@@ -1,0 +1,30 @@
+"""sheep_tpu: a TPU-native streaming elimination-tree graph partitioner.
+
+A from-scratch reimplementation of the capabilities of the Sheep partitioner
+(Margo & Seltzer, VLDB'15; reference C++/MPI implementation surveyed in
+SURVEY.md) designed for TPU execution with JAX/XLA:
+
+- the per-worker streaming tree-insert loop becomes a batched, fixed-shape
+  "hooking" kernel over edge blocks (`sheep_tpu.ops.forest`),
+- the distributed degree sort becomes a `psum` histogram + replicated argsort
+  (`sheep_tpu.parallel`),
+- the associative tree merge becomes a collective min-reduction over the
+  device mesh instead of an MPI_Reduce custom op,
+- partitioning + evaluation run on dense arrays (host C++ / numpy for the
+  sequential FFD pass, device segment-ops for the evaluator).
+
+Layout:
+  io/         edge-list / sequence / tree file formats (.dat .net .seq .tre)
+  core/       exact sequential semantics (numpy oracle) + facts + validation
+  ops/        single-device JAX kernels (sort, hooking, segment sums, eval)
+  parallel/   mesh construction, sharded fused build, tournament merge
+  partition/  tree partitioners (forward FFD et al.), fennel, evaluators
+  cli/        graph2tree / partition_tree / degree_sequence / merge_trees
+  utils/      phase timers (stdout grammar), misc helpers
+"""
+
+__version__ = "0.1.0"
+
+INVALID_JNID = 0xFFFFFFFF
+INVALID_VID = 0xFFFFFFFF
+INVALID_PART = -1

@@ -1,0 +1,24 @@
+from .sequence import degree_sequence, sequence_positions, default_sequence
+from .forest import (
+    Forest,
+    edges_to_positions,
+    build_forest,
+    build_forest_links,
+    merge_forests,
+)
+from .facts import Facts, compute_facts
+from .validate import is_valid_forest
+
+__all__ = [
+    "degree_sequence",
+    "sequence_positions",
+    "default_sequence",
+    "Forest",
+    "edges_to_positions",
+    "build_forest",
+    "build_forest_links",
+    "merge_forests",
+    "Facts",
+    "compute_facts",
+    "is_valid_forest",
+]

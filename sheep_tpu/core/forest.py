@@ -1,0 +1,136 @@
+"""Elimination-forest construction — exact sequential semantics (host oracle).
+
+The reference builds its "JTree" by streaming vertices in sequence order
+(lib/jtree.cpp:34-55): when vertex X is inserted, each already-inserted
+neighbor's subtree root is re-parented to X via union-find
+(lib/jnode.h:158-162), and each not-yet-inserted neighbor increments X's
+``pst_weight`` (self-loops excluded, jtree.cpp:48).
+
+This module uses an equivalent *link-processing* formulation that the whole
+framework is built around:
+
+    Map each edge {u,v} to sequence positions (lo, hi) with lo < hi.
+    - ``pst_weight[lo] += 1`` per edge (order-free: a pure segment-sum).
+    - Process links (lo -> hi) in ascending-hi order with union-find whose
+      representative is the max-position element of each component:
+          r = find(lo); if r != hi: parent[r] = hi; union.
+
+This yields the *identical* parent array: when hi's edges are processed, hi
+is still a root (links only attach earlier roots to later vertices), and
+within one hi-group, link order does not affect the parent array (distinct
+component roots each get parent hi; repeats are no-ops).  The same routine
+implements the associative tree *merge* (lib/jnode.cpp:174-201): a tree's
+(kid, parent) pairs are simply re-inserted as links, so merging k partial
+trees is "concatenate their links and rebuild" — which is what the batched
+TPU kernel (sheep_tpu.ops.forest) and the mesh-collective merge
+(sheep_tpu.parallel) exploit.
+
+This numpy/python implementation is the correctness oracle for the C++ and
+JAX paths; it is exact but not fast.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+from .. import INVALID_JNID
+from .sequence import sequence_positions
+
+
+@dataclass
+class Forest:
+    """Elimination forest over jnid space (positions in the sequence)."""
+
+    parent: np.ndarray      # uint32 [n], INVALID_JNID for roots
+    pst_weight: np.ndarray  # uint32 [n]
+
+    @property
+    def n(self) -> int:
+        return len(self.parent)
+
+    def copy(self) -> "Forest":
+        return Forest(self.parent.copy(), self.pst_weight.copy())
+
+
+def edges_to_positions(tail: np.ndarray, head: np.ndarray, seq: np.ndarray,
+                       max_vid: int | None = None) -> tuple[np.ndarray, np.ndarray]:
+    """Map edge records to (lo, hi) position pairs, dropping self-loops."""
+    pos = sequence_positions(seq, max_vid)
+    pt = pos[tail].astype(np.int64)
+    ph = pos[head].astype(np.int64)
+    keep = pt != ph  # drops self-loops; position map is injective on seq
+    pt, ph = pt[keep], ph[keep]
+    lo = np.minimum(pt, ph)
+    hi = np.maximum(pt, ph)
+    return lo, hi
+
+
+def _find(uf: np.ndarray, x: int) -> int:
+    """Find with path compression; representative = max element of component."""
+    root = x
+    while uf[root] != root:
+        root = uf[root]
+    while uf[x] != root:
+        uf[x], x = root, uf[x]
+    return root
+
+
+def build_forest_links(lo: np.ndarray, hi: np.ndarray, n: int,
+                       pst: np.ndarray | None = None) -> Forest:
+    """Build the elimination forest from links (lo -> hi), lo < hi elementwise.
+
+    ``pst`` lets callers pass precomputed pst-weights (used by merge, where
+    links are tree edges that must not be re-counted).  When None, each link
+    contributes 1 to pst_weight[lo].
+    """
+    if pst is None:
+        pst = np.bincount(lo, minlength=n).astype(np.uint32)
+    parent = np.full(n, INVALID_JNID, dtype=np.uint32)
+    uf = np.arange(n, dtype=np.int64)
+    order = np.argsort(hi, kind="stable")
+    lo_s, hi_s = lo[order], hi[order]
+    for i in range(len(lo_s)):
+        h = int(hi_s[i])
+        r = _find(uf, int(lo_s[i]))
+        if r != h:
+            # r is the max of its component and h > r: attach and re-root.
+            parent[r] = h
+            uf[r] = h
+    return Forest(parent, pst.astype(np.uint32))
+
+
+def build_forest(tail: np.ndarray, head: np.ndarray, seq: np.ndarray,
+                 max_vid: int | None = None) -> Forest:
+    """Build from raw edge records over a (possibly partial) graph."""
+    lo, hi = edges_to_positions(tail, head, seq, max_vid)
+    return build_forest_links(lo, hi, len(seq))
+
+
+def forest_links(forest: Forest) -> tuple[np.ndarray, np.ndarray]:
+    """A tree's (kid, parent) pairs as link arrays."""
+    kids = np.nonzero(forest.parent != INVALID_JNID)[0].astype(np.int64)
+    return kids, forest.parent[kids].astype(np.int64)
+
+
+def merge_forests(*forests: Forest) -> Forest:
+    """Associative merge of same-sequence partial forests.
+
+    Equivalent to the reference's pairwise merge (lib/jnode.cpp:174-201) /
+    MPI_Reduce custom op (:203-250): pst_weights add; parent links from all
+    inputs are replayed as links in ascending-parent order.
+    """
+    assert len(forests) >= 1
+    n = forests[0].n
+    assert all(f.n == n for f in forests)
+    pst = np.zeros(n, dtype=np.uint64)
+    los, his = [], []
+    for f in forests:
+        pst += f.pst_weight
+        k, p = forest_links(f)
+        los.append(k)
+        his.append(p)
+    lo = np.concatenate(los) if los else np.empty(0, dtype=np.int64)
+    hi = np.concatenate(his) if his else np.empty(0, dtype=np.int64)
+    return build_forest_links(lo, hi, n, pst=pst.astype(np.uint32))

@@ -1,0 +1,49 @@
+"""Sequence engine: vertex elimination orders.
+
+The default Sheep order is *ascending degree, ties broken by ascending vid*
+(lib/sequence.h:52-63 degreeSequence; identical comparator in mpiSequence
+:85-91 and fileSequence :114-120).  Only vertices with nonzero degree enter
+the sequence (the node iterator skips 0-degree vertices,
+graph_wrapper.h:97-100; fileSequence filters degree==0, sequence.h:110-112).
+
+All variants in the reference (serial, MPI-Allreduce, file-streaming) compute
+the *same* order given the same whole-graph degrees — every MPI rank sorts an
+identical replicated histogram.  Here the host version is a numpy lexsort;
+the device/mesh versions live in sheep_tpu.ops / sheep_tpu.parallel and are
+tested equal to this one.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def degree_sequence_from_degrees(deg: np.ndarray) -> np.ndarray:
+    """Sequence from a dense degree histogram (vid-indexed)."""
+    vids = np.nonzero(deg)[0]
+    order = np.lexsort((vids, deg[vids]))  # primary: degree asc, tie: vid asc
+    return vids[order].astype(np.uint32)
+
+
+def degree_sequence(tail: np.ndarray, head: np.ndarray,
+                    num_vertices: int | None = None) -> np.ndarray:
+    """Ascending-degree sequence from edge records (whole graph)."""
+    n = num_vertices
+    if n is None:
+        n = int(max(tail.max(initial=0), head.max(initial=0))) + 1 if len(tail) else 0
+    deg = np.bincount(tail, minlength=n) + np.bincount(head, minlength=n)
+    return degree_sequence_from_degrees(deg)
+
+
+def default_sequence(deg: np.ndarray) -> np.ndarray:
+    """Vertices in vid order, degree-0 skipped (lib/sequence.h:43-50)."""
+    return np.nonzero(deg)[0].astype(np.uint32)
+
+
+def sequence_positions(seq: np.ndarray, max_vid: int | None = None) -> np.ndarray:
+    """Invert a sequence into a vid->position map; 0xFFFFFFFF where absent."""
+    n = int(max_vid) + 1 if max_vid is not None else (int(seq.max()) + 1 if len(seq) else 0)
+    n = max(n, int(seq.max()) + 1 if len(seq) else 0)
+    pos = np.full(n, 0xFFFFFFFF, dtype=np.uint32)
+    pos[seq] = np.arange(len(seq), dtype=np.uint32)
+    return pos

@@ -1,0 +1,48 @@
+"""Self-validation oracle — analog of ``graph2tree -c`` (lib/jtree.cpp:238-301).
+
+Checks the defining elimination-tree invariant per edge: for an edge whose
+endpoints sit at positions lo < hi, walking parent pointers up from lo must
+reach hi within the forest (hi lies on lo's root path), without overshooting.
+Also checks structural sanity: parents strictly later than children, pst sum
+equals the number of non-loop edge records, bounded walk lengths.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .. import INVALID_JNID
+from .forest import Forest, edges_to_positions
+
+
+def is_valid_forest(forest: Forest, tail: np.ndarray, head: np.ndarray,
+                    seq: np.ndarray, max_vid: int | None = None) -> bool:
+    n = forest.n
+    parent = forest.parent.astype(np.int64)
+    parent[forest.parent == INVALID_JNID] = -1
+
+    if n != len(seq):
+        return False
+    ids = np.arange(n)
+    linked = parent >= 0
+    if not np.all(parent[linked] > ids[linked]):
+        return False
+
+    lo, hi = edges_to_positions(tail, head, seq, max_vid)
+    if int(forest.pst_weight.sum()) != len(lo):
+        return False
+    if len(lo) and np.bincount(lo, minlength=n).astype(np.int64).tolist() != \
+            forest.pst_weight.astype(np.int64).tolist():
+        return False
+
+    for l, h in zip(lo.tolist(), hi.tolist()):
+        cur = l
+        steps = 0
+        while cur < h:
+            cur = parent[cur]
+            steps += 1
+            if cur < 0 or steps > n:
+                return False
+        if cur != h:
+            return False
+    return True

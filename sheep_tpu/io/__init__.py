@@ -1,0 +1,27 @@
+from .edges import (
+    EdgeList,
+    load_edges,
+    write_edges,
+    read_dat,
+    read_net,
+    write_dat,
+    write_net,
+    partial_range,
+)
+from .seqfile import read_sequence, write_sequence
+from .trefile import read_tree, write_tree
+
+__all__ = [
+    "EdgeList",
+    "load_edges",
+    "write_edges",
+    "read_dat",
+    "read_net",
+    "write_dat",
+    "write_net",
+    "partial_range",
+    "read_sequence",
+    "write_sequence",
+    "read_tree",
+    "write_tree",
+]

@@ -1,0 +1,152 @@
+"""Edge-list file formats.
+
+Two on-disk formats, byte/char-compatible with the reference
+(lib/readerwriter.h:36-102):
+
+- ``.dat``  XS1 / Graph500 binary: little-endian 12-byte records
+  ``{uint32 tail, uint32 head, float32 weight}``.
+- ``.net``  SNAP whitespace-separated text: ``tail head`` per line
+  (comment lines starting with '#' are skipped, matching operator-stream
+  semantics of ``stream >> X`` which the reference relies on only for
+  well-formed files).
+
+Dispatch on the ``.dat`` suffix mirrors lib/sequence.h:124-128 and
+lib/partition.cpp:677.
+
+An :class:`EdgeList` is just a pair of uint32 numpy arrays (tail, head) plus
+bookkeeping.  Graphs are undirected: every record is one undirected edge;
+degree/adjacency semantics double it (LLAMA's LL_L_UNDIRECTED_DOUBLE,
+graph_wrapper.h:51).  Multi-edges are preserved (the reference's DDUP_GRAPH
+option is off by default) and self-loops are preserved in the record stream
+(they contribute 2 to their endpoint's degree but are excluded from tree
+pst-weights, jtree.cpp:48).
+
+Partial loads (`graph2tree -l part/num_parts`, graph_wrapper.h:48-49) are
+contiguous record ranges: part k of n (1-indexed) covers records
+[floor((k-1)*E/n), floor(k*E/n)).  The union over k is the whole file and
+parts are edge-disjoint, which is the property the distributed tree merge
+relies on.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+
+import numpy as np
+
+_XS1_DTYPE = np.dtype(
+    [("tail", "<u4"), ("head", "<u4"), ("weight", "<f4")]
+)
+
+
+@dataclass
+class EdgeList:
+    """A batch of undirected edge records."""
+
+    tail: np.ndarray  # uint32 [E]
+    head: np.ndarray  # uint32 [E]
+    #: total records in the underlying file (== len(tail) unless partial load)
+    file_edges: int = 0
+    #: record range [start, stop) of this (possibly partial) load
+    start: int = 0
+
+    def __post_init__(self):
+        if self.file_edges == 0:
+            self.file_edges = len(self.tail)
+
+    @property
+    def num_edges(self) -> int:
+        return len(self.tail)
+
+    @property
+    def max_vid(self) -> int:
+        if self.num_edges == 0:
+            return 0
+        return int(max(self.tail.max(), self.head.max()))
+
+    def degrees(self, num_vertices: int | None = None) -> np.ndarray:
+        """Per-vertex degree of the undirected-doubled graph.
+
+        Each record adds 1 to both endpoints; a self-loop adds 2 to its
+        vertex (LLAMA doubled-graph semantics, graph_wrapper.h:87-89).
+        """
+        n = num_vertices if num_vertices is not None else self.max_vid + 1
+        deg = np.bincount(self.tail, minlength=n)
+        deg += np.bincount(self.head, minlength=n)
+        return deg.astype(np.int64)
+
+
+def partial_range(num_records: int, part: int, num_parts: int) -> tuple[int, int]:
+    """Record range of partial load `part`/`num_parts` (part is 1-indexed)."""
+    if num_parts <= 0:
+        return 0, num_records
+    if not (1 <= part <= num_parts):
+        raise ValueError(f"part {part} out of range 1..{num_parts}")
+    start = ((part - 1) * num_records) // num_parts
+    stop = (part * num_records) // num_parts
+    return start, stop
+
+
+def read_dat(path: str, part: int = 0, num_parts: int = 0) -> EdgeList:
+    nbytes = os.path.getsize(path)
+    num_records = nbytes // _XS1_DTYPE.itemsize
+    start, stop = partial_range(num_records, part, num_parts) if num_parts else (0, num_records)
+    with open(path, "rb") as f:
+        f.seek(start * _XS1_DTYPE.itemsize)
+        raw = np.fromfile(f, dtype=_XS1_DTYPE, count=stop - start)
+    return EdgeList(
+        tail=np.ascontiguousarray(raw["tail"]),
+        head=np.ascontiguousarray(raw["head"]),
+        file_edges=num_records,
+        start=start,
+    )
+
+
+def read_net(path: str, part: int = 0, num_parts: int = 0) -> EdgeList:
+    # np.loadtxt is slow for big graphs; use fromstring on the filtered text.
+    with open(path, "rb") as f:
+        data = f.read()
+    if b"#" in data:
+        lines = [ln for ln in data.splitlines() if not ln.lstrip().startswith(b"#")]
+        data = b"\n".join(lines)
+    flat = np.array(data.split(), dtype=np.uint32)
+    if flat.size % 2 != 0:
+        raise ValueError(f"{path}: odd token count {flat.size}")
+    tails = flat[0::2].copy()
+    heads = flat[1::2].copy()
+    num_records = len(tails)
+    if num_parts:
+        start, stop = partial_range(num_records, part, num_parts)
+        tails, heads = tails[start:stop].copy(), heads[start:stop].copy()
+    else:
+        start = 0
+    return EdgeList(tail=tails, head=heads, file_edges=num_records, start=start)
+
+
+def load_edges(path: str, part: int = 0, num_parts: int = 0) -> EdgeList:
+    """Suffix-dispatching loader (``.dat`` binary, else SNAP text)."""
+    if path.endswith(".dat"):
+        return read_dat(path, part, num_parts)
+    return read_net(path, part, num_parts)
+
+
+def write_dat(path: str, tail: np.ndarray, head: np.ndarray) -> None:
+    rec = np.empty(len(tail), dtype=_XS1_DTYPE)
+    rec["tail"] = tail
+    rec["head"] = head
+    rec["weight"] = 1.0
+    rec.tofile(path)
+
+
+def write_net(path: str, tail: np.ndarray, head: np.ndarray) -> None:
+    with open(path, "w") as f:
+        for x, y in zip(tail.tolist(), head.tolist()):
+            f.write(f"{x} {y}\n")
+
+
+def write_edges(path: str, tail: np.ndarray, head: np.ndarray) -> None:
+    if path.endswith(".dat"):
+        write_dat(path, tail, head)
+    else:
+        write_net(path, tail, head)

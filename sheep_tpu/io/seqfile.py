@@ -1,0 +1,36 @@
+"""Sequence (vertex elimination order) file I/O.
+
+Text format by default — one vid per line — matching the reference's default
+(USE_BIN_SEQUENCE off; lib/sequence.h:153-168).  The binary variant
+(``binary=True``) writes ``{uint64 size}{uint32 vid[size]}`` exactly like
+lib/sequence.h:133-151.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def write_sequence(seq: np.ndarray, path: str, binary: bool = False) -> None:
+    seq = np.asarray(seq, dtype=np.uint32)
+    if binary:
+        with open(path, "wb") as f:
+            f.write(np.uint64(len(seq)).tobytes())
+            f.write(seq.astype("<u4").tobytes())
+    else:
+        with open(path, "w") as f:
+            f.write("\n".join(map(str, seq.tolist())))
+            if len(seq):
+                f.write("\n")
+
+
+def read_sequence(path: str, binary: bool = False) -> np.ndarray:
+    if binary:
+        with open(path, "rb") as f:
+            size = int(np.frombuffer(f.read(8), dtype="<u8")[0])
+            return np.frombuffer(f.read(4 * size), dtype="<u4").copy()
+    with open(path, "rb") as f:
+        data = f.read()
+    if not data.strip():
+        return np.empty(0, dtype=np.uint32)
+    return np.array(data.split(), dtype=np.uint32)

@@ -1,0 +1,37 @@
+"""``.tre`` tree-file I/O.
+
+Byte-compatible with the reference's JNodeTable persistence
+(lib/jnode.cpp:164-168 save / :76-102 mmap-open): a little-endian ``uint32
+end_id`` header followed by ``max_id`` records of ``{uint32 parent, uint32
+pst_weight}``.  ``INVALID_JNID`` (0xFFFFFFFF) marks roots.  In the default
+build path ``end_id == max_id == len(seq)``.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .. import INVALID_JNID
+
+_NODE_DTYPE = np.dtype([("parent", "<u4"), ("pst_weight", "<u4")])
+
+
+def write_tree(path: str, parent: np.ndarray, pst_weight: np.ndarray) -> None:
+    assert len(parent) == len(pst_weight)
+    rec = np.empty(len(parent), dtype=_NODE_DTYPE)
+    rec["parent"] = parent
+    rec["pst_weight"] = pst_weight
+    with open(path, "wb") as f:
+        f.write(np.uint32(len(parent)).tobytes())
+        f.write(rec.tobytes())
+
+
+def read_tree(path: str) -> tuple[np.ndarray, np.ndarray]:
+    """Returns (parent, pst_weight) uint32 arrays of length end_id."""
+    with open(path, "rb") as f:
+        end_id = int(np.frombuffer(f.read(4), dtype="<u4")[0])
+        rec = np.fromfile(f, dtype=_NODE_DTYPE)
+    if end_id > len(rec):
+        raise ValueError(f"{path}: end_id {end_id} > {len(rec)} stored nodes")
+    rec = rec[:end_id]
+    return rec["parent"].copy(), rec["pst_weight"].copy()

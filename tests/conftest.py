@@ -1,0 +1,37 @@
+import os
+
+# Force a virtual 8-device CPU mesh for all tests: multi-chip sharding code
+# must compile and run without TPU hardware (the driver validates the real
+# multi-chip path separately via __graft_entry__.dryrun_multichip).
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import numpy as np
+import pytest
+
+HEP_TH = "/root/reference/data/hep-th.dat"
+
+
+@pytest.fixture(scope="session")
+def hep_edges():
+    from sheep_tpu.io import load_edges
+
+    if not os.path.exists(HEP_TH):
+        pytest.skip("hep-th.dat not available")
+    return load_edges(HEP_TH)
+
+
+def random_multigraph(rng, n_max=40, e_max=120, self_loops=True):
+    """Random multigraph edge records (may include self-loops, multi-edges)."""
+    n = int(rng.integers(2, n_max))
+    e = int(rng.integers(1, e_max))
+    tail = rng.integers(0, n, size=e).astype(np.uint32)
+    head = rng.integers(0, n, size=e).astype(np.uint32)
+    if not self_loops:
+        fix = tail == head
+        head[fix] = (head[fix] + 1) % n
+    return tail, head

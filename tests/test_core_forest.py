@@ -1,0 +1,156 @@
+"""Core forest construction vs an independent brute-force simulation.
+
+The brute-force model literally replays the reference's insert loop
+(lib/jtree.cpp:34-55): stream vertices in sequence order, keep connected
+components of the inserted subgraph as Python sets with their max-position
+element as root, attach roots, count postorder edges.
+"""
+
+import numpy as np
+import pytest
+
+from sheep_tpu import INVALID_JNID
+from sheep_tpu.core import (
+    build_forest,
+    build_forest_links,
+    compute_facts,
+    degree_sequence,
+    edges_to_positions,
+    merge_forests,
+    is_valid_forest,
+)
+from conftest import random_multigraph
+
+
+def brute_force_forest(tail, head, seq):
+    """Simulate the streaming insert loop directly."""
+    pos = {int(v): i for i, v in enumerate(seq)}
+    n = len(seq)
+    # adjacency over positions (directed-doubled, self-loops kept as records)
+    adj = [[] for _ in range(n)]
+    for t, h in zip(tail.tolist(), head.tolist()):
+        if t == h:
+            continue  # self-loops never contribute (jtree.cpp:48)
+        a, b = pos[t], pos[h]
+        adj[a].append(b)
+        adj[b].append(a)
+
+    parent = np.full(n, INVALID_JNID, dtype=np.uint32)
+    pst = np.zeros(n, dtype=np.uint32)
+    comp_of = {}   # position -> component id
+    comps = {}     # component id -> (set of positions, root position)
+    next_comp = [0]
+
+    for x in range(n):  # insertion order == position order
+        cid = next_comp[0]
+        next_comp[0] += 1
+        comps[cid] = ({x}, x)
+        comp_of[x] = cid
+        for nbr in adj[x]:
+            if nbr < x:  # preorder: already inserted
+                ncid = comp_of[nbr]
+                if ncid != comp_of[x]:
+                    members, root = comps[ncid]
+                    parent[root] = x
+                    cur_members, _ = comps[comp_of[x]]
+                    merged = members | cur_members
+                    mcid = comp_of[x]
+                    comps[mcid] = (merged, x)
+                    for m in members:
+                        comp_of[m] = mcid
+            else:  # postorder: not yet inserted
+                pst[x] += 1
+    return parent, pst
+
+
+@pytest.mark.parametrize("seed", range(25))
+def test_forest_matches_bruteforce(seed):
+    rng = np.random.default_rng(seed)
+    tail, head = random_multigraph(rng)
+    seq = degree_sequence(tail, head)
+    f = build_forest(tail, head, seq)
+    bp, bpst = brute_force_forest(tail, head, seq)
+    np.testing.assert_array_equal(f.parent, bp)
+    np.testing.assert_array_equal(f.pst_weight, bpst)
+    assert is_valid_forest(f, tail, head, seq)
+
+
+@pytest.mark.parametrize("seed", range(10))
+def test_edge_order_irrelevant(seed):
+    """The parent array must not depend on edge-record order."""
+    rng = np.random.default_rng(100 + seed)
+    tail, head = random_multigraph(rng)
+    seq = degree_sequence(tail, head)
+    f1 = build_forest(tail, head, seq)
+    perm = rng.permutation(len(tail))
+    f2 = build_forest(tail[perm], head[perm], seq)
+    np.testing.assert_array_equal(f1.parent, f2.parent)
+    np.testing.assert_array_equal(f1.pst_weight, f2.pst_weight)
+
+
+@pytest.mark.parametrize("seed", range(15))
+@pytest.mark.parametrize("nparts", [2, 3, 5])
+def test_partial_build_and_merge(seed, nparts):
+    """Edge-disjoint partial forests merge to the whole-graph forest
+    (the associativity the distributed reduce relies on)."""
+    rng = np.random.default_rng(200 + seed)
+    tail, head = random_multigraph(rng, n_max=60, e_max=300)
+    seq = degree_sequence(tail, head)
+    whole = build_forest(tail, head, seq)
+
+    bounds = [(k * len(tail)) // nparts for k in range(nparts + 1)]
+    partials = [
+        build_forest(tail[bounds[k]:bounds[k + 1]], head[bounds[k]:bounds[k + 1]], seq)
+        for k in range(nparts)
+    ]
+    merged = merge_forests(*partials)
+    np.testing.assert_array_equal(merged.parent, whole.parent)
+    np.testing.assert_array_equal(merged.pst_weight, whole.pst_weight)
+
+    # pairwise tournament (scripts/horizontal-dist.sh REDUCTION=2) agrees too
+    layer = partials
+    while len(layer) > 1:
+        nxt = []
+        for i in range(0, len(layer) - 1, 2):
+            nxt.append(merge_forests(layer[i], layer[i + 1]))
+        if len(layer) % 2:
+            nxt.append(layer[-1])
+        layer = nxt
+    np.testing.assert_array_equal(layer[0].parent, whole.parent)
+
+
+def test_merge_is_idempotent_on_self():
+    rng = np.random.default_rng(7)
+    tail, head = random_multigraph(rng)
+    seq = degree_sequence(tail, head)
+    f = build_forest(tail, head, seq)
+    # merging a forest with an empty forest preserves it
+    empty = build_forest(tail[:0], head[:0], seq)
+    m = merge_forests(f, empty)
+    np.testing.assert_array_equal(m.parent, f.parent)
+    np.testing.assert_array_equal(m.pst_weight, f.pst_weight)
+
+
+def test_path_graph_chain():
+    # path 0-1-2-3 in vid order, uniform degree ties -> seq by vid
+    tail = np.array([0, 1, 2], dtype=np.uint32)
+    head = np.array([1, 2, 3], dtype=np.uint32)
+    seq = degree_sequence(tail, head)
+    f = build_forest(tail, head, seq)
+    facts = compute_facts(f)
+    assert facts.root_cnt == 1
+    assert facts.edge_cnt == 3
+    # every non-final node's parent is set
+    assert int((f.parent == INVALID_JNID).sum()) == 1
+
+
+def test_self_loops_and_multi_edges():
+    tail = np.array([0, 0, 0, 1], dtype=np.uint32)
+    head = np.array([0, 1, 1, 1], dtype=np.uint32)
+    seq = degree_sequence(tail, head)
+    f = build_forest(tail, head, seq)
+    # self-loop (0,0) ignored; multi-edge (0,1)x2 counted twice in pst;
+    # self-loop (1,1) ignored.
+    assert int(f.pst_weight.sum()) == 2
+    lo, hi = edges_to_positions(tail, head, seq)
+    assert len(lo) == 2

@@ -1,0 +1,35 @@
+"""Golden-value tests on the reference's bundled hep-th graph.
+
+Expected values come from the reference's published experiment logs
+(data/quality/hep.degree.raw): tree facts for the degree sequence —
+width 24, roots 581, vheight 754, eheight 2330, verts 7610, edges 15751,
+halo 3532, core 0, fill 0.
+"""
+
+import numpy as np
+
+from sheep_tpu.core import build_forest, compute_facts, degree_sequence, is_valid_forest
+
+
+def test_hepth_degree_sequence_tree_facts(hep_edges):
+    seq = degree_sequence(hep_edges.tail, hep_edges.head)
+    assert len(seq) == 7610
+
+    forest = build_forest(hep_edges.tail, hep_edges.head, seq)
+    facts = compute_facts(forest)
+    assert facts.vert_cnt == 7610
+    assert facts.edge_cnt == 15751
+    assert facts.width == 24
+    assert facts.root_cnt == 581
+    assert facts.vert_height == 754
+    assert facts.edge_height == 2330
+    assert facts.halo_id == 3532
+    assert facts.core_id == 0
+    assert facts.fill == 0
+
+
+def test_hepth_tree_valid(hep_edges):
+    seq = degree_sequence(hep_edges.tail, hep_edges.head)
+    forest = build_forest(hep_edges.tail, hep_edges.head, seq)
+    assert is_valid_forest(forest, hep_edges.tail, hep_edges.head, seq,
+                           max_vid=hep_edges.max_vid)

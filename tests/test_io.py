@@ -1,0 +1,81 @@
+import numpy as np
+
+from sheep_tpu import INVALID_JNID
+from sheep_tpu.io import (
+    load_edges,
+    partial_range,
+    read_sequence,
+    read_tree,
+    write_edges,
+    write_sequence,
+    write_tree,
+)
+
+
+def test_dat_roundtrip(tmp_path):
+    tail = np.array([1, 5, 2, 2], dtype=np.uint32)
+    head = np.array([3, 1, 2, 4], dtype=np.uint32)
+    p = str(tmp_path / "g.dat")
+    write_edges(p, tail, head)
+    el = load_edges(p)
+    np.testing.assert_array_equal(el.tail, tail)
+    np.testing.assert_array_equal(el.head, head)
+    assert el.file_edges == 4
+    assert el.max_vid == 5
+
+
+def test_net_roundtrip(tmp_path):
+    tail = np.array([0, 7, 3], dtype=np.uint32)
+    head = np.array([2, 0, 3], dtype=np.uint32)
+    p = str(tmp_path / "g.net")
+    write_edges(p, tail, head)
+    el = load_edges(p)
+    np.testing.assert_array_equal(el.tail, tail)
+    np.testing.assert_array_equal(el.head, head)
+
+
+def test_net_comments(tmp_path):
+    p = tmp_path / "g.net"
+    p.write_text("# comment line\n0 1\n2 3\n")
+    el = load_edges(str(p))
+    np.testing.assert_array_equal(el.tail, [0, 2])
+    np.testing.assert_array_equal(el.head, [1, 3])
+
+
+def test_partial_ranges_cover_disjointly():
+    for e in [0, 1, 7, 100, 101]:
+        for n in [1, 2, 3, 7]:
+            spans = [partial_range(e, k, n) for k in range(1, n + 1)]
+            assert spans[0][0] == 0 and spans[-1][1] == e
+            for (a, b), (c, d) in zip(spans, spans[1:]):
+                assert b == c
+
+
+def test_partial_load(tmp_path):
+    tail = np.arange(10, dtype=np.uint32)
+    head = np.arange(10, 20, dtype=np.uint32)
+    p = str(tmp_path / "g.dat")
+    write_edges(p, tail, head)
+    parts = [load_edges(p, part=k, num_parts=3) for k in (1, 2, 3)]
+    got_t = np.concatenate([q.tail for q in parts])
+    np.testing.assert_array_equal(got_t, tail)
+    assert all(q.file_edges == 10 for q in parts)
+
+
+def test_sequence_roundtrip(tmp_path):
+    seq = np.array([5, 2, 9, 0], dtype=np.uint32)
+    p = str(tmp_path / "s.seq")
+    for binary in (False, True):
+        write_sequence(seq, p, binary=binary)
+        got = read_sequence(p, binary=binary)
+        np.testing.assert_array_equal(got, seq)
+
+
+def test_tree_roundtrip(tmp_path):
+    parent = np.array([2, 2, INVALID_JNID], dtype=np.uint32)
+    pst = np.array([1, 0, 3], dtype=np.uint32)
+    p = str(tmp_path / "t.tre")
+    write_tree(p, parent, pst)
+    gp, gw = read_tree(p)
+    np.testing.assert_array_equal(gp, parent)
+    np.testing.assert_array_equal(gw, pst)

@@ -1,0 +1,28 @@
+from .tree_partition import (
+    TreePartitionOptions,
+    forward_partition,
+    backward_partition,
+    depth_partition,
+    height_partition,
+    naive_partition,
+    random_partition,
+    partition_forest,
+    make_kids,
+)
+from .partition import Partition
+from .evaluate import evaluate_partition, EvalReport
+
+__all__ = [
+    "TreePartitionOptions",
+    "forward_partition",
+    "backward_partition",
+    "depth_partition",
+    "height_partition",
+    "naive_partition",
+    "random_partition",
+    "partition_forest",
+    "make_kids",
+    "Partition",
+    "evaluate_partition",
+    "EvalReport",
+]

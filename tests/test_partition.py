@@ -1,0 +1,200 @@
+"""Partitioner + evaluator: goldens on hep-th and brute-force parity.
+
+Golden values from the reference's published log data/quality/hep.degree.raw
+(degree sequence, balance 1.03, pst weights — the partition_tree defaults).
+"""
+
+import numpy as np
+import pytest
+
+from sheep_tpu import INVALID_PART
+from sheep_tpu.core import build_forest, degree_sequence
+from sheep_tpu.core.sequence import sequence_positions
+from sheep_tpu.partition import (
+    Partition,
+    TreePartitionOptions,
+    evaluate_partition,
+    partition_forest,
+)
+from sheep_tpu.partition.evaluate import cormen_hash
+from conftest import random_multigraph
+
+GOLDEN = {
+    2: dict(sizes=(3409, 4201), edges_cut=2811, vcom=2061, ecv_hash=1311,
+            ecv_down=521, ecv_up=1539),
+    3: dict(sizes=(2323, 2205), edges_cut=3973, vcom=3256, ecv_hash=2042,
+            ecv_down=888, ecv_up=2364),
+    4: dict(sizes=(1662, 1714), edges_cut=4601, vcom=4075, ecv_hash=2452,
+            ecv_down=1177, ecv_up=2893),
+}
+
+
+@pytest.fixture(scope="module")
+def hep_setup(hep_edges):
+    seq = degree_sequence(hep_edges.tail, hep_edges.head)
+    forest = build_forest(hep_edges.tail, hep_edges.head, seq)
+    return hep_edges, seq, forest
+
+
+@pytest.mark.parametrize("nparts", [2, 3, 4])
+def test_hepth_partition_goldens(hep_setup, nparts):
+    el, seq, forest = hep_setup
+    p = Partition.from_forest(seq, forest, nparts, max_vid=el.max_vid)
+    g = GOLDEN[nparts]
+    assert p.max_part + 1 == nparts
+    first = int((p.parts == 0).sum())
+    second = int((p.parts == 1).sum())
+    assert (first, second) == g["sizes"]
+
+    rep = evaluate_partition(p.parts, el.tail, el.head, seq, nparts,
+                             max_vid=el.max_vid, file_edges=el.file_edges)
+    assert rep.edges_cut == g["edges_cut"]
+    assert rep.vcom_vol == g["vcom"]
+    assert rep.ecv_hash == g["ecv_hash"]
+    assert rep.ecv_down == g["ecv_down"]
+    assert rep.ecv_up == g["ecv_up"]
+
+
+def brute_force_eval(parts, tail, head, seq, num_parts, file_edges):
+    """Literal replay of lib/partition.cpp:428-521 with python sets."""
+    pos = {int(v): i for i, v in enumerate(seq)}
+    adj = {}
+    for t, h in zip(tail.tolist(), head.tolist()):
+        adj.setdefault(t, []).append(h)
+        adj.setdefault(h, []).append(t)
+
+    edges_cut = vcom = ecv_hash = ecv_down = ecv_up = 0
+    P = int(max(parts)) + 1
+    vert_bal = [0] * P
+    hash_bal = [0] * P
+    down_bal = [0] * P
+    up_bal = [0] * P
+
+    ch = lambda k: int(cormen_hash(np.array([k], dtype=np.uint32))[0])
+    for X in sorted(adj):
+        Xp = int(parts[X])
+        vert_bal[Xp] += 1
+        vset = {Xp}
+        hset = set()
+        dset = set()
+        uset = set()
+        for Y in adj[X]:
+            Yp = int(parts[Y])
+            if X < Y and Xp != Yp:
+                edges_cut += 1
+            vset.add(Yp)
+            hp = Xp if ch(X) < ch(Y) else Yp
+            hset.add(hp)
+            if X < Y:
+                hash_bal[hp] += 1
+            dset.add(Xp if pos[X] < pos[Y] else Yp)
+            uset.add(Xp if pos[X] > pos[Y] else Yp)
+            if pos[X] < pos[Y]:
+                down_bal[Xp] += 1
+            if pos[X] > pos[Y]:
+                up_bal[Xp] += 1
+        vcom += len(vset) - 1
+        ecv_hash += len(hset) - 1
+        ecv_down += len(dset) - 1
+        ecv_up += len(uset) - 1
+    return dict(edges_cut=edges_cut, vcom=vcom, ecv_hash=ecv_hash,
+                ecv_down=ecv_down, ecv_up=ecv_up,
+                vertex_balance=max(vert_bal), hash_balance=max(hash_bal),
+                down_balance=max(down_bal), up_balance=max(up_bal))
+
+
+@pytest.mark.parametrize("seed", range(12))
+def test_evaluator_matches_bruteforce(seed):
+    rng = np.random.default_rng(300 + seed)
+    tail, head = random_multigraph(rng, n_max=30, e_max=90)
+    seq = degree_sequence(tail, head)
+    n = int(max(tail.max(), head.max())) + 1
+    parts = np.full(n, INVALID_PART, dtype=np.int64)
+    parts[seq] = rng.integers(0, 3, size=len(seq))
+
+    rep = evaluate_partition(parts, tail, head, seq, 3)
+    bf = brute_force_eval(parts, tail, head, seq, 3, len(tail))
+    assert rep.edges_cut == bf["edges_cut"]
+    assert rep.vcom_vol == bf["vcom"]
+    assert rep.ecv_hash == bf["ecv_hash"]
+    assert rep.ecv_down == bf["ecv_down"]
+    assert rep.ecv_up == bf["ecv_up"]
+    assert rep.vertex_balance == bf["vertex_balance"]
+    assert rep.hash_balance == bf["hash_balance"]
+    assert rep.down_balance == bf["down_balance"]
+    assert rep.up_balance == bf["up_balance"]
+
+
+@pytest.mark.parametrize("strategy", ["forward", "backward", "depth", "height", "naive"])
+@pytest.mark.parametrize("seed", range(4))
+def test_strategies_assign_everything(strategy, seed):
+    rng = np.random.default_rng(400 + seed)
+    tail, head = random_multigraph(rng, n_max=50, e_max=200)
+    seq = degree_sequence(tail, head)
+    forest = build_forest(tail, head, seq)
+    jparts = partition_forest(forest, 3, strategy=strategy)
+    assert (jparts != INVALID_PART).all()
+    assert jparts.min() >= 0
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_forward_balance_invariant(seed):
+    """forwardPartition respects max_component per bin (partition.cpp:114,133)."""
+    rng = np.random.default_rng(500 + seed)
+    tail, head = random_multigraph(rng, n_max=60, e_max=400)
+    seq = degree_sequence(tail, head)
+    forest = build_forest(tail, head, seq)
+    opts = TreePartitionOptions()
+    jparts = partition_forest(forest, 4, opts)
+    w = forest.pst_weight.astype(np.int64)
+    total = int(w.sum())
+    max_component = int((total // 4) * opts.balance_factor)
+    loads = np.bincount(jparts, weights=w)
+    # Every part except possibly the last-resort root bins stays within
+    # max_component; the algorithm guarantees each *bin* stays within.
+    assert (loads <= max_component).all() or total < 4
+
+
+def test_partition_writers(tmp_path, hep_setup):
+    el, seq, forest = hep_setup
+    p = Partition.from_forest(seq, forest, 2, max_vid=el.max_vid)
+    prefix = str(tmp_path / "out-p")
+    paths = p.write_partitioned_graph(el.tail, el.head, seq, prefix,
+                                      max_vid=el.max_vid)
+    assert len(paths) == 2
+    # downward assignment: every non-loop edge lands in exactly one file
+    import os
+    tot = 0
+    for path in paths:
+        with open(path) as f:
+            tot += sum(1 for _ in f)
+    n_loops = int((el.tail == el.head).sum())
+    assert tot == el.num_edges - n_loops
+
+    iso = str(tmp_path / "iso.net")
+    p.write_isomorphic_graph(el.tail, el.head, seq, iso, max_vid=el.max_vid)
+    assert os.path.getsize(iso) > 0
+
+
+def test_forward_overweight_node_raises():
+    """A node heavier than max_component must fail fast, not hang
+    (the reference's live assert at partition.cpp:114)."""
+    tail = np.array([0, 1], dtype=np.uint32)
+    head = np.array([1, 2], dtype=np.uint32)
+    seq = degree_sequence(tail, head)
+    forest = build_forest(tail, head, seq)
+    with pytest.raises(ValueError, match="max_component"):
+        partition_forest(forest, 8)
+
+
+def test_balance_denominators_truncate(capsys):
+    """Printed balance fractions use integer-divided denominators
+    (partition.cpp:470: max_bal / (getNodes() / num_parts))."""
+    from sheep_tpu.partition.evaluate import EvalReport
+    rep = EvalReport(edges_cut=0, vcom_vol=0, ecv_hash=0, ecv_down=0,
+                     ecv_up=0, vertex_balance=5, hash_balance=0,
+                     down_balance=0, up_balance=0,
+                     num_edges=10, num_nodes=9, num_parts=2)
+    rep.print()
+    out = capsys.readouterr().out
+    assert "balance: 5 (1.250000%)" in out  # 5 / (9 // 2), not 5 / 4.5

@@ -1,0 +1,72 @@
+"""Benchmark: device elimination-forest build throughput (edges/sec).
+
+Prints ONE JSON line.  The metric is end-to-end edges/sec of the fused
+single-chip build step (degree histogram + (degree,vid) sort + edge links +
+forest fixpoint + pst) on an R-MAT power-law graph — the analog of the
+reference's load-free sort+map phases.  ``vs_baseline`` compares against the
+reference's best aggregate MPI throughput on twitter-2010: 1,468,364,884
+edges / 18.7 s map = 78.5M edges/s across 18 ranks (BASELINE.md,
+data/slurm-twitter/slurm-25.avg:15); the north-star target is 10x that.
+
+Sizes are env-tunable: SHEEP_BENCH_LOG_N (default 23), SHEEP_BENCH_EDGE_FACTOR
+(default 8 edges per vertex), SHEEP_BENCH_REPS (default 3).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+_BASELINE_EDGES_PER_SEC = 1_468_364_884 / 18.7  # twitter map, 18 MPI ranks
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+    from sheep_tpu.ops import build_step
+    from sheep_tpu.utils import rmat_edges
+
+    platform = jax.devices()[0].platform
+    on_accel = platform not in ("cpu",)
+    log_n = int(os.environ.get("SHEEP_BENCH_LOG_N", "23" if on_accel else "18"))
+    factor = int(os.environ.get("SHEEP_BENCH_EDGE_FACTOR", "8"))
+    reps = int(os.environ.get("SHEEP_BENCH_REPS", "3"))
+    n = 1 << log_n
+    e = factor * n
+
+    print(f"bench: platform={platform} n=2^{log_n} edges={e}", file=sys.stderr)
+    tail, head = rmat_edges(log_n, e, seed=1)
+    t = jax.device_put(jnp.asarray(tail, jnp.int32))
+    h = jax.device_put(jnp.asarray(head, jnp.int32))
+
+    # warmup / compile
+    out = build_step(t, h, n)
+    jax.block_until_ready(out)
+    rounds = int(out[5])
+    print(f"bench: fixpoint rounds={rounds}", file=sys.stderr)
+
+    times = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        out = build_step(t, h, n)
+        jax.block_until_ready(out)
+        times.append(time.perf_counter() - t0)
+    best = min(times)
+    eps = e / best
+    print(f"bench: times={['%.3f' % x for x in times]} best={best:.3f}s",
+          file=sys.stderr)
+
+    print(json.dumps({
+        "metric": f"device_build_edges_per_sec_rmat_n2^{log_n}_e{factor}x",
+        "value": round(eps, 1),
+        "unit": "edges/sec",
+        "vs_baseline": round(eps / _BASELINE_EDGES_PER_SEC, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,28 @@
+from .sort import (
+    degree_histogram,
+    degree_order,
+    edge_links,
+    degree_sequence_device,
+)
+from .forest import (
+    forest_fixpoint,
+    pst_weights,
+    merge_parents,
+    build_forest_device,
+    merge_forests_device,
+)
+from .build import build_step, build_graph_device
+
+__all__ = [
+    "degree_histogram",
+    "degree_order",
+    "edge_links",
+    "degree_sequence_device",
+    "forest_fixpoint",
+    "pst_weights",
+    "merge_parents",
+    "build_forest_device",
+    "merge_forests_device",
+    "build_step",
+    "build_graph_device",
+]

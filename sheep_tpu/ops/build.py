@@ -1,0 +1,57 @@
+"""Fused single-device build step: edges -> (sequence, elimination forest).
+
+This is the whole ``graph2tree`` compute path as one jitted program with
+static shapes — the device analog of load+sort+map (SURVEY §3.1): degree
+histogram, (degree, vid) sort, edge->link mapping, forest fixpoint, pst
+segment-sum.  The mesh-sharded variant lives in sheep_tpu.parallel.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from .. import INVALID_JNID
+from ..core.forest import Forest
+from .forest import forest_fixpoint, pst_weights
+from .sort import degree_histogram, degree_order, edge_links
+
+
+@functools.partial(jax.jit, static_argnames=("n",))
+def build_step(tail: jnp.ndarray, head: jnp.ndarray, n: int):
+    """Full forward step on edge records (uint32/int32 [E]) over n vid slots.
+
+    Returns (seq, pos, num_active, parent, pst, rounds) — all int32, all
+    length n except the scalars.  Positions/parents live in full n-slot
+    space; entries for zero-degree vids sit at the tail and are roots with
+    pst 0.  ``parent[v] == n`` marks roots.
+    """
+    deg = degree_histogram(tail, head, n)
+    seq, pos, m = degree_order(deg)
+    lo, hi = edge_links(tail, head, pos, n)
+    parent, rounds = forest_fixpoint(lo, hi, n)
+    pst = pst_weights(lo, n)
+    return seq, pos, m, parent, pst, rounds
+
+
+def build_graph_device(tail: np.ndarray, head: np.ndarray,
+                       num_vertices: int | None = None):
+    """Host-facing fused build: returns (seq uint32 [m], Forest over m)."""
+    n = num_vertices
+    if n is None:
+        n = int(max(tail.max(initial=0), head.max(initial=0))) + 1 if len(tail) else 0
+    if n == 0:
+        return np.empty(0, np.uint32), Forest(
+            np.empty(0, np.uint32), np.empty(0, np.uint32))
+    seq, _, m, parent, pst, _ = build_step(
+        jnp.asarray(tail), jnp.asarray(head), n)
+    m = int(m)
+    seq = np.asarray(seq)[:m].astype(np.uint32)
+    parent = np.asarray(parent)[:m].astype(np.int64)
+    out = np.full(m, INVALID_JNID, dtype=np.uint32)
+    live = parent < n  # parents of active nodes are active positions (< m)
+    out[live] = parent[live].astype(np.uint32)
+    return seq, Forest(out, np.asarray(pst)[:m].astype(np.uint32))

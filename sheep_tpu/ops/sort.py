@@ -1,0 +1,78 @@
+"""Device-side sequence engine: degree histogram + (degree, vid) sort.
+
+The reference's orders (lib/sequence.h): ascending degree with ascending-vid
+tie-break, computed from the undirected-doubled degree (each edge record
+counts both endpoints; self-loops count twice).  Every distributed variant
+sorts an identical replicated histogram (sequence.h:65-93), which is exactly
+how the mesh path works here too (psum the histogram, replicated sort —
+sheep_tpu.parallel).
+
+Shapes are static: the sequence is returned full-length over all n vid
+slots, with zero-degree vertices pushed to the tail via an infinite sort key
+(the reference drops them, graph_wrapper.h:97-100); ``num_active`` says how
+many leading entries are real.  Positions of zero-degree vids are INVALID.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+_I32_MAX = np.int32(np.iinfo(np.int32).max)
+
+
+@functools.partial(jax.jit, static_argnames=("n",))
+def degree_histogram(tail: jnp.ndarray, head: jnp.ndarray, n: int) -> jnp.ndarray:
+    """Undirected-doubled degrees (graph_wrapper.h:87-89 semantics)."""
+    deg = jnp.zeros(n, jnp.int32)
+    deg = deg.at[tail.astype(jnp.int32)].add(1)
+    deg = deg.at[head.astype(jnp.int32)].add(1)
+    return deg
+
+
+@jax.jit
+def degree_order(deg: jnp.ndarray):
+    """(seq, pos, num_active) from a dense degree histogram.
+
+    seq: int32 [n] — vids sorted by (degree asc, vid asc), zero-degree last.
+    pos: int32 [n] — vid -> sequence position; INVALID (=n) for zero-degree.
+    """
+    n = deg.shape[0]
+    vid = jnp.arange(n, dtype=jnp.int32)
+    key = jnp.where(deg > 0, deg.astype(jnp.int32), _I32_MAX)
+    _, seq = lax.sort((key, vid), num_keys=2)
+    pos_all = jnp.zeros(n, jnp.int32).at[seq].set(vid)
+    pos = jnp.where(deg > 0, pos_all, jnp.int32(n))
+    return seq, pos, jnp.sum(deg > 0).astype(jnp.int32)
+
+
+@functools.partial(jax.jit, static_argnames=("n",))
+def edge_links(tail: jnp.ndarray, head: jnp.ndarray, pos: jnp.ndarray, n: int):
+    """Map edge records to sentinel-padded (lo, hi) position links.
+
+    Self-loops become sentinels (excluded from the tree, jtree.cpp:48).
+    """
+    pt = pos[tail.astype(jnp.int32)]
+    ph = pos[head.astype(jnp.int32)]
+    lo = jnp.minimum(pt, ph)
+    hi = jnp.maximum(pt, ph)
+    dead = lo == hi
+    sent = jnp.int32(n)
+    return jnp.where(dead, sent, lo), jnp.where(dead, sent, hi)
+
+
+def degree_sequence_device(tail: np.ndarray, head: np.ndarray,
+                           num_vertices: int | None = None) -> np.ndarray:
+    """Host-facing: the reference's degreeSequence on device (active only)."""
+    n = num_vertices
+    if n is None:
+        n = int(max(tail.max(initial=0), head.max(initial=0))) + 1 if len(tail) else 0
+    if n == 0:
+        return np.empty(0, dtype=np.uint32)
+    deg = degree_histogram(jnp.asarray(tail), jnp.asarray(head), n)
+    seq, _, m = degree_order(deg)
+    return np.asarray(seq)[: int(m)].astype(np.uint32)

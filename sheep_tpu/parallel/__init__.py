@@ -1,0 +1,11 @@
+from .mesh import AXIS, make_mesh, edge_sharding, replicated
+from .build import distributed_build_step, build_graph_distributed
+
+__all__ = [
+    "AXIS",
+    "make_mesh",
+    "edge_sharding",
+    "replicated",
+    "distributed_build_step",
+    "build_graph_distributed",
+]

@@ -1,0 +1,36 @@
+"""Device mesh construction for the distributed build.
+
+The reference's distribution unit is an MPI rank owning an edge-disjoint
+partial graph (graph2tree.cpp:134-157).  Here the unit is a mesh axis
+``'workers'``: edge records are sharded along it, the degree histogram is
+psum-reduced across it (the MPI_Allreduce of lib/sequence.h:78), and the
+partial forests merge with an all_gather + associative rebuild (the
+MPI_Reduce custom op of lib/jnode.cpp:203-250).  Collectives ride ICI on a
+real slice; multi-host meshes extend over DCN via ``jax.distributed`` with
+the same code (XLA inserts the transport).
+"""
+
+from __future__ import annotations
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+AXIS = "workers"
+
+
+def make_mesh(num_workers: int | None = None) -> Mesh:
+    devices = jax.devices()
+    if num_workers is None:
+        num_workers = len(devices)
+    if num_workers > len(devices):
+        raise ValueError(
+            f"requested {num_workers} workers but only {len(devices)} devices")
+    return Mesh(devices[:num_workers], (AXIS,))
+
+
+def edge_sharding(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P(AXIS))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())

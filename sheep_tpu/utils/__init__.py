@@ -1,0 +1,3 @@
+from .synth import rmat_edges
+
+__all__ = ["rmat_edges"]

@@ -1,0 +1,27 @@
+"""Synthetic graph generators for benchmarks and stress tests.
+
+R-MAT / Graph500-style Kronecker edges (the reference's ``.dat`` XS1 format
+is "XS1/Graph500 binary", lib/readerwriter.h:36-40, and BASELINE.json's
+config 5 is a scale-26 Kronecker) — power-law degree structure comparable to
+the twitter/uk web graphs the reference benchmarks on.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def rmat_edges(log_n: int, num_edges: int, seed: int = 0,
+               a: float = 0.57, b: float = 0.19, c: float = 0.19
+               ) -> tuple[np.ndarray, np.ndarray]:
+    """R-MAT edge records (tail, head) uint32 over 2**log_n vid slots."""
+    rng = np.random.default_rng(seed)
+    tail = np.zeros(num_edges, dtype=np.uint32)
+    head = np.zeros(num_edges, dtype=np.uint32)
+    for bit in range(log_n):
+        u = rng.random(num_edges)
+        tbit = u >= (a + b)
+        hbit = ((u >= a) & (u < a + b)) | (u >= a + b + c)
+        tail |= tbit.astype(np.uint32) << np.uint32(bit)
+        head |= hbit.astype(np.uint32) << np.uint32(bit)
+    return tail, head

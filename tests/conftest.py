@@ -3,12 +3,18 @@ import os
 # Force a virtual 8-device CPU mesh for all tests: multi-chip sharding code
 # must compile and run without TPU hardware (the driver validates the real
 # multi-chip path separately via __graft_entry__.dryrun_multichip).
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# A sitecustomize may have force-registered a hardware PJRT plugin and set
+# jax_platforms programmatically, so overriding the env var alone is not
+# enough — override the live config too, before backends initialize.
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
 
 import numpy as np
 import pytest

@@ -1,0 +1,170 @@
+"""Device kernels (sheep_tpu.ops) == sequential oracle (sheep_tpu.core).
+
+The batched fixpoint formulation must produce the *identical* parent array
+to the reference's sequential union-find insert loop on every input — this
+is SURVEY §7's "hard part #1", tested here on adversarial shapes (stars and
+paths exercise the chain/jump rewrites), random multigraphs with self-loops,
+and the bundled hep-th graph.
+"""
+
+import numpy as np
+import pytest
+
+from conftest import random_multigraph
+
+from sheep_tpu.core import (
+    build_forest, degree_sequence, merge_forests, edges_to_positions,
+)
+from sheep_tpu.core.forest import build_forest_links
+from sheep_tpu.ops import (
+    build_forest_device, degree_sequence_device, merge_forests_device,
+    build_graph_device, forest_fixpoint,
+)
+
+
+def assert_forest_equal(got, want, msg=""):
+    np.testing.assert_array_equal(got.parent, want.parent, err_msg=msg)
+    np.testing.assert_array_equal(got.pst_weight, want.pst_weight, err_msg=msg)
+
+
+def both_forests(tail, head):
+    seq = degree_sequence(tail, head)
+    want = build_forest(tail, head, seq)
+    got = build_forest_device(tail, head, seq)
+    return got, want
+
+
+# --- adversarial structures -------------------------------------------------
+
+def test_star_center_first():
+    # Center eliminated first => elimination tree is a path: the worst case
+    # for naive parallel union-find, handled by the star->chain rewrite.
+    n = 64
+    tail = np.zeros(n - 1, dtype=np.uint32)
+    head = np.arange(1, n, dtype=np.uint32)
+    seq = np.arange(n, dtype=np.uint32)  # identity order: center at pos 0
+    want = build_forest(tail, head, seq)
+    got = build_forest_device(tail, head, seq)
+    assert_forest_equal(got, want)
+    # depth-n chain must not take ~n rounds
+    lo, hi = edges_to_positions(tail, head, seq)
+    import jax.numpy as jnp
+    _, rounds = forest_fixpoint(jnp.asarray(lo, jnp.int32),
+                                jnp.asarray(hi, jnp.int32), n)
+    assert int(rounds) < 20, f"star took {int(rounds)} rounds"
+
+
+def test_path_graph():
+    n = 100
+    tail = np.arange(n - 1, dtype=np.uint32)
+    head = np.arange(1, n, dtype=np.uint32)
+    assert_forest_equal(*both_forests(tail, head))
+
+
+def test_complete_graph():
+    n = 24
+    tail, head = np.triu_indices(n, k=1)
+    assert_forest_equal(*both_forests(tail.astype(np.uint32),
+                                      head.astype(np.uint32)))
+
+
+def test_crossing_links_counterexample():
+    # The case that breaks naive batched min-attach: link (1,4)'s root lags
+    # behind while (3,5) would commit parent[3]=5; truth is parent[3]=4.
+    seq = np.arange(6, dtype=np.uint32)
+    tail = np.array([1, 2, 1, 3], dtype=np.uint32)
+    head = np.array([2, 3, 4, 5], dtype=np.uint32)
+    want = build_forest(tail, head, seq)
+    got = build_forest_device(tail, head, seq)
+    assert want.parent[3] == 4
+    assert_forest_equal(got, want)
+
+
+def test_binary_staircase():
+    # Nested components merging at every scale.
+    rng = np.random.default_rng(7)
+    n = 128
+    edges = []
+    for width in (2, 4, 8, 16, 32, 64, 128):
+        for s in range(0, n, width):
+            edges.append((s, s + width - 1))
+    tail = np.array([a for a, _ in edges], dtype=np.uint32)
+    head = np.array([b for _, b in edges], dtype=np.uint32)
+    assert_forest_equal(*both_forests(tail, head))
+
+
+# --- randomized equivalence -------------------------------------------------
+
+@pytest.mark.parametrize("trial", range(40))
+def test_random_multigraph_device_equals_oracle(trial):
+    rng = np.random.default_rng(1000 + trial)
+    tail, head = random_multigraph(rng)
+    assert_forest_equal(*both_forests(tail, head), msg=f"trial {trial}")
+
+
+@pytest.mark.parametrize("trial", range(10))
+def test_random_identity_sequence(trial):
+    # Non-degree orders must work too (fileSequence / -s flag paths).
+    rng = np.random.default_rng(2000 + trial)
+    tail, head = random_multigraph(rng, n_max=60, e_max=200)
+    n = int(max(tail.max(), head.max())) + 1
+    seq = rng.permutation(n).astype(np.uint32)
+    want = build_forest(tail, head, seq)
+    got = build_forest_device(tail, head, seq)
+    assert_forest_equal(got, want, msg=f"trial {trial}")
+
+
+# --- device sequence --------------------------------------------------------
+
+@pytest.mark.parametrize("trial", range(15))
+def test_degree_sequence_device(trial):
+    rng = np.random.default_rng(3000 + trial)
+    tail, head = random_multigraph(rng)
+    np.testing.assert_array_equal(
+        degree_sequence_device(tail, head), degree_sequence(tail, head))
+
+
+def test_fused_build_matches_pipeline():
+    rng = np.random.default_rng(42)
+    tail, head = random_multigraph(rng, n_max=80, e_max=400)
+    seq, forest = build_graph_device(tail, head)
+    want_seq = degree_sequence(tail, head)
+    np.testing.assert_array_equal(seq, want_seq)
+    assert_forest_equal(forest, build_forest(tail, head, want_seq))
+
+
+# --- device merge -----------------------------------------------------------
+
+@pytest.mark.parametrize("parts", [2, 3, 8])
+def test_merge_device_equals_oracle(parts):
+    rng = np.random.default_rng(500 + parts)
+    tail, head = random_multigraph(rng, n_max=50, e_max=300)
+    seq = degree_sequence(tail, head)
+    cuts = np.linspace(0, len(tail), parts + 1).astype(int)
+    partials = [
+        build_forest(tail[a:b], head[a:b], seq, max_vid=int(max(tail.max(), head.max())))
+        for a, b in zip(cuts[:-1], cuts[1:])
+    ]
+    want = merge_forests(*partials)
+    got = merge_forests_device(*partials)
+    assert_forest_equal(got, want)
+    # and the merged tree equals the whole-graph tree
+    assert_forest_equal(got, build_forest(tail, head, seq))
+
+
+# --- hep-th golden ----------------------------------------------------------
+
+def test_hepth_device_equals_oracle(hep_edges):
+    seq = degree_sequence(hep_edges.tail, hep_edges.head)
+    want = build_forest(hep_edges.tail, hep_edges.head, seq)
+    got = build_forest_device(hep_edges.tail, hep_edges.head, seq)
+    assert_forest_equal(got, want)
+
+
+def test_hepth_fixpoint_rounds(hep_edges):
+    import jax.numpy as jnp
+    seq = degree_sequence(hep_edges.tail, hep_edges.head)
+    lo, hi = edges_to_positions(hep_edges.tail, hep_edges.head, seq)
+    _, rounds = forest_fixpoint(jnp.asarray(lo, jnp.int32),
+                                jnp.asarray(hi, jnp.int32), len(seq))
+    assert int(rounds) < 64, f"hep-th took {int(rounds)} fixpoint rounds"

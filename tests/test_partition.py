@@ -150,9 +150,8 @@ def test_forward_balance_invariant(seed):
     total = int(w.sum())
     max_component = int((total // 4) * opts.balance_factor)
     loads = np.bincount(jparts, weights=w)
-    # Every part except possibly the last-resort root bins stays within
-    # max_component; the algorithm guarantees each *bin* stays within.
-    assert (loads <= max_component).all() or total < 4
+    # The algorithm guarantees every bin stays within max_component.
+    assert (loads <= max_component).all()
 
 
 def test_partition_writers(tmp_path, hep_setup):

@@ -1,0 +1,7 @@
+"""CLI binaries mirroring the reference's flag surfaces (SURVEY §2.2).
+
+Each module is runnable via ``python -m sheep_tpu.cli.<name>`` and via the
+``bin/`` shims; flags, positional arguments, and the stdout phase grammar
+("Loaded graph in: %f seconds" etc., which the plot scripts grep) match
+graph2tree.cpp / partition_tree.cpp / degree_sequence.cpp / merge_trees.cpp.
+"""

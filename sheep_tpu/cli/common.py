@@ -1,0 +1,87 @@
+"""Shared CLI plumbing: phase timers (exact stdout grammar) + graph stats.
+
+The reference prints phase lines like ``Loaded graph in: 1.234000 seconds``
+(graph2tree.cpp:167,183,193,200,225,240, %f formatting) and the shell /
+plotting layer parses them (data/make-parallel.sh), so the grammar is API.
+Millisecond truncation matches std::chrono::duration_cast<milliseconds>.
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+
+def ensure_jax_platform() -> None:
+    """Honor JAX_PLATFORMS even when a sitecustomize force-registered a
+    hardware plugin and initialized the backend programmatically (in which
+    case the env var alone is ignored).  Call before any mesh work."""
+    import os
+
+    want = os.environ.get("JAX_PLATFORMS", "")
+    if not want:
+        return
+    import jax
+
+    # Never query the current backend here — that would *initialize* it,
+    # which on a tunneled hardware platform can block for a long time.
+    # Drop any already-initialized backends and pin the requested platform;
+    # the next jax use initializes it fresh.
+    try:
+        from jax.extend.backend import clear_backends
+        clear_backends()
+    except Exception:
+        pass
+    try:
+        jax.config.update("jax_platforms", want)
+    except Exception:
+        pass
+
+
+class PhaseClock:
+    """Elapsed-time phases with duration_cast<milliseconds> truncation."""
+
+    def __init__(self):
+        self.start = time.perf_counter()
+        self.last = 0.0  # total at the previous phase boundary, in ms
+
+    def _total_ms(self) -> int:
+        return int((time.perf_counter() - self.start) * 1000)
+
+    def phase_seconds(self) -> float:
+        """Seconds since the previous phase boundary."""
+        total = self._total_ms()
+        out = (total - self.last) / 1000.0
+        self.last = total
+        return out
+
+    def total_seconds(self) -> float:
+        return self._total_ms() / 1000.0
+
+
+def print_phase(label: str, seconds: float) -> None:
+    print(f"{label} in: {seconds:f} seconds", flush=True)
+
+
+def print_phase_ms(label: str, seconds: float) -> None:
+    """merge_trees/degree_sequence style: ``Loaded in: 12ms``."""
+    print(f"{label} in: {int(seconds * 1000)}ms", flush=True)
+
+
+def graph_stats(edges) -> tuple[int, int]:
+    """(nodes, edges) as the reference reports them: nodes = vertices with
+    nonzero degree (graph_wrapper.h:75-77), edges = file records
+    (max_edges/2 of the undirected-doubled graph, :79-81)."""
+    deg = edges.degrees()
+    return int((deg > 0).sum()), edges.num_edges
+
+
+def print_tree(seq: np.ndarray, parent: np.ndarray, pst: np.ndarray) -> None:
+    """``graph2tree -t`` / JTree::print grammar (lib/jtree.h:60-66,
+    lib/jnode.h print: width:w pre:pre pst:pst -> [parent])."""
+    for jnid in range(len(seq)):
+        width = 1 + int(pst[jnid])
+        print("%4d:%-8d%6d:w%6d:pre%6d:pst        ->[%4d]"
+              % (jnid, int(seq[jnid]), width, 0, int(pst[jnid]),
+                 int(np.uint32(parent[jnid]))))

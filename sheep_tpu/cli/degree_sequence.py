@@ -1,0 +1,32 @@
+"""degree_sequence: whole-graph streaming degree sort (degree_sequence.cpp).
+
+Streams the edge file without building adjacency (the reference's
+fileSequence, lib/sequence.h:95-128 — the out-of-memory path), writes the
+sequence, prints ``Sorted in: Nms``.
+"""
+
+from __future__ import annotations
+
+import sys
+
+from ..core.sequence import degree_sequence
+from ..io.edges import load_edges
+from ..io.seqfile import write_sequence
+from .common import PhaseClock, print_phase_ms
+
+
+def main(argv: list[str] | None = None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    if len(argv) != 2:
+        print("USAGE: degree_sequence graph_file output_file", end="")
+        return 1
+    clock = PhaseClock()
+    edges = load_edges(argv[0])
+    seq = degree_sequence(edges.tail, edges.head)
+    write_sequence(seq, argv[1])
+    print_phase_ms("Sorted", clock.total_seconds())
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,265 @@
+"""graph2tree: load graph -> sequence -> elimination forest [-> partition].
+
+Flag surface and control flow mirror graph2tree.cpp:44-246; the MPI switches
+are re-targeted at the TPU mesh (this is the one deliberate redesign):
+
+  -i / -r   In the reference these pick MPI collectives across ranks
+            (mpiSequence / mpi_merge).  Here either switch runs the fused
+            SPMD build over a ``jax.sharding.Mesh`` of all local devices in
+            ONE process — edge records are sharded over the 'workers' axis,
+            the degree sort is a psum'd histogram, and the tree reduce is an
+            all_gather + associative rebuild (sheep_tpu.parallel).  The
+            worker count is the device count (override: SHEEP_WORKERS).
+  -l n/k    partial file load for the multi-process file path (map-worker).
+
+Everything else is host-native: the C++ runtime (sheep_tpu.native) does the
+streaming insert and FFD partition exactly like the reference's serial path.
+"""
+
+from __future__ import annotations
+
+import getopt
+import os
+import sys
+
+import numpy as np
+
+from ..core.facts import compute_facts
+from ..core.forest import Forest, build_forest
+from ..core.sequence import degree_sequence
+from ..core.validate import is_valid_forest
+from ..io.edges import load_edges
+from ..io.seqfile import read_sequence, write_sequence
+from ..io.trefile import write_tree
+from ..partition.partition import Partition
+from .common import PhaseClock, graph_stats, print_phase, print_tree
+
+USAGE = "USAGE: graph2tree input_graph [options ...]"
+
+
+def main(argv: list[str] | None = None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    try:
+        opts, args = getopt.gnu_getopt(argv, "irl:p:s:o:vkejm:w:xfdtc")
+    except getopt.GetoptError as exc:
+        o = (exc.opt or "?")[:1]
+        if o in ("s", "o", "l"):
+            print(f"Option -{o} requires a string.")
+        elif o in ("m", "w", "p"):
+            print(f"Option -{o} requires a long long.")
+        else:
+            print(f"Unknown option character '{o}'.")
+        return 1
+
+    use_mesh_sort = use_mesh_reduce = False
+    part = num_parts = 0
+    partitions = 0
+    sequence_filename = ""
+    output_filename = ""
+    verbose = False
+    make_kids = make_pst = make_jxn = False
+    memory_limit = 0
+    width_limit = 0
+    find_max_width = False
+    do_faqs = do_print = do_validate = False
+
+    for o, a in opts:
+        if o == "-i":
+            use_mesh_sort = not use_mesh_sort
+        elif o == "-r":
+            use_mesh_reduce = not use_mesh_reduce
+        elif o == "-l":
+            part_s, num_s = a.split("/")
+            part, num_parts = int(part_s), int(num_s)
+        elif o == "-p":
+            partitions = int(a)
+        elif o == "-s":
+            sequence_filename = a
+        elif o == "-o":
+            output_filename = a
+        elif o == "-v":
+            verbose = not verbose
+        elif o == "-k":
+            make_kids = not make_kids
+        elif o == "-e":
+            make_pst = not make_pst
+        elif o == "-j":
+            make_jxn = not make_jxn
+        elif o == "-m":
+            memory_limit = int(a) * (1 << 20)
+        elif o == "-w":
+            width_limit = int(a)
+        elif o == "-x":
+            find_max_width = not find_max_width
+        elif o == "-f":
+            do_faqs = not do_faqs
+        elif o == "-t":
+            do_print = not do_print
+        elif o == "-c":
+            do_validate = not do_validate
+
+    if not args:
+        print(USAGE)
+        return 1
+    graph_filename = args[0]
+
+    clock = PhaseClock()
+    use_mesh = use_mesh_sort or use_mesh_reduce
+    is_leader = use_mesh or sequence_filename == ""
+
+    if verbose:
+        print(f"Loading {graph_filename}...")
+    edges = load_edges(graph_filename, part, num_parts) if not use_mesh \
+        else load_edges(graph_filename)
+    if verbose:
+        nodes, nedges = graph_stats(edges)
+        print(f"Nodes:{nodes} Edges:{nedges}")
+    if is_leader:
+        print_phase("Loaded graph", clock.phase_seconds())
+
+    jxn_mode = make_kids or make_pst or make_jxn or width_limit or \
+        find_max_width
+    widths = None
+
+    map_only = False
+    if use_mesh and jxn_mode:
+        # The kids/pst/jxn tables are a host-side feature (dynamic shapes;
+        # SURVEY §7); with -i/-r they run as the single-worker equivalent:
+        # device degree sort, then the host parameterized insert — matching
+        # a 1-rank MPI run of the reference with the same jopts.
+        from .common import ensure_jax_platform
+        ensure_jax_platform()
+        from ..core.jxn import JxnOptions, build_forest_jxn
+        from ..ops.sort import degree_sequence_device
+        if not use_mesh_sort and sequence_filename:
+            seq = read_sequence(sequence_filename)
+        else:
+            seq = degree_sequence_device(edges.tail, edges.head)
+            if use_mesh_sort and sequence_filename:
+                write_sequence(seq, sequence_filename)
+        if use_mesh_sort or sequence_filename == "":
+            print_phase("Sorted", clock.phase_seconds())
+        jopts = JxnOptions(make_kids=make_kids, make_pst=make_pst,
+                           make_jxn=make_jxn,
+                           memory_limit=memory_limit or (1 << 30),
+                           width_limit=width_limit,
+                           find_max_width=find_max_width)
+        forest, seq, widths = build_forest_jxn(
+            edges.tail, edges.head, seq, jopts)
+        print_phase("Mapped", clock.phase_seconds())
+        if use_mesh_reduce:
+            print_phase("Reduced", clock.phase_seconds())
+    elif use_mesh:
+        # Fused SPMD program over the device mesh: sort + map [+ reduce].
+        from .common import ensure_jax_platform
+        ensure_jax_platform()
+        import jax
+
+        from ..parallel.build import build_graph_distributed
+        # SHEEP_WORKERS (set by the scripts to $WORKERS) fixes the logical
+        # worker count; the mesh itself is capped by the device count — the
+        # merged result is identical for any mesh size.
+        workers = int(os.environ.get("SHEEP_WORKERS") or 0) \
+            or len(jax.devices())
+        mesh_workers = min(workers, len(jax.devices()))
+        given_seq = None
+        if not use_mesh_sort and sequence_filename:
+            given_seq = read_sequence(sequence_filename)
+        # -i without -r: save exactly `workers` partial trees for the
+        # file-path reduce tournament (reference rank-suffixed %02dr0.tre
+        # naming, graph2tree.cpp:146-149).  Partials are built host-side
+        # over contiguous record ranges — bit-identical to mesh shards.
+        map_only = (use_mesh_sort and not use_mesh_reduce
+                    and output_filename != "" and partitions == 0)
+        if map_only:
+            from ..io.edges import EdgeList, partial_range
+            from ..ops.sort import degree_sequence_device
+            seq = given_seq if given_seq is not None else \
+                degree_sequence_device(edges.tail, edges.head)
+            if use_mesh_sort and sequence_filename:
+                write_sequence(seq, sequence_filename)
+            if use_mesh_sort or sequence_filename == "":
+                print_phase("Sorted", clock.phase_seconds())
+            forest = None
+            max_vid = edges.max_vid
+            for w in range(workers):
+                a, b = partial_range(edges.num_edges, w + 1, workers)
+                f = build_forest(edges.tail[a:b], edges.head[a:b], seq,
+                                 max_vid=max_vid)
+                write_tree(f"{output_filename}{w:02d}r0.tre",
+                           f.parent, f.pst_weight)
+                if forest is None:
+                    # -f/-c/-t report worker 0's partial view, like the
+                    # reference's rank 0 with its partial graph load.
+                    forest = f
+                    a0, b0 = a, b
+            edges = EdgeList(edges.tail[a0:b0], edges.head[a0:b0],
+                             file_edges=edges.file_edges, start=a0)
+        else:
+            seq, forest = build_graph_distributed(
+                edges.tail, edges.head, num_workers=mesh_workers,
+                seq=given_seq)
+            if use_mesh_sort and sequence_filename:
+                write_sequence(seq, sequence_filename)
+            if use_mesh_sort or sequence_filename == "":
+                print_phase("Sorted", clock.phase_seconds())
+        print_phase("Mapped", clock.phase_seconds())
+        if use_mesh_reduce:
+            print_phase("Reduced", clock.phase_seconds())
+    else:
+        if sequence_filename:
+            seq = read_sequence(sequence_filename)
+        else:
+            seq = degree_sequence(edges.tail, edges.head)
+        if is_leader:
+            print_phase("Sorted", clock.phase_seconds())
+        if jxn_mode:
+            from ..core.jxn import JxnOptions, build_forest_jxn
+            jopts = JxnOptions(make_kids=make_kids, make_pst=make_pst,
+                               make_jxn=make_jxn,
+                               memory_limit=memory_limit or (1 << 30),
+                               width_limit=width_limit,
+                               find_max_width=find_max_width)
+            forest, seq, widths = build_forest_jxn(
+                edges.tail, edges.head, seq, jopts)
+        else:
+            forest = build_forest(edges.tail, edges.head, seq,
+                                  max_vid=edges.max_vid)
+        if is_leader:
+            print_phase("Mapped", clock.phase_seconds())
+
+    if partitions != 0:
+        p = Partition.from_forest(seq, forest, partitions,
+                                  max_vid=edges.max_vid)
+        if output_filename:
+            prefix = output_filename + ("-w0000-p" if use_mesh_reduce else "")
+            p.write_partitioned_graph(edges.tail, edges.head, seq, prefix,
+                                      max_vid=edges.max_vid)
+        elif is_leader:
+            p.print()
+    elif output_filename and not map_only:
+        # Serial fast path builds straight into the output file
+        # (graph2tree.cpp:185-188); with -r only the leader saves (:217-218).
+        write_tree(output_filename, forest.parent, forest.pst_weight)
+
+    if verbose:
+        print_phase("Built", clock.total_seconds())
+
+    if do_faqs:
+        compute_facts(forest, widths=widths).print()
+    if do_print:
+        print_tree(seq, forest.parent, forest.pst_weight)
+    if do_validate:
+        if is_valid_forest(forest, edges.tail, edges.head, seq,
+                           max_vid=edges.max_vid):
+            print("Tree is valid.")
+        else:
+            print("ERROR: Tree is not valid.")
+
+    if verbose:
+        print_phase("Finished", clock.total_seconds())
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

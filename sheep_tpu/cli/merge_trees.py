@@ -1,0 +1,63 @@
+"""merge_trees: associative pairwise merge of two .tre files
+(merge_trees.cpp:37-101).  ``Loaded in: Nms`` / ``Built in: Nms`` grammar.
+"""
+
+from __future__ import annotations
+
+import getopt
+import sys
+
+from ..core.facts import compute_facts
+from ..core.forest import Forest, merge_forests
+from ..io.trefile import read_tree, write_tree
+from .common import PhaseClock, print_phase_ms
+
+
+def main(argv: list[str] | None = None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    try:
+        opts, args = getopt.gnu_getopt(argv, "o:vkf")
+    except getopt.GetoptError as exc:
+        o = (exc.opt or "?")[:1]
+        if o == "o":
+            print(f"Option -{o} requires a string.")
+        else:
+            print(f"Unknown option character '{o}'.")
+        return 1
+
+    output_filename = ""
+    verbose = False
+    do_faqs = False
+    for o, a in opts:
+        if o == "-o":
+            output_filename = a
+        elif o == "-v":
+            verbose = not verbose
+        elif o == "-k":
+            pass  # make_kids: kids are always derivable from parents here
+        elif o == "-f":
+            do_faqs = not do_faqs
+
+    if len(args) < 2:
+        print("USAGE: merge_trees [options ...] first.tree second.tree")
+        return 1
+
+    clock = PhaseClock()
+    lp, lw = read_tree(args[0])
+    rp, rw = read_tree(args[1])
+    if verbose:
+        print_phase_ms("Loaded", clock.phase_seconds())
+
+    merged = merge_forests(Forest(lp, lw), Forest(rp, rw))
+    if output_filename:
+        write_tree(output_filename, merged.parent, merged.pst_weight)
+    if verbose:
+        print_phase_ms("Built", clock.phase_seconds())
+
+    if do_faqs:
+        compute_facts(merged).print()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

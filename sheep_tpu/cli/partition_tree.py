@@ -1,0 +1,134 @@
+"""partition_tree: load a .tre + sequence, partition, evaluate or write.
+
+Flag surface and the three modes mirror partition_tree.cpp:40-171:
+partition-only print (no -g), partition+evaluate (-g), partition+write
+(-g -o).  When no weight flag is given, pst weights are the default
+(partition_tree.cpp:95-96).  One intended-behavior fix: the reference's
+partition-only loop re-reads ``argv[optind + 2]`` for every trailing parts
+argument (an evident indexing slip at :117); here each parts argument is
+honored.
+"""
+
+from __future__ import annotations
+
+import getopt
+import sys
+
+from ..core.facts import compute_facts
+from ..core.forest import Forest
+from ..core.sequence import degree_sequence
+from ..io.edges import load_edges
+from ..io.seqfile import read_sequence
+from ..io.trefile import read_tree
+from ..partition.evaluate import evaluate_partition
+from ..partition.partition import Partition
+from ..partition.tree_partition import TreePartitionOptions
+from .common import PhaseClock, print_phase
+
+USAGE = "USAGE: partition_tree [options] input_sequence input_tree parts [parts...]"
+
+
+def main(argv: list[str] | None = None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    try:
+        opts, args = getopt.gnu_getopt(argv, "vfb:xdug:o:")
+    except getopt.GetoptError as exc:
+        o = (exc.opt or "?")[:1]
+        if o == "b":
+            print(f"Option -{o} requires a double.")
+        elif o in ("g", "o"):
+            print(f"Option -{o} requires a string.")
+        else:
+            print(f"Unknown option character '{o}'.")
+        return 1
+
+    verbose = True
+    do_faqs = False
+    balance_factor = 1.03
+    vtx_weight = pst_weight = pre_weight = False
+    graph_filename = ""
+    output_filename = ""
+
+    for o, a in opts:
+        if o == "-v":
+            verbose = not verbose
+        elif o == "-f":
+            do_faqs = not do_faqs
+        elif o == "-b":
+            balance_factor = float(a)
+        elif o == "-x":
+            vtx_weight = True
+        elif o == "-d":
+            pst_weight = True
+        elif o == "-u":
+            pre_weight = True
+        elif o == "-g":
+            graph_filename = a
+        elif o == "-o":
+            output_filename = a
+
+    if not (vtx_weight or pst_weight or pre_weight):
+        pst_weight = True
+    popts = TreePartitionOptions(balance_factor=balance_factor,
+                                 vtx_weight=vtx_weight,
+                                 pst_weight=pst_weight,
+                                 pre_weight=pre_weight)
+
+    if len(args) < 3:
+        print(USAGE)
+        return 1
+    sequence_filename, tree_filename = args[0], args[1]
+
+    clock = PhaseClock()
+    parent, pst = read_tree(tree_filename)
+    forest = Forest(parent, pst)
+    if verbose:
+        print_phase("Loaded tree", clock.phase_seconds())
+    if do_faqs:
+        compute_facts(forest).print()
+
+    if graph_filename == "":
+        # Partition-only print
+        seq = read_sequence(sequence_filename)
+        for parts_arg in args[2:]:
+            num_parts = int(parts_arg)
+            part = Partition.from_forest(seq, forest, num_parts, popts)
+            part.print()
+    elif output_filename == "":
+        # Partition and evaluate
+        edges = load_edges(graph_filename)
+        seq = degree_sequence(edges.tail, edges.head) \
+            if sequence_filename == "-" else read_sequence(sequence_filename)
+        for parts_arg in args[2:]:
+            num_parts = int(parts_arg)
+            pclock = PhaseClock()
+            part = Partition.from_forest(seq, forest, num_parts, popts,
+                                         max_vid=edges.max_vid)
+            if verbose:
+                print(f"Partitioning took: {pclock.phase_seconds():f} seconds")
+            part.print()
+            evaluate_partition(part.parts, edges.tail, edges.head, seq,
+                               num_parts, max_vid=edges.max_vid,
+                               file_edges=edges.num_edges).print()
+    else:
+        # Partition and write per-part edge files
+        edges = load_edges(graph_filename)
+        seq = degree_sequence(edges.tail, edges.head) \
+            if sequence_filename == "-" else read_sequence(sequence_filename)
+        num_parts = int(args[2])
+        pclock = PhaseClock()
+        part = Partition.from_forest(seq, forest, num_parts, popts,
+                                     max_vid=edges.max_vid)
+        if verbose:
+            print(f"Partitioning took: {pclock.phase_seconds():f} seconds")
+        part.print()
+        part.write_partitioned_graph(edges.tail, edges.head, seq,
+                                     output_filename, max_vid=edges.max_vid)
+
+    if verbose:
+        print_phase("Finished", clock.total_seconds())
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -56,15 +56,42 @@ class Forest:
 
 def edges_to_positions(tail: np.ndarray, head: np.ndarray, seq: np.ndarray,
                        max_vid: int | None = None) -> tuple[np.ndarray, np.ndarray]:
-    """Map edge records to (lo, hi) position pairs, dropping self-loops."""
+    """Map edge records to (lo, hi) position pairs, dropping self-loops.
+
+    Partial-sequence contract (mirrors the reference, where a neighbor never
+    appearing in the sequence stays "not yet inserted" forever and so counts
+    toward pst_weight, jtree.cpp:47-49): an edge with exactly one endpoint in
+    the sequence yields (lo = present position, hi = INVALID); both-absent
+    edges and self-loops are dropped.  Callers treat hi >= len(seq) as
+    "pst-only" — no tree link.
+    """
     pos = sequence_positions(seq, max_vid)
+    mx = int(max(tail.max(initial=0), head.max(initial=0))) if len(tail) else 0
+    if mx >= len(pos):  # vids beyond the position table are simply absent
+        pos = np.concatenate(
+            [pos, np.full(mx + 1 - len(pos), INVALID_JNID, np.uint32)])
     pt = pos[tail].astype(np.int64)
     ph = pos[head].astype(np.int64)
-    keep = pt != ph  # drops self-loops; position map is injective on seq
+    keep = pt != ph  # drops self-loops and both-absent (INVALID == INVALID)
     pt, ph = pt[keep], ph[keep]
     lo = np.minimum(pt, ph)
     hi = np.maximum(pt, ph)
     return lo, hi
+
+
+def native_or_none(impl: str):
+    """Resolve the ``impl`` dispatch: the native module, or None for the
+    python oracle.  "auto" prefers native when built; "native" requires it."""
+    if impl not in ("auto", "python", "native"):
+        raise ValueError(f"impl must be auto|python|native, got {impl!r}")
+    if impl == "python":
+        return None
+    from .. import native
+    if native.available():
+        return native
+    if impl == "native":
+        raise RuntimeError("native runtime unavailable (build failed?)")
+    return None
 
 
 def _find(uf: np.ndarray, x: int) -> int:
@@ -78,17 +105,27 @@ def _find(uf: np.ndarray, x: int) -> int:
 
 
 def build_forest_links(lo: np.ndarray, hi: np.ndarray, n: int,
-                       pst: np.ndarray | None = None) -> Forest:
+                       pst: np.ndarray | None = None,
+                       impl: str = "auto") -> Forest:
     """Build the elimination forest from links (lo -> hi), lo < hi elementwise.
 
     ``pst`` lets callers pass precomputed pst-weights (used by merge, where
     links are tree edges that must not be re-counted).  When None, each link
     contributes 1 to pst_weight[lo].
+
+    ``impl``: "auto" uses the C++ runtime when built (sheep_tpu.native),
+    "python" forces this module's loop (the oracle), "native" requires C++.
     """
+    native = native_or_none(impl)
+    if native is not None:
+        p, w = native.build_forest_links(lo, hi, n, pst)
+        return Forest(p, w)
     if pst is None:
         pst = np.bincount(lo, minlength=n).astype(np.uint32)
     parent = np.full(n, INVALID_JNID, dtype=np.uint32)
     uf = np.arange(n, dtype=np.int64)
+    linked = hi < n  # hi >= n marks pst-only links (absent endpoint)
+    lo, hi = lo[linked], hi[linked]
     order = np.argsort(hi, kind="stable")
     lo_s, hi_s = lo[order], hi[order]
     for i in range(len(lo_s)):
@@ -102,10 +139,16 @@ def build_forest_links(lo: np.ndarray, hi: np.ndarray, n: int,
 
 
 def build_forest(tail: np.ndarray, head: np.ndarray, seq: np.ndarray,
-                 max_vid: int | None = None) -> Forest:
+                 max_vid: int | None = None, impl: str = "auto") -> Forest:
     """Build from raw edge records over a (possibly partial) graph."""
+    native = native_or_none(impl)
+    if native is not None:
+        pos = sequence_positions(seq, max_vid)
+        lo, hi = native.edges_to_links(tail, head, pos)
+        p, w = native.build_forest_links(lo, hi, len(seq))
+        return Forest(p, w)
     lo, hi = edges_to_positions(tail, head, seq, max_vid)
-    return build_forest_links(lo, hi, len(seq))
+    return build_forest_links(lo, hi, len(seq), impl=impl)
 
 
 def forest_links(forest: Forest) -> tuple[np.ndarray, np.ndarray]:

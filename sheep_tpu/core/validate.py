@@ -36,6 +36,8 @@ def is_valid_forest(forest: Forest, tail: np.ndarray, head: np.ndarray,
         return False
 
     for l, h in zip(lo.tolist(), hi.tolist()):
+        if h >= n:
+            continue  # pst-only link: endpoint absent from the sequence
         cur = l
         steps = 0
         while cur < h:

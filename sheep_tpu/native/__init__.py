@@ -1,0 +1,130 @@
+"""ctypes bindings to the C++ host runtime (``libsheep_native.so``).
+
+The library is built on demand with ``make`` (g++ is part of the toolchain;
+pybind11 is not, so the ABI is plain C over caller-allocated numpy buffers).
+If the toolchain is unavailable the callers fall back to the numpy oracle —
+``available()`` reports which path is live.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+import numpy as np
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SO = os.path.join(_DIR, "libsheep_native.so")
+_lock = threading.Lock()
+_lib: ctypes.CDLL | None = None
+_tried = False
+
+_u32p = np.ctypeslib.ndpointer(np.uint32, flags="C_CONTIGUOUS")
+_i64p = np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS")
+_i32p = np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS")
+
+
+def _load() -> ctypes.CDLL | None:
+    global _lib, _tried
+    with _lock:
+        if _tried:
+            return _lib
+        _tried = True
+        src = os.path.join(_DIR, "src", "sheep_native.cpp")
+        stale = (os.path.exists(src) and os.path.exists(_SO)
+                 and os.path.getmtime(_SO) < os.path.getmtime(src))
+        if not os.path.exists(_SO) or stale:
+            try:
+                subprocess.run(
+                    ["make", "-C", _DIR], check=True,
+                    stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+            except (OSError, subprocess.CalledProcessError):
+                return None
+        try:
+            lib = ctypes.CDLL(_SO)
+        except OSError:
+            return None
+
+        lib.sheep_build_forest.restype = ctypes.c_int
+        lib.sheep_build_forest.argtypes = [
+            _u32p, _u32p, ctypes.c_int64, ctypes.c_int64,
+            ctypes.c_void_p, _u32p, _u32p]
+        lib.sheep_edges_to_links.restype = ctypes.c_int64
+        lib.sheep_edges_to_links.argtypes = [
+            _u32p, _u32p, ctypes.c_int64, _u32p, ctypes.c_int64, _u32p, _u32p]
+        lib.sheep_forward_partition.restype = ctypes.c_int64
+        lib.sheep_forward_partition.argtypes = [
+            _u32p, _i64p, ctypes.c_int64, ctypes.c_int64, _i32p]
+        lib.sheep_degree_histogram.restype = ctypes.c_int
+        lib.sheep_degree_histogram.argtypes = [
+            _u32p, _u32p, ctypes.c_int64, ctypes.c_int64, _i64p]
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def build_forest_links(lo: np.ndarray, hi: np.ndarray, n: int,
+                       pst: np.ndarray | None = None):
+    """Native elimination-forest build; returns (parent, pst) uint32 [n]."""
+    lib = _load()
+    assert lib is not None
+    lo = np.ascontiguousarray(lo, dtype=np.uint32)
+    hi = np.ascontiguousarray(hi, dtype=np.uint32)
+    parent = np.empty(n, dtype=np.uint32)
+    pst_out = np.empty(n, dtype=np.uint32)
+    pst_ptr = None
+    if pst is not None:
+        pst = np.ascontiguousarray(pst, dtype=np.uint32)
+        pst_ptr = pst.ctypes.data_as(ctypes.c_void_p)
+    rc = lib.sheep_build_forest(lo, hi, len(lo), n, pst_ptr, parent, pst_out)
+    if rc != 0:
+        raise RuntimeError(f"sheep_build_forest failed rc={rc}")
+    return parent, pst_out
+
+
+def edges_to_links(tail: np.ndarray, head: np.ndarray, pos: np.ndarray):
+    """Map edge records through a position table; drops self-loops and
+    absent vids.  Returns (lo, hi) uint32 arrays."""
+    lib = _load()
+    assert lib is not None
+    tail = np.ascontiguousarray(tail, dtype=np.uint32)
+    head = np.ascontiguousarray(head, dtype=np.uint32)
+    pos = np.ascontiguousarray(pos, dtype=np.uint32)
+    lo = np.empty(len(tail), dtype=np.uint32)
+    hi = np.empty(len(tail), dtype=np.uint32)
+    k = lib.sheep_edges_to_links(tail, head, len(tail), pos, len(pos), lo, hi)
+    return lo[:k], hi[:k]
+
+
+def forward_partition(parent: np.ndarray, weights: np.ndarray,
+                      max_component: int) -> np.ndarray:
+    """Native FFD tree partition; returns int32 part array."""
+    lib = _load()
+    assert lib is not None
+    parent = np.ascontiguousarray(parent, dtype=np.uint32)
+    weights = np.ascontiguousarray(weights, dtype=np.int64)
+    parts = np.empty(len(parent), dtype=np.int32)
+    rc = lib.sheep_forward_partition(parent, weights, len(parent),
+                                     max_component, parts)
+    if rc == -2:
+        raise ValueError(
+            f"max_component {max_component} smaller than the heaviest node; "
+            f"request fewer partitions or a larger balance factor")
+    if rc < 0:
+        raise RuntimeError(f"sheep_forward_partition failed rc={rc}")
+    return parts
+
+
+def degree_histogram(tail: np.ndarray, head: np.ndarray, n: int) -> np.ndarray:
+    lib = _load()
+    assert lib is not None
+    tail = np.ascontiguousarray(tail, dtype=np.uint32)
+    head = np.ascontiguousarray(head, dtype=np.uint32)
+    deg = np.empty(n, dtype=np.int64)
+    lib.sheep_degree_histogram(tail, head, len(tail), n, deg)
+    return deg

@@ -1,0 +1,207 @@
+// sheep_native: C++ host runtime for the hot sequential loops.
+//
+// The reference implements these in C++ (lib/jtree.cpp insert loop,
+// lib/unionfind.h find/unify, lib/jnode.cpp merge, lib/partition.cpp
+// forwardPartition).  The TPU framework keeps the same split: batched
+// fixed-shape work runs on device (sheep_tpu.ops), while the inherently
+// sequential pointer-chasing passes run here, vectorized over dense arrays
+// instead of the reference's per-object structures.
+//
+// API style: plain C functions over caller-allocated numpy buffers
+// (ctypes-friendly; no pybind11 in this toolchain).  All functions return 0
+// on success, negative on error.
+
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <algorithm>
+#include <vector>
+
+namespace {
+constexpr uint32_t kInvalid = 0xFFFFFFFFu;
+
+// Path-halving find over a flat uint32 union-find array whose representative
+// is the *max-position* element of each component (the later-in-sequence
+// vertex survives, mirroring lib/unionfind.h:82-102 unify(lesser, greater)).
+static inline uint32_t uf_find(uint32_t* uf, uint32_t x) {
+  while (uf[x] != x) {
+    uf[x] = uf[uf[x]];
+    x = uf[x];
+  }
+  return x;
+}
+}  // namespace
+
+extern "C" {
+
+// Build the elimination forest from links (lo -> hi), lo < hi elementwise,
+// in ascending-hi order — the exact sequential semantics of the reference's
+// streaming insert (lib/jtree.cpp:34-55: each earlier root is adopted by the
+// later endpoint).  Links are grouped by hi with a counting sort, so the
+// cost is O(m + n) plus near-O(1) amortized finds.
+//
+//   lo, hi     [m]  uint32 sequence positions; lo must be < n; hi >= n marks
+//              a "pst-only" link (edge to a vertex absent from the sequence,
+//              which counts toward pst but never forms a tree edge — the
+//              reference's forever-uninserted neighbor, jtree.cpp:47-49)
+//   pst_in     [n]  uint32 or NULL; when NULL each link adds 1 to pst[lo]
+//   parent_out [n]  uint32, kInvalid for roots
+//   pst_out    [n]  uint32
+//   scratch: internally allocates ~ (m + 2n) * 4 bytes.
+int sheep_build_forest(const uint32_t* lo, const uint32_t* hi, int64_t m,
+                       int64_t n, const uint32_t* pst_in,
+                       uint32_t* parent_out, uint32_t* pst_out) {
+  if (n < 0 || m < 0) return -1;
+  for (int64_t i = 0; i < m; ++i)
+    if (lo[i] >= (uint64_t)n) return -3;  // malformed link
+  if (pst_in) {
+    std::memcpy(pst_out, pst_in, sizeof(uint32_t) * (size_t)n);
+  } else {
+    std::memset(pst_out, 0, sizeof(uint32_t) * (size_t)n);
+    for (int64_t i = 0; i < m; ++i) ++pst_out[lo[i]];
+  }
+
+  // Counting sort of lo values grouped by hi; pst-only links are excluded.
+  std::vector<int64_t> offs((size_t)n + 1, 0);
+  for (int64_t i = 0; i < m; ++i)
+    if (hi[i] < (uint64_t)n) ++offs[hi[i] + 1];
+  for (int64_t h = 0; h < n; ++h) offs[h + 1] += offs[h];
+  int64_t linked = offs[n];
+  std::vector<uint32_t> lo_by_hi((size_t)linked);
+  {
+    std::vector<int64_t> cur(offs.begin(), offs.end() - 1);
+    for (int64_t i = 0; i < m; ++i)
+      if (hi[i] < (uint64_t)n) lo_by_hi[(size_t)cur[hi[i]]++] = lo[i];
+  }
+
+  for (int64_t v = 0; v < n; ++v) parent_out[v] = kInvalid;
+  std::vector<uint32_t> uf((size_t)n);
+  for (int64_t v = 0; v < n; ++v) uf[(size_t)v] = (uint32_t)v;
+
+  for (int64_t h = 0; h < n; ++h) {
+    const uint32_t hh = (uint32_t)h;
+    for (int64_t i = offs[h]; i < offs[h + 1]; ++i) {
+      uint32_t r = uf_find(uf.data(), lo_by_hi[(size_t)i]);
+      if (r != hh) {
+        parent_out[r] = hh;  // adopt: lib/jnode.h:158-162
+        uf[r] = hh;
+      }
+    }
+  }
+  return 0;
+}
+
+// Map raw edge records to links through a vid->position table.  A vid
+// beyond the table or mapped to kInvalid is absent from the sequence:
+// self-loops and both-absent edges are dropped; a one-absent edge becomes a
+// pst-only link (lo = present position, hi = kInvalid) so its pst count
+// survives, matching the reference's forever-uninserted neighbors.
+// Returns the number of links written (<= m).
+int64_t sheep_edges_to_links(const uint32_t* tail, const uint32_t* head,
+                             int64_t m, const uint32_t* pos, int64_t pos_len,
+                             uint32_t* lo_out, uint32_t* hi_out) {
+  int64_t k = 0;
+  for (int64_t i = 0; i < m; ++i) {
+    uint32_t pt = tail[i] < (uint64_t)pos_len ? pos[tail[i]] : kInvalid;
+    uint32_t ph = head[i] < (uint64_t)pos_len ? pos[head[i]] : kInvalid;
+    if (pt == ph) continue;  // self-loop or both absent
+    lo_out[k] = pt < ph ? pt : ph;
+    hi_out[k] = pt < ph ? ph : pt;
+    ++k;
+  }
+  return k;
+}
+
+// forwardPartition (lib/partition.cpp:86-157): ascending pass accumulating
+// component_below with first-fit-decreasing bin packing of overweight
+// subtrees, then a descending pass inheriting parts from parents and packing
+// leftover roots from the most-recent bin backwards.  Kid sorts use a stable
+// descending-weight order with ascending-jnid tie break (the reference's
+// std::sort is unstable there; see SURVEY.md §7 determinism note).
+//
+//   parent   [n] uint32 (kInvalid roots)
+//   weights  [n] int64 node weights
+//   parts_out[n] int32, filled 0..num_parts-1
+// Returns number of bins opened, or negative on error (-2: a single node
+// outweighs max_component, which would loop forever in the reference).
+int64_t sheep_forward_partition(const uint32_t* parent, const int64_t* weights,
+                                int64_t n, int64_t max_component,
+                                int32_t* parts_out) {
+  constexpr int32_t kNoPart = -1;
+  std::vector<int64_t> component_below(weights, weights + n);
+  for (int64_t i = 0; i < n; ++i) {
+    if (weights[i] > max_component) return -2;
+    parts_out[i] = kNoPart;
+  }
+
+  // kids grouped by parent in ascending-jnid order (counting sort).
+  std::vector<int64_t> koffs((size_t)n + 1, 0);
+  for (int64_t i = 0; i < n; ++i)
+    if (parent[i] != kInvalid) ++koffs[parent[i] + 1];
+  for (int64_t v = 0; v < n; ++v) koffs[v + 1] += koffs[v];
+  std::vector<uint32_t> kids((size_t)koffs[n]);
+  {
+    std::vector<int64_t> cur(koffs.begin(), koffs.end() - 1);
+    for (int64_t i = 0; i < n; ++i)
+      if (parent[i] != kInvalid) kids[(size_t)cur[parent[i]]++] = (uint32_t)i;
+  }
+
+  std::vector<int64_t> part_size;
+  std::vector<uint32_t> ks;
+  for (int64_t i = 0; i < n; ++i) {
+    if (component_below[i] > max_component) {
+      ks.assign(kids.begin() + koffs[i], kids.begin() + koffs[i + 1]);
+      std::stable_sort(ks.begin(), ks.end(),
+                       [&](uint32_t a, uint32_t b) {
+                         return component_below[a] > component_below[b];
+                       });
+      while (component_below[i] > max_component) {
+        for (uint32_t kid : ks) {
+          if (component_below[i] <= max_component) break;
+          if (parts_out[kid] != kNoPart) continue;
+          int64_t cb = component_below[kid];
+          for (size_t cur = 0; cur < part_size.size(); ++cur) {
+            if (part_size[cur] + cb <= max_component) {
+              component_below[i] -= cb;
+              part_size[cur] += cb;
+              parts_out[kid] = (int32_t)cur;
+              break;
+            }
+          }
+        }
+        if (component_below[i] > max_component) part_size.push_back(0);
+      }
+    }
+    if (parent[i] != kInvalid) component_below[parent[i]] += component_below[i];
+  }
+
+  for (int64_t i = n - 1; i >= 0; --i) {
+    if (parts_out[i] == kNoPart && parent[i] != kInvalid)
+      parts_out[i] = parts_out[parent[i]];
+    while (parts_out[i] == kNoPart) {
+      for (int64_t cur = (int64_t)part_size.size() - 1; cur >= 0; --cur) {
+        if (part_size[(size_t)cur] + component_below[i] <= max_component) {
+          part_size[(size_t)cur] += component_below[i];
+          parts_out[i] = (int32_t)cur;
+          break;
+        }
+      }
+      if (parts_out[i] == kNoPart) part_size.push_back(0);
+    }
+  }
+  return (int64_t)part_size.size();
+}
+
+// Per-vertex degree accumulation for the sequence sort: each record adds 1
+// to both endpoints (undirected-doubled semantics, graph_wrapper.h:87-89).
+int sheep_degree_histogram(const uint32_t* tail, const uint32_t* head,
+                           int64_t m, int64_t n, int64_t* deg_out) {
+  std::memset(deg_out, 0, sizeof(int64_t) * (size_t)n);
+  for (int64_t i = 0; i < m; ++i) {
+    ++deg_out[tail[i]];
+    ++deg_out[head[i]];
+  }
+  return 0;
+}
+
+}  // extern "C"

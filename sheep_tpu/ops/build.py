@@ -50,8 +50,7 @@ def build_graph_device(tail: np.ndarray, head: np.ndarray,
         jnp.asarray(tail), jnp.asarray(head), n)
     m = int(m)
     seq = np.asarray(seq)[:m].astype(np.uint32)
-    parent = np.asarray(parent)[:m].astype(np.int64)
-    out = np.full(m, INVALID_JNID, dtype=np.uint32)
-    live = parent < n  # parents of active nodes are active positions (< m)
-    out[live] = parent[live].astype(np.uint32)
-    return seq, Forest(out, np.asarray(pst)[:m].astype(np.uint32))
+    # Trimmed to the m active slots; parents of active nodes are active
+    # positions (< m), so the converter's n=m sentinel check is exact.
+    from .forest import _to_forest
+    return seq, _to_forest(np.asarray(parent)[:m], np.asarray(pst)[:m], m)

@@ -190,11 +190,14 @@ def build_forest_device(tail: np.ndarray, head: np.ndarray,
 
     lo, hi = edges_to_positions(tail, head, seq, max_vid)
     n = len(seq)
-    lo_d = jnp.asarray(lo, dtype=jnp.int32)
-    hi_d = jnp.asarray(hi, dtype=jnp.int32)
+    # pst-only links (hi = INVALID: edge to a vertex absent from the
+    # sequence) count toward pst but must be sentineled out of the fixpoint.
+    pst_d = pst_weights(jnp.asarray(lo, dtype=jnp.int32), n)
+    pst_only = hi >= n
+    lo_d = jnp.asarray(np.where(pst_only, n, lo), dtype=jnp.int32)
+    hi_d = jnp.asarray(np.where(pst_only, n, hi), dtype=jnp.int32)
     parent, _ = forest_fixpoint(lo_d, hi_d, n)
-    pst = pst_weights(lo_d, n)
-    return _to_forest(parent, pst, n)
+    return _to_forest(parent, pst_d, n)
 
 
 def merge_forests_device(*forests: Forest) -> Forest:

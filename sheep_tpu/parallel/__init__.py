@@ -1,5 +1,6 @@
 from .mesh import AXIS, make_mesh, edge_sharding, replicated
-from .build import distributed_build_step, build_graph_distributed
+from .build import (distributed_build_step, build_graph_distributed,
+                    map_graph_distributed)
 
 __all__ = [
     "AXIS",
@@ -8,4 +9,5 @@ __all__ = [
     "replicated",
     "distributed_build_step",
     "build_graph_distributed",
+    "map_graph_distributed",
 ]

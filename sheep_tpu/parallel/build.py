@@ -37,16 +37,34 @@ from ..ops.sort import degree_order
 from .mesh import AXIS, make_mesh
 
 
-def _sharded_build(tail, head, n: int):
-    """Per-shard body; runs under shard_map over the 'workers' axis."""
+def _sharded_build(tail, head, given_pos, n: int, do_merge: bool = True):
+    """Per-shard body; runs under shard_map over the 'workers' axis.
+
+    ``given_pos``: None computes the degree sequence on device (the `-i`
+    sort); otherwise a replicated vid->position table is used as-is (the
+    `-r`-without-`-i` case, where the sequence comes from a file).
+    ``do_merge``: False skips the reduce and returns per-worker partials
+    (the `-i`-without-`-r` case, whose trees feed the file-path tournament).
+    """
     sent = jnp.int32(n)
     t = tail.astype(jnp.int32)
     h = head.astype(jnp.int32)
 
     # --- distributed degree sort (mpiSequence) ---
-    deg_local = jnp.zeros(n + 1, jnp.int32).at[t].add(1).at[h].add(1)
-    deg = lax.psum(deg_local, AXIS)[:n]
-    seq, pos, m = degree_order(deg)  # replicated, identical on every worker
+    if given_pos is None:
+        deg_local = jnp.zeros(n + 1, jnp.int32).at[t].add(1).at[h].add(1)
+        deg = lax.psum(deg_local, AXIS)[:n]
+        seq, pos, m = degree_order(deg)  # replicated, identical per worker
+    else:
+        posi = given_pos.astype(jnp.int32)
+        # INVALID (0xFFFFFFFF) slots arrive as -1 after the int32 view.
+        absent = (posi < 0) | (posi >= n)
+        pos = jnp.where(absent, sent, posi)
+        seq = jnp.full(n, sent, jnp.int32)
+        vids = jnp.arange(n, dtype=jnp.int32)
+        # absent vids scatter out-of-bounds and are dropped
+        seq = seq.at[jnp.where(absent, n, pos)].set(vids, mode="drop")
+        m = jnp.int32(n) - jnp.sum(absent, dtype=jnp.int32)
 
     # --- map: local partial forest over the shared sequence ---
     pos_ext = jnp.concatenate([pos, jnp.full((1,), sent, jnp.int32)])
@@ -54,11 +72,21 @@ def _sharded_build(tail, head, n: int):
     ph = pos_ext[h]
     lo = jnp.minimum(pt, ph)
     hi = jnp.maximum(pt, ph)
-    dead = lo >= hi  # self-loops and phantom padding
+    # pst counts every edge whose earlier endpoint is present — including
+    # edges to absent vids (hi == sent), which never insert and so stay
+    # postorder forever (jtree.cpp:47-49).  Only self-loops / padding /
+    # both-absent (lo == hi) are excluded.
+    pst_local = pst_weights(jnp.where(lo == hi, sent, lo), n)
+    # The forest sees only fully-present links.
+    dead = (lo >= hi) | (hi >= sent)
     lo = jnp.where(dead, sent, lo)
     hi = jnp.where(dead, sent, hi)
-    parent_local, _ = forest_fixpoint(lo, hi, n)
-    pst_local = pst_weights(lo, n)
+    parent_local, map_rounds = forest_fixpoint(lo, hi, n)
+
+    if not do_merge:
+        parents = lax.all_gather(parent_local, AXIS)  # [W, n]
+        psts = lax.all_gather(pst_local, AXIS)
+        return seq, pos, m, parents, psts, lax.pmax(map_rounds, AXIS)
 
     # --- reduce: associative merge of the partial forests ---
     parents = lax.all_gather(parent_local, AXIS)  # [W, n]
@@ -71,16 +99,32 @@ def _sharded_build(tail, head, n: int):
     return seq, pos, m, parent, pst, rounds
 
 
-@functools.partial(jax.jit, static_argnames=("n", "mesh"))
-def distributed_build_step(tail: jnp.ndarray, head: jnp.ndarray, n: int, mesh):
+@functools.partial(jax.jit,
+                   static_argnames=("n", "mesh", "with_pos", "do_merge"))
+def distributed_build_step(tail: jnp.ndarray, head: jnp.ndarray, n: int, mesh,
+                           pos: jnp.ndarray | None = None,
+                           with_pos: bool = False, do_merge: bool = True):
     """Jitted SPMD build over `mesh`: edge shards in, replicated forest out.
 
     tail/head must have length divisible by the mesh size (pad with n).
     Returns (seq, pos, num_active, parent, pst, merge_rounds); ``parent[v]
-    == n`` marks roots, everything in full n-slot position space.
+    == n`` marks roots, everything in full n-slot position space.  With
+    ``do_merge=False`` parent/pst come back stacked [W, n] (per-worker
+    partials).  ``with_pos`` switches to an externally-given replicated
+    vid->position table instead of the on-device degree sort.
     """
+    body = functools.partial(_sharded_build, n=n, do_merge=do_merge)
+    if with_pos:
+        fn = shard_map(
+            lambda t, h, p: body(t, h, p),
+            mesh=mesh,
+            in_specs=(P(AXIS), P(AXIS), P()),
+            out_specs=(P(), P(), P(), P(), P(), P()),
+            check_vma=False,
+        )
+        return fn(tail, head, pos)
     fn = shard_map(
-        functools.partial(_sharded_build, n=n),
+        lambda t, h: body(t, h, None),
         mesh=mesh,
         in_specs=(P(AXIS), P(AXIS)),
         out_specs=(P(), P(), P(), P(), P(), P()),
@@ -93,30 +137,85 @@ def distributed_build_step(tail: jnp.ndarray, head: jnp.ndarray, n: int, mesh):
     return fn(tail, head)
 
 
-def build_graph_distributed(tail: np.ndarray, head: np.ndarray,
-                            num_vertices: int | None = None,
-                            num_workers: int | None = None):
-    """Host-facing distributed build: (seq uint32 [m], Forest over m)."""
-    mesh = make_mesh(num_workers)
-    w = mesh.size
-    n = num_vertices
-    if n is None:
-        n = int(max(tail.max(initial=0), head.max(initial=0))) + 1 if len(tail) else 0
-    if n == 0:
-        return np.empty(0, np.uint32), Forest(
-            np.empty(0, np.uint32), np.empty(0, np.uint32))
+def _pad_edges(tail, head, n, w):
     e = len(tail)
     e_pad = max(w, ((e + w - 1) // w) * w)
     t = np.full(e_pad, n, dtype=np.int64)
     h = np.full(e_pad, n, dtype=np.int64)
     t[:e] = tail
     h[:e] = head
-    seq, _, m, parent, pst, _ = distributed_build_step(
-        jnp.asarray(t, jnp.int32), jnp.asarray(h, jnp.int32), n, mesh)
-    m = int(m)
-    seq = np.asarray(seq)[:m].astype(np.uint32)
-    parent = np.asarray(parent)[:m].astype(np.int64)
-    out = np.full(m, INVALID_JNID, dtype=np.uint32)
-    live = parent < n
-    out[live] = parent[live].astype(np.uint32)
-    return seq, Forest(out, np.asarray(pst)[:m].astype(np.uint32))
+    return jnp.asarray(t, jnp.int32), jnp.asarray(h, jnp.int32)
+
+
+def _to_forest(parent, pst, n, m):
+    # Trim to the m active slots, then reuse the ops converter.  Passing
+    # n=m is sound: live parents of active nodes are themselves active
+    # positions (< m), and both the root sentinel n and any padding slot
+    # value are >= m, so they map to INVALID either way.
+    from ..ops.forest import _to_forest as ops_to_forest
+    return ops_to_forest(np.asarray(parent)[:m], np.asarray(pst)[:m], m)
+
+
+def _run_distributed(tail, head, num_vertices, num_workers, seq, do_merge):
+    """Shared prologue + dispatch for the host-facing wrappers.
+
+    Returns (out_seq, parent, pst, n, m, mesh_size) with parent/pst either
+    merged [n] or stacked [W, n] depending on ``do_merge``; n == 0 signals
+    the empty graph.
+    """
+    mesh = make_mesh(num_workers)
+    n = num_vertices
+    if n is None:
+        n = int(max(tail.max(initial=0), head.max(initial=0))) + 1 if len(tail) else 0
+    if seq is not None and len(seq):
+        n = max(n, int(seq.max()) + 1)
+    if n == 0:
+        return np.empty(0, np.uint32), None, None, 0, 0, mesh.size
+    t, h = _pad_edges(tail, head, n, mesh.size)
+    if seq is None:
+        dseq, _, m, parent, pst, _ = distributed_build_step(
+            t, h, n, mesh, do_merge=do_merge)
+        m = int(m)
+        out_seq = np.asarray(dseq)[:m].astype(np.uint32)
+    else:
+        from ..core.sequence import sequence_positions
+        pos = sequence_positions(seq, n - 1)
+        _, _, m, parent, pst, _ = distributed_build_step(
+            t, h, n, mesh, pos=jnp.asarray(pos.astype(np.int64), jnp.int32),
+            with_pos=True, do_merge=do_merge)
+        m = len(seq)
+        out_seq = np.asarray(seq, dtype=np.uint32)
+    return out_seq, parent, pst, n, m, mesh.size
+
+
+def build_graph_distributed(tail: np.ndarray, head: np.ndarray,
+                            num_vertices: int | None = None,
+                            num_workers: int | None = None,
+                            seq: np.ndarray | None = None):
+    """Host-facing distributed build: (seq uint32 [m], Forest over m).
+
+    ``seq``: an externally-given elimination order (the `-r`-without-`-i`
+    case); None runs the device degree sort.
+    """
+    out_seq, parent, pst, n, m, _ = _run_distributed(
+        tail, head, num_vertices, num_workers, seq, do_merge=True)
+    if n == 0:
+        return out_seq, Forest(np.empty(0, np.uint32), np.empty(0, np.uint32))
+    return out_seq, _to_forest(parent, pst, n, m)
+
+
+def map_graph_distributed(tail: np.ndarray, head: np.ndarray,
+                          num_vertices: int | None = None,
+                          num_workers: int | None = None,
+                          seq: np.ndarray | None = None):
+    """Map-only (`-i` without `-r`): per-worker partial forests, no merge.
+
+    Returns (seq uint32 [m], [Forest over m] * W) — each partial tree covers
+    the full vertex set over the shared sequence, ready for the file-path
+    merge tournament (reference graph2tree.cpp:148,158 rank-suffixed saves).
+    """
+    out_seq, parents, psts, n, m, w = _run_distributed(
+        tail, head, num_vertices, num_workers, seq, do_merge=False)
+    if n == 0:
+        return out_seq, []
+    return out_seq, [_to_forest(parents[i], psts[i], n, m) for i in range(w)]

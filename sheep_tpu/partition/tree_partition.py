@@ -244,10 +244,17 @@ _STRATEGIES = {
 def partition_forest(forest: Forest, num_parts: int,
                      opts: TreePartitionOptions | None = None,
                      strategy: str = "forward",
-                     pre: np.ndarray | None = None) -> np.ndarray:
+                     pre: np.ndarray | None = None,
+                     impl: str = "auto") -> np.ndarray:
     """jnid-indexed part assignment (lib/partition.cpp:50-61)."""
     opts = opts or TreePartitionOptions()
     weights = node_weights(forest, opts, pre)
     total = int(weights.sum())
     max_component = int((total // max(num_parts, 1)) * opts.balance_factor)
+    if strategy == "forward":
+        from ..core.forest import native_or_none
+        native = native_or_none(impl)
+        if native is not None:
+            return native.forward_partition(
+                forest.parent, weights, max_component).astype(np.int64)
     return _STRATEGIES[strategy](forest, max_component, weights)

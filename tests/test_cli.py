@@ -1,0 +1,116 @@
+"""CLI binaries + shell orchestration — subprocess integration tests.
+
+Uses the bundled hep-th graph (8361 verts / 15751 edges) as the de facto
+end-to-end smoke test, like the reference README:10-12.  Golden values:
+tree facts width 24 / 7610 verts (data/quality/hep.degree.raw) and the
+deterministic 2-part ECV(down) of this implementation's stable FFD.
+"""
+
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+HEP = os.path.join(REPO, "data", "hep-th.dat")
+BIN = os.path.join(REPO, "bin")
+
+pytestmark = pytest.mark.skipif(not os.path.exists(HEP),
+                                reason="hep-th.dat not bundled")
+
+
+def run_cli(args, timeout=600, env_extra=None):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"  # the host env may pin a hardware platform
+    flags = env.get("XLA_FLAGS", "")
+    if "host_platform_device_count" not in flags:
+        env["XLA_FLAGS"] = (flags +
+                            " --xla_force_host_platform_device_count=8").strip()
+    if env_extra:
+        env.update(env_extra)
+    proc = subprocess.run([sys.executable, "-m", f"sheep_tpu.cli.{args[0]}"]
+                          + args[1:], capture_output=True, text=True,
+                          timeout=timeout, env=env, cwd=REPO)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    return proc.stdout
+
+
+def test_degree_sequence_cli(tmp_path):
+    seq_path = str(tmp_path / "hep.seq")
+    out = run_cli(["degree_sequence", HEP, seq_path])
+    assert "Sorted in:" in out
+    from sheep_tpu.core.sequence import degree_sequence
+    from sheep_tpu.io import load_edges
+    from sheep_tpu.io.seqfile import read_sequence
+    edges = load_edges(HEP)
+    np.testing.assert_array_equal(read_sequence(seq_path),
+                                  degree_sequence(edges.tail, edges.head))
+
+
+def test_graph2tree_facts_validate(tmp_path):
+    tre = str(tmp_path / "hep.tre")
+    out = run_cli(["graph2tree", HEP, "-o", tre, "-f", "-c"])
+    assert "TREEFAQS: width:24" in out
+    assert "verts:7610" in out and "edges:15751" in out
+    assert "Tree is valid." in out
+    assert os.path.getsize(tre) == 4 + 8 * 7610
+
+
+def test_graph2tree_fast_partition_print():
+    out = run_cli(["graph2tree", HEP, "-p", "2"])
+    assert "Actually created 2 partitions." in out
+    assert "First two partition sizes: 3409 and 4201" in out
+
+
+def test_partition_tree_evaluate(tmp_path):
+    tre = str(tmp_path / "hep.tre")
+    seq = str(tmp_path / "hep.seq")
+    run_cli(["degree_sequence", HEP, seq])
+    run_cli(["graph2tree", HEP, "-s", seq, "-o", tre])
+    out = run_cli(["partition_tree", "-f", "-g", HEP, seq, tre, "2"])
+    assert "ECV(down): 521" in out
+    assert "Actually created 2 partitions." in out
+
+
+def test_merge_trees_equals_whole(tmp_path):
+    seq = str(tmp_path / "hep.seq")
+    run_cli(["degree_sequence", HEP, seq])
+    for part in (1, 2):
+        run_cli(["graph2tree", HEP, "-l", f"{part}/2", "-s", seq,
+                 "-o", str(tmp_path / f"p{part}.tre")])
+    run_cli(["graph2tree", HEP, "-s", seq, "-o", str(tmp_path / "whole.tre")])
+    run_cli(["merge_trees", str(tmp_path / "p1.tre"), str(tmp_path / "p2.tre"),
+             "-o", str(tmp_path / "merged.tre")])
+    whole = open(tmp_path / "whole.tre", "rb").read()
+    merged = open(tmp_path / "merged.tre", "rb").read()
+    assert whole == merged
+
+
+def test_graph2tree_jxn_mode():
+    out = run_cli(["graph2tree", HEP, "-k", "-e", "-j", "-f", "-c"])
+    assert "TREEFAQS: width:551" in out
+    assert "Tree is valid." in out
+
+
+def test_graph2tree_mesh_ir():
+    out = run_cli(["graph2tree", HEP, "-i", "-r", "-p", "2", "-f"],
+                  env_extra={"SHEEP_WORKERS": "8"})
+    assert "TREEFAQS: width:24" in out
+    assert "First two partition sizes: 3409 and 4201" in out
+    assert "Reduced in:" in out
+
+
+def test_dist_partition_script(tmp_path):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.run(
+        ["bash", os.path.join(REPO, "scripts", "dist-partition.sh"),
+         "-w", "2", "data/hep-th.dat", "2"],
+        capture_output=True, text=True, timeout=600, env=env, cwd=REPO)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "ECV(down): 521" in proc.stdout
+    assert "Mapped in" in proc.stdout and "Reduced in" in proc.stdout

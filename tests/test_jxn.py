@@ -1,0 +1,116 @@
+"""Treewidth/jxn mode (core.jxn) — semantics tests.
+
+Oracle for jxn correctness: after eliminating vertices in sequence order,
+``jxn(X)`` must equal the set of not-yet-eliminated vertices adjacent (in
+the fill graph) to the set eliminated at-or-below X's subtree — computed
+here by brute-force graph elimination on small random graphs.
+"""
+
+import numpy as np
+import pytest
+
+from sheep_tpu import INVALID_JNID
+from sheep_tpu.core.forest import build_forest
+from sheep_tpu.core.jxn import JxnOptions, build_jxn_tree
+from sheep_tpu.core.sequence import degree_sequence
+from sheep_tpu.core.validate import is_valid_forest
+
+from conftest import random_multigraph
+
+
+def brute_force_fill(tail, head, seq):
+    """Eliminate vertices in order; return per-position fill neighborhoods."""
+    n_vid = int(max(tail.max(initial=0), head.max(initial=0))) + 1
+    adj = {v: set() for v in range(n_vid)}
+    for t, h in zip(tail.tolist(), head.tolist()):
+        if t != h:
+            adj[t].add(h)
+            adj[h].add(t)
+    eliminated = set()
+    jxns = []
+    for v in seq.tolist():
+        nbrs = adj[v] - eliminated
+        jxns.append(sorted(nbrs))
+        # eliminate: connect remaining neighbors into a clique
+        for a in nbrs:
+            adj[a] |= nbrs - {a}
+            adj[a].discard(a)
+        eliminated.add(v)
+    return jxns
+
+
+@pytest.mark.parametrize("seed", range(10))
+def test_jxn_matches_brute_force_elimination(seed):
+    rng = np.random.default_rng(seed)
+    tail, head = random_multigraph(rng, n_max=30, e_max=90)
+    seq = degree_sequence(tail, head)
+    opts = JxnOptions(make_kids=True, make_pst=True, make_jxn=True)
+    tree = build_jxn_tree(tail, head, seq, opts)
+    expect = brute_force_fill(tail, head, seq)
+    assert len(tree.jxn) == len(expect)
+    for i, ref in enumerate(expect):
+        got = tree.jxn[i].tolist()
+        assert got == ref, f"jxn mismatch at position {i}"
+
+
+@pytest.mark.parametrize("seed", range(10))
+def test_jxn_forest_matches_default_path(seed):
+    """parent/pst arrays must be identical to the default fast path."""
+    rng = np.random.default_rng(100 + seed)
+    tail, head = random_multigraph(rng)
+    seq = degree_sequence(tail, head)
+    opts = JxnOptions(make_kids=True, make_pst=True, make_jxn=True)
+    tree = build_jxn_tree(tail, head, seq, opts)
+    ref = build_forest(tail, head, seq, impl="python")
+    np.testing.assert_array_equal(tree.forest.parent, ref.parent)
+    np.testing.assert_array_equal(tree.forest.pst_weight, ref.pst_weight)
+    np.testing.assert_array_equal(tree.seq, seq)
+
+
+@pytest.mark.parametrize("seed", range(8))
+def test_width_limit_defers_and_stays_valid(seed):
+    rng = np.random.default_rng(200 + seed)
+    tail, head = random_multigraph(rng, n_max=30, e_max=120,
+                                   self_loops=False)
+    seq = degree_sequence(tail, head)
+    opts = JxnOptions(make_kids=True, make_pst=True, make_jxn=True,
+                      width_limit=3)
+    tree = build_jxn_tree(tail, head, seq, opts)
+    # Same vertex set, possibly reordered; the tree must still satisfy the
+    # elimination invariant for its own effective sequence.
+    assert sorted(tree.seq.tolist()) == sorted(seq.tolist())
+    assert is_valid_forest(tree.forest, tail, head, tree.seq,
+                           max_vid=int(max(tail.max(), head.max())))
+    # Nodes inserted normally honor the limit; tail-chain nodes (whose jxn
+    # is exactly the trailing remaining-vertex set) are exempt, matching the
+    # reference where tail jxns are unbounded (jtree.cpp:182-186).
+    widths = tree.widths
+    for i in range(tree.forest.n):
+        is_tail = len(tree.jxn[i]) > 0 and \
+            set(tree.jxn[i].tolist()) == set(tree.seq[i + 1:].tolist())
+        if not is_tail:
+            assert widths[i] <= 1 + 3
+
+
+def test_find_max_width_stops_early():
+    rng = np.random.default_rng(7)
+    tail, head = random_multigraph(rng, n_max=25, e_max=60, self_loops=False)
+    seq = degree_sequence(tail, head)
+    full = build_jxn_tree(tail, head, seq,
+                          JxnOptions(make_kids=True, make_pst=True,
+                                     make_jxn=True))
+    early = build_jxn_tree(tail, head, seq,
+                           JxnOptions(make_kids=True, make_pst=True,
+                                      make_jxn=True, find_max_width=True))
+    # Early stop may truncate the tree but never exceeds the full size.
+    assert len(early.seq) <= len(full.seq)
+
+
+def test_memory_limit_enforced():
+    rng = np.random.default_rng(3)
+    tail, head = random_multigraph(rng, n_max=30, e_max=200)
+    seq = degree_sequence(tail, head)
+    with pytest.raises(MemoryError):
+        build_jxn_tree(tail, head, seq,
+                       JxnOptions(make_kids=True, make_pst=True,
+                                  make_jxn=True, memory_limit=8))

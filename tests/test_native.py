@@ -1,0 +1,116 @@
+"""Native C++ runtime vs the numpy/python oracle — exact equivalence."""
+
+import numpy as np
+import pytest
+
+from sheep_tpu import native, INVALID_JNID
+from sheep_tpu.core.forest import (
+    Forest, build_forest, build_forest_links, edges_to_positions,
+    merge_forests)
+from sheep_tpu.core.sequence import degree_sequence, sequence_positions
+from sheep_tpu.partition.tree_partition import (
+    TreePartitionOptions, forward_partition, node_weights, partition_forest)
+
+pytestmark = pytest.mark.skipif(not native.available(),
+                                reason="native runtime not built")
+
+
+def _rand_graph(rng, n, m):
+    tail = rng.integers(0, n, m).astype(np.uint32)
+    head = rng.integers(0, n, m).astype(np.uint32)
+    return tail, head
+
+
+@pytest.mark.parametrize("seed", range(8))
+def test_build_forest_matches_oracle(seed):
+    rng = np.random.default_rng(seed)
+    n = int(rng.integers(5, 200))
+    m = int(rng.integers(0, 4 * n))
+    tail, head = _rand_graph(rng, n, m)
+    seq = degree_sequence(tail, head)
+    ours = build_forest(tail, head, seq, impl="native")
+    oracle = build_forest(tail, head, seq, impl="python")
+    np.testing.assert_array_equal(ours.parent, oracle.parent)
+    np.testing.assert_array_equal(ours.pst_weight, oracle.pst_weight)
+
+
+def test_edges_to_links_matches_oracle():
+    rng = np.random.default_rng(3)
+    tail, head = _rand_graph(rng, 100, 400)
+    seq = degree_sequence(tail, head)
+    pos = sequence_positions(seq)
+    lo_n, hi_n = native.edges_to_links(tail, head, pos)
+    lo_o, hi_o = edges_to_positions(tail, head, seq)
+    # native preserves record order and so does the oracle
+    np.testing.assert_array_equal(lo_n.astype(np.int64), lo_o)
+    np.testing.assert_array_equal(hi_n.astype(np.int64), hi_o)
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_merge_matches_direct_build(seed):
+    """Partial builds + native merge == whole-graph build (associativity)."""
+    rng = np.random.default_rng(100 + seed)
+    n, m = 80, 300
+    tail, head = _rand_graph(rng, n, m)
+    seq = degree_sequence(tail, head)
+    k = int(rng.integers(2, 5))
+    cuts = np.linspace(0, m, k + 1).astype(int)
+    partials = [
+        build_forest(tail[a:b], head[a:b], seq, max_vid=n - 1, impl="native")
+        for a, b in zip(cuts[:-1], cuts[1:])
+    ]
+    merged = merge_forests(*partials)
+    whole = build_forest(tail, head, seq, max_vid=n - 1, impl="python")
+    np.testing.assert_array_equal(merged.parent, whole.parent)
+    np.testing.assert_array_equal(merged.pst_weight, whole.pst_weight)
+
+
+@pytest.mark.parametrize("seed", range(8))
+def test_forward_partition_matches_oracle(seed):
+    rng = np.random.default_rng(200 + seed)
+    n = int(rng.integers(10, 300))
+    m = int(rng.integers(n, 5 * n))
+    tail, head = _rand_graph(rng, n, m)
+    seq = degree_sequence(tail, head)
+    forest = build_forest(tail, head, seq, impl="python")
+    for np_ in (2, 3, 7):
+        ours = partition_forest(forest, np_, impl="native")
+        ref = partition_forest(forest, np_, impl="python")
+        np.testing.assert_array_equal(ours, ref)
+
+
+def test_partial_sequence_contract_matches():
+    """Edges to vids absent from seq count toward pst (never a link) — the
+    reference's forever-uninserted neighbor (jtree.cpp:47-49) — and all
+    implementations must agree, including vids beyond the position table."""
+    tail = np.array([0, 0, 1, 3, 5], dtype=np.uint32)
+    head = np.array([1, 2, 3, 3, 0], dtype=np.uint32)  # 2,5 absent; 3-3 loop
+    seq = np.array([0, 1, 3], dtype=np.uint32)
+    a = build_forest(tail, head, seq, max_vid=5, impl="python")
+    b = build_forest(tail, head, seq, max_vid=5, impl="native")
+    np.testing.assert_array_equal(a.parent, b.parent)
+    np.testing.assert_array_equal(a.pst_weight, b.pst_weight)
+    # 0-1 links; 0-2 pst-only; 1-3 links; 3-3 dropped; 5-0 pst-only at 0
+    np.testing.assert_array_equal(a.pst_weight, [3, 1, 0])
+    np.testing.assert_array_equal(a.parent, [1, 2, INVALID_JNID])
+    # max_vid understated: vids beyond the table are still "absent", not OOB
+    c = build_forest(tail, head, seq, max_vid=3, impl="python")
+    d = build_forest(tail, head, seq, max_vid=3, impl="native")
+    np.testing.assert_array_equal(c.pst_weight, a.pst_weight)
+    np.testing.assert_array_equal(d.pst_weight, a.pst_weight)
+
+
+def test_forward_partition_overweight_raises():
+    forest = Forest(np.array([1, INVALID_JNID], dtype=np.uint32),
+                    np.array([100, 1], dtype=np.uint32))
+    w = node_weights(forest, TreePartitionOptions())
+    with pytest.raises(ValueError):
+        native.forward_partition(forest.parent, w, 10)
+
+
+def test_degree_histogram():
+    rng = np.random.default_rng(5)
+    tail, head = _rand_graph(rng, 50, 200)
+    deg = native.degree_histogram(tail, head, 50)
+    ref = np.bincount(tail, minlength=50) + np.bincount(head, minlength=50)
+    np.testing.assert_array_equal(deg, ref.astype(np.int64))

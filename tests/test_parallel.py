@@ -56,6 +56,38 @@ def test_edges_fewer_than_workers():
     assert list(forest.pst_weight) == [1, 0]
 
 
+@pytest.mark.parametrize("workers", [1, 3, 8])
+def test_distributed_given_sequence(workers):
+    """`-r` without `-i`: an externally-given sequence, including one that
+    omits vertices (their edges count as pst of the present endpoint)."""
+    rng = np.random.default_rng(700 + workers)
+    tail, head = random_multigraph(rng, n_max=40, e_max=160)
+    full = degree_sequence(tail, head)
+    seq = full[: max(1, len(full) - 3)]  # drop the 3 highest-degree verts
+    got_seq, forest = build_graph_distributed(tail, head, seq=seq,
+                                              num_workers=workers)
+    np.testing.assert_array_equal(got_seq, seq)
+    want = build_forest(tail, head, seq,
+                        max_vid=int(max(tail.max(), head.max())),
+                        impl="python")
+    np.testing.assert_array_equal(forest.parent, want.parent)
+    np.testing.assert_array_equal(forest.pst_weight, want.pst_weight)
+
+
+def test_map_only_partials_merge_to_whole():
+    from sheep_tpu.core.forest import merge_forests
+    from sheep_tpu.parallel import map_graph_distributed
+
+    rng = np.random.default_rng(42)
+    tail, head = random_multigraph(rng, n_max=50, e_max=250)
+    seq, partials = map_graph_distributed(tail, head, num_workers=4)
+    assert len(partials) == 4
+    merged = merge_forests(*partials)
+    want = build_forest(tail, head, seq, impl="python")
+    np.testing.assert_array_equal(merged.parent, want.parent)
+    np.testing.assert_array_equal(merged.pst_weight, want.pst_weight)
+
+
 def test_hepth_distributed(hep_edges):
     seq, forest = build_graph_distributed(hep_edges.tail, hep_edges.head)
     want_seq = degree_sequence(hep_edges.tail, hep_edges.head)

@@ -37,6 +37,25 @@ from .common import PhaseClock, graph_stats, print_phase, print_tree
 USAGE = "USAGE: graph2tree input_graph [options ...]"
 
 
+def _make_jopts(make_kids, make_pst, make_jxn, memory_limit, width_limit,
+                find_max_width):
+    from ..core.jxn import JxnOptions
+    return JxnOptions(make_kids=make_kids, make_pst=make_pst,
+                      make_jxn=make_jxn,
+                      memory_limit=memory_limit or (1 << 30),
+                      width_limit=width_limit,
+                      find_max_width=find_max_width)
+
+
+def _finish_sort(seq, use_mesh_sort, sequence_filename, clock):
+    """Write the sequence when `-i -s` asked for it and emit the Sorted
+    phase line per the reference grammar (graph2tree.cpp:177-184)."""
+    if use_mesh_sort and sequence_filename:
+        write_sequence(seq, sequence_filename)
+    if use_mesh_sort or sequence_filename == "":
+        print_phase("Sorted", clock.phase_seconds())
+
+
 def main(argv: list[str] | None = None) -> int:
     argv = sys.argv[1:] if argv is None else argv
     try:
@@ -129,21 +148,15 @@ def main(argv: list[str] | None = None) -> int:
         # a 1-rank MPI run of the reference with the same jopts.
         from .common import ensure_jax_platform
         ensure_jax_platform()
-        from ..core.jxn import JxnOptions, build_forest_jxn
+        from ..core.jxn import build_forest_jxn
         from ..ops.sort import degree_sequence_device
         if not use_mesh_sort and sequence_filename:
             seq = read_sequence(sequence_filename)
         else:
             seq = degree_sequence_device(edges.tail, edges.head)
-            if use_mesh_sort and sequence_filename:
-                write_sequence(seq, sequence_filename)
-        if use_mesh_sort or sequence_filename == "":
-            print_phase("Sorted", clock.phase_seconds())
-        jopts = JxnOptions(make_kids=make_kids, make_pst=make_pst,
-                           make_jxn=make_jxn,
-                           memory_limit=memory_limit or (1 << 30),
-                           width_limit=width_limit,
-                           find_max_width=find_max_width)
+        _finish_sort(seq, use_mesh_sort, sequence_filename, clock)
+        jopts = _make_jopts(make_kids, make_pst, make_jxn, memory_limit,
+                            width_limit, find_max_width)
         forest, seq, widths = build_forest_jxn(
             edges.tail, edges.head, seq, jopts)
         print_phase("Mapped", clock.phase_seconds())
@@ -176,10 +189,7 @@ def main(argv: list[str] | None = None) -> int:
             from ..ops.sort import degree_sequence_device
             seq = given_seq if given_seq is not None else \
                 degree_sequence_device(edges.tail, edges.head)
-            if use_mesh_sort and sequence_filename:
-                write_sequence(seq, sequence_filename)
-            if use_mesh_sort or sequence_filename == "":
-                print_phase("Sorted", clock.phase_seconds())
+            _finish_sort(seq, use_mesh_sort, sequence_filename, clock)
             forest = None
             max_vid = edges.max_vid
             for w in range(workers):
@@ -199,10 +209,7 @@ def main(argv: list[str] | None = None) -> int:
             seq, forest = build_graph_distributed(
                 edges.tail, edges.head, num_workers=mesh_workers,
                 seq=given_seq)
-            if use_mesh_sort and sequence_filename:
-                write_sequence(seq, sequence_filename)
-            if use_mesh_sort or sequence_filename == "":
-                print_phase("Sorted", clock.phase_seconds())
+            _finish_sort(seq, use_mesh_sort, sequence_filename, clock)
         print_phase("Mapped", clock.phase_seconds())
         if use_mesh_reduce:
             print_phase("Reduced", clock.phase_seconds())
@@ -214,12 +221,9 @@ def main(argv: list[str] | None = None) -> int:
         if is_leader:
             print_phase("Sorted", clock.phase_seconds())
         if jxn_mode:
-            from ..core.jxn import JxnOptions, build_forest_jxn
-            jopts = JxnOptions(make_kids=make_kids, make_pst=make_pst,
-                               make_jxn=make_jxn,
-                               memory_limit=memory_limit or (1 << 30),
-                               width_limit=width_limit,
-                               find_max_width=find_max_width)
+            from ..core.jxn import build_forest_jxn
+            jopts = _make_jopts(make_kids, make_pst, make_jxn, memory_limit,
+                                width_limit, find_max_width)
             forest, seq, widths = build_forest_jxn(
                 edges.tail, edges.head, seq, jopts)
         else:

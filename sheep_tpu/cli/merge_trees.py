@@ -43,12 +43,14 @@ def main(argv: list[str] | None = None) -> int:
         return 1
 
     clock = PhaseClock()
-    lp, lw = read_tree(args[0])
-    rp, rw = read_tree(args[1])
+    # All positional trees merge in one associative pass (the reference
+    # takes exactly two, which silently pins the scripts' REDUCTION to 2;
+    # accepting k inputs makes any tournament fan-in correct).
+    inputs = [Forest(*read_tree(a)) for a in args]
     if verbose:
         print_phase_ms("Loaded", clock.phase_seconds())
 
-    merged = merge_forests(Forest(lp, lw), Forest(rp, rw))
+    merged = merge_forests(*inputs)
     if output_filename:
         write_tree(output_filename, merged.parent, merged.pst_weight)
     if verbose:

@@ -36,11 +36,20 @@ def _load() -> ctypes.CDLL | None:
         stale = (os.path.exists(src) and os.path.exists(_SO)
                  and os.path.getmtime(_SO) < os.path.getmtime(src))
         if not os.path.exists(_SO) or stale:
+            # Concurrent CLI processes may race to build: compile to a
+            # process-unique name and publish with an atomic rename.
+            tmp = f"{_SO}.{os.getpid()}.tmp"
             try:
                 subprocess.run(
-                    ["make", "-C", _DIR], check=True,
+                    ["make", "-C", _DIR, f"OUT={os.path.basename(tmp)}"],
+                    check=True,
                     stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+                os.replace(tmp, _SO)
             except (OSError, subprocess.CalledProcessError):
+                try:
+                    os.unlink(tmp)
+                except OSError:
+                    pass
                 return None
         try:
             lib = ctypes.CDLL(_SO)

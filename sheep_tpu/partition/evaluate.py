@@ -77,16 +77,22 @@ class EvalReport:
 
 
 def evaluate_partition(parts: np.ndarray, tail: np.ndarray, head: np.ndarray,
-                       seq: np.ndarray, num_parts: int,
+                       seq: np.ndarray | None, num_parts: int,
                        max_vid: int | None = None,
                        file_edges: int | None = None) -> EvalReport:
+    """``seq=None`` evaluates the sequence-free metrics only (the
+    reference's evaluate(graph) overload, partition.cpp:428-473); the
+    ECV(down)/(up) fields then come back zero — print with
+    ``with_seq=False``."""
     from ..core.sequence import sequence_positions
 
     parts = parts.astype(np.int64)
     t = tail.astype(np.int64)
     h = head.astype(np.int64)
     E = file_edges if file_edges is not None else len(t)
-    pos = sequence_positions(seq, max_vid).astype(np.int64)
+    pos = None
+    if seq is not None:
+        pos = sequence_positions(seq, max_vid).astype(np.int64)
 
     deg_mask = np.zeros(len(parts), dtype=bool)
     deg_mask[t] = True
@@ -127,14 +133,16 @@ def evaluate_partition(parts: np.ndarray, tail: np.ndarray, head: np.ndarray,
     hash_balance = int(np.bincount(und_hash_part, minlength=P).max(initial=0))
 
     # ECV(down)/(up): part of the earlier/later-in-sequence endpoint
-    posX = pos[X]
-    posY = pos[Y]
-    down_part = np.where(posX < posY, pX, pY)
-    up_part = np.where(posX > posY, pX, pY)
-    ecv_down = _nunique_pairs(X, down_part, P) - n_active
-    ecv_up = _nunique_pairs(X, up_part, P) - n_active
-    down_balance = int(np.bincount(pX[posX < posY], minlength=P).max(initial=0))
-    up_balance = int(np.bincount(pX[posX > posY], minlength=P).max(initial=0))
+    ecv_down = ecv_up = down_balance = up_balance = 0
+    if pos is not None:
+        posX = pos[X]
+        posY = pos[Y]
+        down_part = np.where(posX < posY, pX, pY)
+        up_part = np.where(posX > posY, pX, pY)
+        ecv_down = _nunique_pairs(X, down_part, P) - n_active
+        ecv_up = _nunique_pairs(X, up_part, P) - n_active
+        down_balance = int(np.bincount(pX[posX < posY], minlength=P).max(initial=0))
+        up_balance = int(np.bincount(pX[posX > posY], minlength=P).max(initial=0))
 
     vertex_balance = int(np.bincount(parts[active], minlength=P).max(initial=0))
 

@@ -40,7 +40,7 @@ class Partition:
     def from_file(cls, seq: np.ndarray, filename: str) -> "Partition":
         """jnid-indexed parts file -> vid-indexed (lib/partition.h:55-65)."""
         jparts = np.loadtxt(filename, dtype=np.int64, ndmin=1)
-        num_parts = int(jparts.max())
+        num_parts = int(jparts.max()) + 1
         n = (int(seq.max()) + 1) if len(seq) else 0
         vparts = np.full(n, INVALID_PART, dtype=np.int64)
         vparts[seq] = jparts[: len(seq)]

@@ -1,0 +1,137 @@
+"""Fennel partitioners + util analysis tools."""
+
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from conftest import random_multigraph
+
+from sheep_tpu import INVALID_PART
+from sheep_tpu.partition.evaluate import evaluate_partition
+from sheep_tpu.partition.fennel import fennel_edges, fennel_vertex
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+HEP = os.path.join(REPO, "data", "hep-th.dat")
+
+
+@pytest.mark.parametrize("seed", range(5))
+@pytest.mark.parametrize("num_parts", [2, 4])
+def test_fennel_vertex_valid_partition(seed, num_parts):
+    rng = np.random.default_rng(seed)
+    tail, head = random_multigraph(rng, n_max=50, e_max=200)
+    parts = fennel_vertex(tail, head, num_parts)
+    deg = np.bincount(tail, minlength=parts.size) + \
+        np.bincount(head, minlength=parts.size)
+    active = deg > 0
+    # every active vertex assigned, every inactive one INVALID
+    assert (parts[active] >= 0).all() and (parts[active] < num_parts).all()
+    assert (parts[~active] == INVALID_PART).all()
+
+
+def test_fennel_vertex_respects_capacity_mostly():
+    """With generous balance, no part exceeds the capacity bound."""
+    rng = np.random.default_rng(9)
+    tail, head = random_multigraph(rng, n_max=60, e_max=300,
+                                   self_loops=False)
+    num_parts = 3
+    parts = fennel_vertex(tail, head, num_parts, balance_factor=1.5)
+    deg = np.bincount(tail, minlength=parts.size) + \
+        np.bincount(head, minlength=parts.size)
+    cap = (2 * len(tail) // num_parts) * 1.5
+    for p in range(num_parts):
+        assert deg[parts == p].sum() <= cap + deg.max()
+
+
+def test_fennel_vertex_beats_random_on_edges_cut():
+    rng = np.random.default_rng(11)
+    tail, head = random_multigraph(rng, n_max=80, e_max=200,
+                                   self_loops=False)
+    parts_f = fennel_vertex(tail, head, 2)
+    n = parts_f.size
+    parts_r = rng.integers(0, 2, size=n)
+    cut_f = int((parts_f[tail] != parts_f[head]).sum())
+    cut_r = int((parts_r[tail] != parts_r[head]).sum())
+    assert cut_f <= cut_r
+
+
+def test_fennel_edges_valid():
+    rng = np.random.default_rng(21)
+    tail, head = random_multigraph(rng, n_max=40, e_max=150)
+    eparts = fennel_edges(tail, head, 3)
+    assert len(eparts) == len(tail)
+    assert (eparts >= 0).all() and (eparts < 3).all()
+    # roughly balanced under the hard cap
+    counts = np.bincount(eparts, minlength=3)
+    assert counts.max() <= (len(tail) // 3) * 1.03 + 1
+
+
+def test_evaluate_without_sequence():
+    rng = np.random.default_rng(31)
+    tail, head = random_multigraph(rng, n_max=30, e_max=100)
+    parts = fennel_vertex(tail, head, 2)
+    rep = evaluate_partition(parts, tail, head, None, 2)
+    assert rep.ecv_down == 0 and rep.ecv_up == 0
+    assert rep.edges_cut >= 0 and rep.vcom_vol >= 0
+
+
+def _run_tool(name, args):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    code = (f"import sys; from sheep_tpu.cli.tools import {name}; "
+            f"sys.exit({name}(sys.argv[1:]))")
+    proc = subprocess.run([sys.executable, "-c", code] + args,
+                          capture_output=True, text=True, timeout=600,
+                          env=env, cwd=REPO)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    return proc.stdout
+
+
+@pytest.mark.skipif(not os.path.exists(HEP), reason="hep-th.dat not bundled")
+def test_tools_end_to_end(tmp_path):
+    tre = str(tmp_path / "hep.tre")
+    seqf = str(tmp_path / "hep.seq")
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    subprocess.run([sys.executable, "-m", "sheep_tpu.cli.degree_sequence",
+                    HEP, seqf], check=True, env=env, cwd=REPO,
+                   capture_output=True)
+    subprocess.run([sys.executable, "-m", "sheep_tpu.cli.graph2tree", HEP,
+                    "-s", seqf, "-o", tre], check=True, env=env, cwd=REPO,
+                   capture_output=True)
+
+    dot = str(tmp_path / "hep.dot")
+    _run_tool("tree2dot", [tre, dot])
+    lines = open(dot).read().splitlines()
+    assert lines[0] == "digraph {" and lines[-1] == "}"
+    assert len(lines) == 7610 + 2
+
+    adj = str(tmp_path / "hep.adj")
+    _run_tool("tree2adj", [tre, adj])
+    first = open(adj).readline().split()
+    assert first == ["7610", "7029", "011"]  # 7610 - 581 roots = 7029 edges
+
+    gadj = str(tmp_path / "hepg.adj")
+    _run_tool("graph2adj", [HEP, gadj])
+    first = open(gadj).readline().split()
+    assert first == ["7610", "15751", "010"]
+
+    out = _run_tool("vfennel", [HEP, "2"])
+    assert "Actually created 2 partitions." in out
+    assert "edges cut:" in out and "ECV(hash):" in out
+    assert "ECV(down)" not in out  # sequence-free evaluation
+
+    # jnid partition file -> read_partition re-evaluation
+    pfile = str(tmp_path / "hep.part")
+    import numpy as np
+    from sheep_tpu.core.forest import Forest
+    from sheep_tpu.io.seqfile import read_sequence
+    from sheep_tpu.io.trefile import read_tree
+    from sheep_tpu.partition.tree_partition import partition_forest
+    parent, pst = read_tree(tre)
+    jparts = partition_forest(Forest(parent, pst), 2)
+    np.savetxt(pfile, jparts, fmt="%d")
+    out = _run_tool("read_partition", [HEP, pfile])
+    assert "ECV(down): 521" in out

@@ -25,6 +25,8 @@ _BASELINE_EDGES_PER_SEC = 1_468_364_884 / 18.7  # twitter map, 18 MPI ranks
 
 
 def main() -> None:
+    from sheep_tpu.cli.common import ensure_jax_platform
+    ensure_jax_platform()  # honor JAX_PLATFORMS even under a forced plugin
     import jax
     import jax.numpy as jnp
     from sheep_tpu.ops import build_step

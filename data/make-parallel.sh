@@ -1,0 +1,96 @@
+#!/bin/bash
+# Worker-scaling benchmark harness (reference data/make-parallel.sh):
+# runs dist-partition.sh over a worker sweep, grepping the phase-line
+# stdout grammar into NAME.raw / NAME.dat / NAME.avg tables (+ eps plot
+# when gnuplot is available).
+#
+#   make-parallel.sh [-m] [-p] [-t TRIALS] [-a] [-i] [-r] [-c CORES]
+#
+# Graphs default to the bundled hep-th; override with
+#   SHEEP_BENCH_GRAPHS="path1.dat path2.dat ..."
+#   SHEEP_BENCH_WORKERS="1 2 4 6 8"
+
+TRUE=0
+FALSE=1
+
+MAKE_DATA=$FALSE
+PLOT_DATA=$FALSE
+TRIALS=3
+VERTICAL=''
+MPI_SORT=''
+MPI_REDUCE=''
+CORES=''
+RDIR=${RDIR:-data/runtimes}
+
+while getopts "mpt:airc:" opt; do
+  case $opt in
+    m) MAKE_DATA=$TRUE;;
+    p) PLOT_DATA=$TRUE;;
+    t) TRIALS=$OPTARG;;
+    a) VERTICAL='-a';;
+    i) MPI_SORT='-i';;
+    r) MPI_REDUCE='-r';;
+    c) CORES="-c $OPTARG";;
+    :) echo "Option -$OPTARG requires an argument."; exit 1;;
+    \?) echo "Invalid option: -$OPTARG"; exit 1;;
+  esac
+done
+
+GRAPHS=( ${SHEEP_BENCH_GRAPHS:-data/hep-th.dat} )
+WORKER_LIST=( ${SHEEP_BENCH_WORKERS:-1 2 4 6} )
+
+if [ $MAKE_DATA -eq $TRUE ]; then
+  mkdir -p $RDIR
+
+  for G in ${GRAPHS[@]}; do
+    NAME=$(basename $G .dat)
+    RAW="${RDIR}/${NAME}.raw"
+    rm -f $RAW
+
+    for WORKERS in ${WORKER_LIST[@]}; do
+      for i in $(seq 1 $TRIALS); do
+        echo "Starting with $WORKERS workers..." | tee -a $RAW
+        scripts/dist-partition.sh $VERTICAL $MPI_SORT $MPI_REDUCE $CORES -w $WORKERS $G 0 | tee -a $RAW
+        echo | tee -a $RAW
+      done
+    done
+  done
+fi
+
+if [ $PLOT_DATA -eq $TRUE ]; then
+  RAW_DATA=( ${RDIR}/*.raw )
+  for RAW in ${RAW_DATA[@]}; do
+    NAME=$(basename $RAW .raw)
+
+    egrep "^Starting with[[:blank:]]" $RAW | egrep -o "[[:digit:]]+" > "/tmp/${NAME}.workers"
+    egrep "^Loaded graph[[:blank:]]" $RAW | egrep -o "[[:digit:]]*\.[[:digit:]]+" > "/tmp/${NAME}.load"
+    egrep "^Sorted[[:blank:]]" $RAW | egrep -o "[[:digit:]]*\.[[:digit:]]+" > "/tmp/${NAME}.sort"
+    egrep "^Mapped[[:blank:]]" $RAW | egrep -o "[[:digit:]]*\.[[:digit:]]+" > "/tmp/${NAME}.map"
+    egrep "^Reduced[[:blank:]]" $RAW | egrep -o "[[:digit:]]*\.[[:digit:]]+" > "/tmp/${NAME}.red"
+
+    paste /tmp/${NAME}.workers /tmp/${NAME}.load /tmp/${NAME}.sort /tmp/${NAME}.map /tmp/${NAME}.red > ${RDIR}/${NAME}.dat
+    rm -f /tmp/${NAME}.workers /tmp/${NAME}.load /tmp/${NAME}.sort /tmp/${NAME}.map /tmp/${NAME}.red
+
+    rm -f "${RDIR}/${NAME}.avg"
+    for W in $(awk '{print $1}' ${RDIR}/${NAME}.dat | sort -nu); do
+      echo -n "$W " >> "${RDIR}/${NAME}.avg"
+      egrep "^$W[[:blank:]]" ${RDIR}/${NAME}.dat | awk 'NR > 1' |
+          awk '{ls += $2; ss += $3; ms += $4; rs += $5} END {print ls/NR" "ss/NR" "ms/NR" "rs/NR}' >> "${RDIR}/${NAME}.avg"
+    done
+
+    if command -v gnuplot > /dev/null; then
+gnuplot <<EOF
+set terminal eps font 'Verdana,14'
+set output "${RDIR}/${NAME}.eps"
+set style data histograms
+set style histogram rowstacked
+set style fill solid 1.0 border -1
+set boxwidth 1 relative
+set xlabel "Workers"
+set ylabel "Seconds"
+plot "${RDIR}/${NAME}.avg" using 2:xtic(1) title "load", \
+     '' using 3 title "sort", '' using 4 title "map", '' using 5 title "reduce"
+EOF
+    fi
+  done
+fi

@@ -1,0 +1,28 @@
+#!/bin/bash
+# Partition-quality sweep (reference data/make-quality.sh): builds the tree
+# once, then evaluates a parts sweep with partition_tree, grepping the
+# evaluator grammar into NAME.quality tables: parts, ECV(down), edges cut.
+#
+#   make-quality.sh [GRAPH] [MAX_PARTS]
+
+GRAPH=${1:-data/hep-th.dat}
+MAX_PARTS=${2:-40}
+RDIR=${RDIR:-data/quality}
+NAME=$(basename $GRAPH .dat)
+BIN=${SHEEP_BIN:-bin}
+
+mkdir -p $RDIR
+SEQ="$RDIR/${NAME}.seq"
+TRE="$RDIR/${NAME}.tre"
+
+$BIN/degree_sequence $GRAPH $SEQ > /dev/null
+$BIN/graph2tree $GRAPH -s $SEQ -o $TRE -f | tee "$RDIR/${NAME}.facts"
+
+RAW="$RDIR/${NAME}.quality.raw"
+$BIN/partition_tree -g $GRAPH $SEQ $TRE $(seq 2 $MAX_PARTS) | tee $RAW
+
+paste <(seq 2 $MAX_PARTS) \
+      <(egrep "^ECV\(down\)" $RAW | awk '{print $2}') \
+      <(egrep "^edges cut" $RAW | awk '{print $3}') \
+      > "$RDIR/${NAME}.quality"
+echo "wrote $RDIR/${NAME}.quality"

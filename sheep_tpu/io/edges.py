@@ -131,6 +131,23 @@ def load_edges(path: str, part: int = 0, num_parts: int = 0) -> EdgeList:
     return read_net(path, part, num_parts)
 
 
+def iter_dat_blocks(path: str, block_edges: int, part: int = 0,
+                    num_parts: int = 0):
+    """Stream a ``.dat`` file as (tail, head) uint32 blocks via memmap —
+    the out-of-core path: nothing but the current block is materialized.
+    Honors partial-load ranges like :func:`read_dat`."""
+    nbytes = os.path.getsize(path)
+    num_records = nbytes // _XS1_DTYPE.itemsize
+    start, stop = partial_range(num_records, part, num_parts) if num_parts \
+        else (0, num_records)
+    mm = np.memmap(path, dtype=_XS1_DTYPE, mode="r")
+    for a in range(start, stop, block_edges):
+        b = min(a + block_edges, stop)
+        rec = mm[a:b]
+        yield np.ascontiguousarray(rec["tail"]), \
+            np.ascontiguousarray(rec["head"])
+
+
 def write_dat(path: str, tail: np.ndarray, head: np.ndarray) -> None:
     rec = np.empty(len(tail), dtype=_XS1_DTYPE)
     rec["tail"] = tail

@@ -12,6 +12,8 @@ from .forest import (
     merge_forests_device,
 )
 from .build import build_step, build_graph_device
+from .stream import (build_graph_streaming, stream_block_step,
+                     streaming_degree_histogram)
 
 __all__ = [
     "degree_histogram",
@@ -25,4 +27,7 @@ __all__ = [
     "merge_forests_device",
     "build_step",
     "build_graph_device",
+    "build_graph_streaming",
+    "stream_block_step",
+    "streaming_degree_histogram",
 ]

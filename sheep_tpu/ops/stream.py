@@ -1,0 +1,104 @@
+"""Out-of-core streaming build: edge blocks from host DRAM into the device.
+
+The reference's OOM story is partial loads with more partials than cores
+(scripts/horizontal-dist.sh:22-24, README:112-122): workers stream
+edge-disjoint slices and the associative tree merge stitches them.  The
+device analog keeps only O(n + B) state resident: a carry forest (two
+length-n arrays) plus one B-edge block.  Each block step rebuilds the forest
+from (carry links + block links) with the fixpoint kernel — correct because
+a forest re-enters as its own link set and the merge is associative
+(lib/jnode.cpp:174-201).  pst accumulates as a segment-sum per block.
+
+Shapes are static (one compilation for any number of blocks), and JAX's
+async dispatch overlaps the host memmap read of block k+1 with the device
+compute of block k — the double-buffering the reference gets from OS
+readahead.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from .. import INVALID_JNID
+from ..core.forest import Forest
+from .forest import forest_fixpoint, pst_weights
+from .sort import degree_histogram
+
+
+@functools.partial(jax.jit, static_argnames=("n",))
+def stream_block_step(parent: jnp.ndarray, pst: jnp.ndarray,
+                      tail: jnp.ndarray, head: jnp.ndarray,
+                      pos: jnp.ndarray, n: int):
+    """Fold one edge block into the carry forest.
+
+    parent int32 [n] (n = root sentinel), pst int32 [n], tail/head int32 [B]
+    (pad with n), pos int32 [n+1] vid->position with pos[n] = n.
+    """
+    sent = jnp.int32(n)
+    pt = pos[jnp.minimum(tail, sent)]
+    ph = pos[jnp.minimum(head, sent)]
+    lo = jnp.minimum(pt, ph)
+    hi = jnp.maximum(pt, ph)
+    # pst: every block edge with a present earlier endpoint, absent-endpoint
+    # edges included (pst-only contract); loops/padding (lo == hi) excluded.
+    pst = pst + pst_weights(jnp.where(lo == hi, sent, lo), n)
+    dead = (lo >= hi) | (hi >= sent)
+    blo = jnp.where(dead, sent, lo)
+    bhi = jnp.where(dead, sent, hi)
+    # carry forest re-enters as its own links
+    kid = jnp.arange(n, dtype=jnp.int32)
+    clive = parent < sent
+    clo = jnp.where(clive, kid, sent)
+    chi = jnp.where(clive, parent, sent)
+    mlo = jnp.concatenate([clo, blo])
+    mhi = jnp.concatenate([chi, bhi])
+    new_parent, rounds = forest_fixpoint(mlo, mhi, n)
+    return new_parent, pst, rounds
+
+
+def build_graph_streaming(blocks, n: int, pos: np.ndarray,
+                          block_edges: int):
+    """Fold an iterator of (tail, head) uint32 blocks into a Forest.
+
+    ``pos``: vid -> position table over n slots (positions of the shared
+    sequence; INVALID for absent vids).  Returns (Forest over n positions,
+    total_rounds).  Memory: O(n + block_edges) device-resident.
+    """
+    sent = np.int32(n)
+    posx = np.full(n + 1, n, dtype=np.int32)
+    take = min(len(pos), n)
+    p = pos[:take].astype(np.int64)
+    posx[:take] = np.where((p < 0) | (p >= n), n, p).astype(np.int32)
+    pos_d = jnp.asarray(posx)
+
+    parent = jnp.full(n, sent, jnp.int32)
+    pst = jnp.zeros(n, jnp.int32)
+    total_rounds = 0
+    for tail, head in blocks:
+        b = len(tail)
+        t = np.full(block_edges, n, dtype=np.int64)
+        h = np.full(block_edges, n, dtype=np.int64)
+        t[:b] = tail
+        h[:b] = head
+        parent, pst, rounds = stream_block_step(
+            parent, pst, jnp.asarray(t, jnp.int32), jnp.asarray(h, jnp.int32),
+            pos_d, n)
+        total_rounds += int(rounds)
+    parent_np = np.asarray(parent).astype(np.int64)
+    out = np.full(n, INVALID_JNID, dtype=np.uint32)
+    live = parent_np < n
+    out[live] = parent_np[live].astype(np.uint32)
+    return Forest(out, np.asarray(pst).astype(np.uint32)), total_rounds
+
+
+def streaming_degree_histogram(blocks, n: int) -> np.ndarray:
+    """Degree histogram from an edge-block iterator (device bincount)."""
+    deg = jnp.zeros(n, jnp.int32)
+    for tail, head in blocks:
+        deg = deg + degree_histogram(jnp.asarray(tail, jnp.int32),
+                                     jnp.asarray(head, jnp.int32), n)
+    return np.asarray(deg).astype(np.int64)

@@ -49,6 +49,8 @@ def fennel_vertex(tail: np.ndarray, head: np.ndarray, num_parts: int,
     offs, dst = _csr(tail, head, n_vid)
     deg = np.diff(offs)
     active = deg > 0
+    if len(tail) == 0 or not active.any():
+        return np.full(n_vid, INVALID_PART, dtype=np.int64)
     n = float(active.sum())
     m = float(2 * len(tail))  # directed edge count
     k = float(num_parts)
@@ -92,7 +94,12 @@ def fennel_edges(tail: np.ndarray, head: np.ndarray, num_parts: int,
     n_vid = int(max_vid) + 1 if max_vid is not None else (
         int(max(tail.max(initial=0), head.max(initial=0))) + 1 if len(tail) else 0)
     e = len(tail)
-    n = float(max(n_vid, 1))
+    if e == 0:
+        return np.empty(0, dtype=np.int64)
+    # active-vertex count, consistent with fennel_vertex (sparse vid spaces
+    # would otherwise inflate n and weaken the balance penalty)
+    deg = np.bincount(tail, minlength=n_vid) + np.bincount(head, minlength=n_vid)
+    n = float(max(int((deg > 0).sum()), 1))
     m = float(2 * e)
     k = float(num_parts)
     y = 1.5

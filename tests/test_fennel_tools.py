@@ -125,9 +125,7 @@ def test_tools_end_to_end(tmp_path):
 
     # jnid partition file -> read_partition re-evaluation
     pfile = str(tmp_path / "hep.part")
-    import numpy as np
     from sheep_tpu.core.forest import Forest
-    from sheep_tpu.io.seqfile import read_sequence
     from sheep_tpu.io.trefile import read_tree
     from sheep_tpu.partition.tree_partition import partition_forest
     parent, pst = read_tree(tre)

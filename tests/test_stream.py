@@ -1,0 +1,78 @@
+"""Out-of-core streaming build == whole-graph oracle, for any block size."""
+
+import os
+
+import numpy as np
+import pytest
+
+from conftest import random_multigraph
+
+from sheep_tpu.core.forest import build_forest
+from sheep_tpu.core.sequence import degree_sequence, sequence_positions
+from sheep_tpu.io.edges import iter_dat_blocks, load_edges, write_dat
+from sheep_tpu.ops import build_graph_streaming, streaming_degree_histogram
+
+
+def _blocks(tail, head, block):
+    for a in range(0, len(tail), block):
+        yield tail[a:a + block], head[a:a + block]
+
+
+@pytest.mark.parametrize("seed", range(5))
+@pytest.mark.parametrize("block", [7, 64, 10_000])
+def test_streaming_matches_oracle(seed, block):
+    rng = np.random.default_rng(seed)
+    tail, head = random_multigraph(rng, n_max=60, e_max=300)
+    seq = degree_sequence(tail, head)
+    n_vid = int(max(tail.max(), head.max())) + 1
+    n = max(n_vid, len(seq))
+    pos = sequence_positions(seq, n - 1)
+    forest, _ = build_graph_streaming(
+        _blocks(tail, head, block), n, pos, block_edges=block)
+    want = build_forest(tail, head, seq, max_vid=n - 1, impl="python")
+    m = len(seq)
+    np.testing.assert_array_equal(forest.parent[:m], want.parent)
+    np.testing.assert_array_equal(forest.pst_weight[:m], want.pst_weight)
+    # slots past the active positions stay empty roots
+    assert (forest.pst_weight[m:] == 0).all()
+
+
+def test_streaming_degree_histogram():
+    rng = np.random.default_rng(17)
+    tail, head = random_multigraph(rng, n_max=50, e_max=200)
+    n = int(max(tail.max(), head.max())) + 1
+    deg = streaming_degree_histogram(_blocks(tail, head, 13), n)
+    ref = np.bincount(tail, minlength=n) + np.bincount(head, minlength=n)
+    np.testing.assert_array_equal(deg, ref)
+
+
+def test_iter_dat_blocks_roundtrip(tmp_path):
+    rng = np.random.default_rng(3)
+    tail = rng.integers(0, 100, 50).astype(np.uint32)
+    head = rng.integers(0, 100, 50).astype(np.uint32)
+    path = str(tmp_path / "g.dat")
+    write_dat(path, tail, head)
+    ts, hs = [], []
+    for t, h in iter_dat_blocks(path, 7):
+        assert len(t) <= 7
+        ts.append(t)
+        hs.append(h)
+    np.testing.assert_array_equal(np.concatenate(ts), tail)
+    np.testing.assert_array_equal(np.concatenate(hs), head)
+    # partial ranges match the eager loader
+    el = load_edges(path, part=2, num_parts=3)
+    ts = [t for t, _ in iter_dat_blocks(path, 5, part=2, num_parts=3)]
+    np.testing.assert_array_equal(np.concatenate(ts), el.tail)
+
+
+def test_streaming_end_to_end_hepth(hep_edges):
+    seq = degree_sequence(hep_edges.tail, hep_edges.head)
+    n = max(hep_edges.max_vid + 1, len(seq))
+    pos = sequence_positions(seq, n - 1)
+    forest, rounds = build_graph_streaming(
+        _blocks(hep_edges.tail, hep_edges.head, 4096), n, pos,
+        block_edges=4096)
+    want = build_forest(hep_edges.tail, hep_edges.head, seq)
+    m = len(seq)
+    np.testing.assert_array_equal(forest.parent[:m], want.parent)
+    np.testing.assert_array_equal(forest.pst_weight[:m], want.pst_weight)

@@ -74,7 +74,10 @@ if [ $PLOT_DATA -eq $TRUE ]; then
     rm -f "${RDIR}/${NAME}.avg"
     for W in $(awk '{print $1}' ${RDIR}/${NAME}.dat | sort -nu); do
       echo -n "$W " >> "${RDIR}/${NAME}.avg"
-      egrep "^$W[[:blank:]]" ${RDIR}/${NAME}.dat | awk 'NR > 1' |
+      # Drop the first (warmup) trial only when more than one trial ran.
+      ROWS=$(egrep -c "^$W[[:blank:]]" ${RDIR}/${NAME}.dat)
+      SKIP=$( [ $ROWS -gt 1 ] && echo 1 || echo 0 )
+      egrep "^$W[[:blank:]]" ${RDIR}/${NAME}.dat | awk -v skip=$SKIP 'NR > skip' |
           awk '{ls += $2; ss += $3; ms += $4; rs += $5} END {print ls/NR" "ss/NR" "ms/NR" "rs/NR}' >> "${RDIR}/${NAME}.avg"
     done
 

@@ -77,7 +77,7 @@ def build_graph_streaming(blocks, n: int, pos: np.ndarray,
 
     parent = jnp.full(n, sent, jnp.int32)
     pst = jnp.zeros(n, jnp.int32)
-    total_rounds = 0
+    round_counts = []  # device arrays; summing later keeps dispatch async
     for tail, head in blocks:
         b = len(tail)
         t = np.full(block_edges, n, dtype=np.int64)
@@ -87,7 +87,8 @@ def build_graph_streaming(blocks, n: int, pos: np.ndarray,
         parent, pst, rounds = stream_block_step(
             parent, pst, jnp.asarray(t, jnp.int32), jnp.asarray(h, jnp.int32),
             pos_d, n)
-        total_rounds += int(rounds)
+        round_counts.append(rounds)
+    total_rounds = int(sum(int(r) for r in round_counts)) if round_counts else 0
     parent_np = np.asarray(parent).astype(np.int64)
     out = np.full(n, INVALID_JNID, dtype=np.uint32)
     live = parent_np < n

@@ -1,10 +1,12 @@
-from .mesh import AXIS, make_mesh, edge_sharding, replicated
+from .mesh import (AXIS, make_mesh, edge_sharding, replicated,
+                   init_distributed)
 from .build import (distributed_build_step, build_graph_distributed,
                     map_graph_distributed)
 
 __all__ = [
     "AXIS",
     "make_mesh",
+    "init_distributed",
     "edge_sharding",
     "replicated",
     "distributed_build_step",

@@ -18,6 +18,23 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 AXIS = "workers"
 
 
+def init_distributed(coordinator_address: str | None = None,
+                     num_processes: int | None = None,
+                     process_id: int | None = None) -> None:
+    """Join a multi-host mesh (the reference's `mpiexec` across nodes).
+
+    Wraps ``jax.distributed.initialize``: with no arguments it relies on the
+    cluster environment (TPU pods auto-detect; elsewhere set
+    JAX_COORDINATOR_ADDRESS / JAX_NUM_PROCESSES / JAX_PROCESS_ID).  After
+    this, ``jax.devices()`` spans every host and :func:`make_mesh` builds a
+    global mesh whose collectives ride ICI within a slice and DCN across
+    hosts — the same SPMD program, no code changes.
+    """
+    jax.distributed.initialize(coordinator_address=coordinator_address,
+                               num_processes=num_processes,
+                               process_id=process_id)
+
+
 def make_mesh(num_workers: int | None = None) -> Mesh:
     devices = jax.devices()
     if num_workers is None:

@@ -18,8 +18,13 @@ from __future__ import annotations
 import numpy as np
 
 
-def degree_sequence_from_degrees(deg: np.ndarray) -> np.ndarray:
+def degree_sequence_from_degrees(deg: np.ndarray,
+                                 impl: str = "auto") -> np.ndarray:
     """Sequence from a dense degree histogram (vid-indexed)."""
+    if impl != "python":
+        from .. import native
+        if native.available():
+            return native.degree_sequence_from_degrees(deg)
     vids = np.nonzero(deg)[0]
     order = np.lexsort((vids, deg[vids]))  # primary: degree asc, tie: vid asc
     return vids[order].astype(np.uint32)

@@ -124,18 +124,56 @@ def read_net(path: str, part: int = 0, num_parts: int = 0) -> EdgeList:
     return EdgeList(tail=tails, head=heads, file_edges=num_records, start=start)
 
 
-def load_edges(path: str, part: int = 0, num_parts: int = 0) -> EdgeList:
-    """Suffix-dispatching loader (``.dat`` binary, else SNAP text)."""
+def dedup_edges(edges: EdgeList) -> EdgeList:
+    """Drop duplicate undirected records and self-loops — the reference's
+    compile-time DDUP_GRAPH option (defs.h:43, graph_wrapper.h:52), off by
+    default.  Records are canonicalized to (min, max) orientation.
+
+    Like the reference, dedup applies to the *loaded record range*: each
+    partial load dedups its own slice (graph_wrapper.h dedups the per-rank
+    loaded graph), so duplicates spanning different parts survive a
+    distributed run in both implementations.  ``file_edges`` becomes the
+    deduped count of this load, matching LLAMA's post-dedup getEdges();
+    ``start`` keeps the raw file offset of the slice.
+    """
+    a = np.minimum(edges.tail, edges.head).astype(np.uint64)
+    b = np.maximum(edges.tail, edges.head).astype(np.uint64)
+    keep = a != b
+    key = np.unique(a[keep] << np.uint64(32) | b[keep])
+    return EdgeList(tail=(key >> np.uint64(32)).astype(np.uint32),
+                    head=(key & np.uint64(0xFFFFFFFF)).astype(np.uint32),
+                    file_edges=len(key), start=edges.start)
+
+
+def load_edges(path: str, part: int = 0, num_parts: int = 0,
+               dedup: bool = False) -> EdgeList:
+    """Suffix-dispatching loader (``.dat`` binary, else SNAP text).
+
+    ``dedup`` mirrors DDUP_GRAPH; the CLIs honor SHEEP_DDUP_GRAPH=1 for the
+    same effect without recompiling (the reference needs a rebuild).
+    """
     if path.endswith(".dat"):
-        return read_dat(path, part, num_parts)
-    return read_net(path, part, num_parts)
+        el = read_dat(path, part, num_parts)
+    else:
+        el = read_net(path, part, num_parts)
+    if dedup or os.environ.get("SHEEP_DDUP_GRAPH", "") == "1":
+        el = dedup_edges(el)
+    return el
 
 
 def iter_dat_blocks(path: str, block_edges: int, part: int = 0,
                     num_parts: int = 0):
     """Stream a ``.dat`` file as (tail, head) uint32 blocks via memmap —
     the out-of-core path: nothing but the current block is materialized.
-    Honors partial-load ranges like :func:`read_dat`."""
+    Honors partial-load ranges like :func:`read_dat`.
+
+    Raw records only: SHEEP_DDUP_GRAPH is NOT applied here (block-local
+    dedup would differ from load-level dedup); a warning is emitted so the
+    two paths are never silently inconsistent."""
+    if os.environ.get("SHEEP_DDUP_GRAPH", "") == "1":
+        import warnings
+        warnings.warn("SHEEP_DDUP_GRAPH is ignored by the streaming block "
+                      "reader; dedup the file up front instead")
     nbytes = os.path.getsize(path)
     num_records = nbytes // _XS1_DTYPE.itemsize
     start, stop = partial_range(num_records, part, num_parts) if num_parts \

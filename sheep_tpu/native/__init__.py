@@ -53,24 +53,33 @@ def _load() -> ctypes.CDLL | None:
                 return None
         try:
             lib = ctypes.CDLL(_SO)
-        except OSError:
+            _bind(lib)
+        except (OSError, AttributeError):
+            # missing file, wrong arch, or a stale .so lacking a newer
+            # symbol — fall back to the numpy oracle
             return None
-
-        lib.sheep_build_forest.restype = ctypes.c_int
-        lib.sheep_build_forest.argtypes = [
-            _u32p, _u32p, ctypes.c_int64, ctypes.c_int64,
-            ctypes.c_void_p, _u32p, _u32p]
-        lib.sheep_edges_to_links.restype = ctypes.c_int64
-        lib.sheep_edges_to_links.argtypes = [
-            _u32p, _u32p, ctypes.c_int64, _u32p, ctypes.c_int64, _u32p, _u32p]
-        lib.sheep_forward_partition.restype = ctypes.c_int64
-        lib.sheep_forward_partition.argtypes = [
-            _u32p, _i64p, ctypes.c_int64, ctypes.c_int64, _i32p]
-        lib.sheep_degree_histogram.restype = ctypes.c_int
-        lib.sheep_degree_histogram.argtypes = [
-            _u32p, _u32p, ctypes.c_int64, ctypes.c_int64, _i64p]
         _lib = lib
         return _lib
+
+
+def _bind(lib: ctypes.CDLL) -> None:
+    """Declare the C ABI; raises AttributeError on a stale library."""
+    lib.sheep_build_forest.restype = ctypes.c_int
+    lib.sheep_build_forest.argtypes = [
+        _u32p, _u32p, ctypes.c_int64, ctypes.c_int64,
+        ctypes.c_void_p, _u32p, _u32p]
+    lib.sheep_edges_to_links.restype = ctypes.c_int64
+    lib.sheep_edges_to_links.argtypes = [
+        _u32p, _u32p, ctypes.c_int64, _u32p, ctypes.c_int64, _u32p, _u32p]
+    lib.sheep_forward_partition.restype = ctypes.c_int64
+    lib.sheep_forward_partition.argtypes = [
+        _u32p, _i64p, ctypes.c_int64, ctypes.c_int64, _i32p]
+    lib.sheep_degree_histogram.restype = ctypes.c_int
+    lib.sheep_degree_histogram.argtypes = [
+        _u32p, _u32p, ctypes.c_int64, ctypes.c_int64, _i64p]
+    lib.sheep_degree_sequence.restype = ctypes.c_int64
+    lib.sheep_degree_sequence.argtypes = [
+        _i64p, ctypes.c_int64, _u32p]
 
 
 def available() -> bool:
@@ -137,3 +146,13 @@ def degree_histogram(tail: np.ndarray, head: np.ndarray, n: int) -> np.ndarray:
     deg = np.empty(n, dtype=np.int64)
     lib.sheep_degree_histogram(tail, head, len(tail), n, deg)
     return deg
+
+
+def degree_sequence_from_degrees(deg: np.ndarray) -> np.ndarray:
+    """Counting-sort degree sequence (ascending degree, vid tie break)."""
+    lib = _load()
+    assert lib is not None
+    deg = np.ascontiguousarray(deg, dtype=np.int64)
+    seq = np.empty(len(deg), dtype=np.uint32)
+    k = lib.sheep_degree_sequence(deg, len(deg), seq)
+    return seq[:k].copy()

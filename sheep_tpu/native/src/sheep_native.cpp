@@ -204,4 +204,24 @@ int sheep_degree_histogram(const uint32_t* tail, const uint32_t* head,
   return 0;
 }
 
+// Ascending-degree sequence with ascending-vid tie break, nonzero degrees
+// only (lib/sequence.h:52-63).  Degrees are small integers, so this is a
+// counting sort over degree buckets — iterating vids in ascending order
+// within a bucket gives the vid tie break for free; O(n + max_degree)
+// versus the reference's comparison sort.  Returns the sequence length.
+int64_t sheep_degree_sequence(const int64_t* deg, int64_t n,
+                              uint32_t* seq_out) {
+  int64_t max_deg = 0;
+  for (int64_t v = 0; v < n; ++v)
+    if (deg[v] > max_deg) max_deg = deg[v];
+  std::vector<int64_t> offs((size_t)max_deg + 2, 0);
+  for (int64_t v = 0; v < n; ++v)
+    if (deg[v] > 0) ++offs[deg[v] + 1];
+  for (int64_t d = 0; d <= max_deg; ++d) offs[d + 1] += offs[d];
+  int64_t total = offs[max_deg + 1];
+  for (int64_t v = 0; v < n; ++v)
+    if (deg[v] > 0) seq_out[offs[deg[v]]++] = (uint32_t)v;
+  return total;
+}
+
 }  // extern "C"

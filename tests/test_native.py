@@ -114,3 +114,14 @@ def test_degree_histogram():
     deg = native.degree_histogram(tail, head, 50)
     ref = np.bincount(tail, minlength=50) + np.bincount(head, minlength=50)
     np.testing.assert_array_equal(deg, ref.astype(np.int64))
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_degree_sequence_counting_sort(seed):
+    from sheep_tpu.core.sequence import degree_sequence_from_degrees
+
+    rng = np.random.default_rng(300 + seed)
+    deg = rng.integers(0, 10, int(rng.integers(1, 200))).astype(np.int64)
+    nat = native.degree_sequence_from_degrees(deg)
+    ref = degree_sequence_from_degrees(deg, impl="python")
+    np.testing.assert_array_equal(nat, ref)

@@ -2,17 +2,44 @@
 
 Streams the edge file without building adjacency (the reference's
 fileSequence, lib/sequence.h:95-128 — the out-of-memory path), writes the
-sequence, prints ``Sorted in: Nms``.
+sequence, prints ``Sorted in: Nms``.  Binary ``.dat`` files stream through
+a memmap block iterator so only the degree array is resident; text files
+fall back to an eager load.
 """
 
 from __future__ import annotations
 
+import os
 import sys
 
-from ..core.sequence import degree_sequence
-from ..io.edges import load_edges
+import numpy as np
+
+from ..core.sequence import degree_sequence, degree_sequence_from_degrees
+from ..io.edges import iter_dat_blocks, load_edges
 from ..io.seqfile import write_sequence
 from .common import PhaseClock, print_phase_ms
+
+_BLOCK = 1 << 24  # 16M records (~192MB) per streamed block
+
+
+def _streamed_sequence(path: str) -> np.ndarray:
+    from .. import native
+
+    deg = None
+    for tail, head in iter_dat_blocks(path, _BLOCK):
+        n_blk = int(max(tail.max(initial=0), head.max(initial=0))) + 1
+        if deg is None:
+            deg = np.zeros(n_blk, dtype=np.int64)
+        elif n_blk > len(deg):
+            deg = np.concatenate([deg, np.zeros(n_blk - len(deg), np.int64)])
+        if native.available():
+            deg[:n_blk] += native.degree_histogram(tail, head, n_blk)
+        else:
+            deg[:n_blk] += np.bincount(tail, minlength=n_blk) \
+                + np.bincount(head, minlength=n_blk)
+    if deg is None:
+        return np.empty(0, dtype=np.uint32)
+    return degree_sequence_from_degrees(deg)
 
 
 def main(argv: list[str] | None = None) -> int:
@@ -21,8 +48,12 @@ def main(argv: list[str] | None = None) -> int:
         print("USAGE: degree_sequence graph_file output_file", end="")
         return 1
     clock = PhaseClock()
-    edges = load_edges(argv[0])
-    seq = degree_sequence(edges.tail, edges.head)
+    if argv[0].endswith(".dat") and \
+            os.environ.get("SHEEP_DDUP_GRAPH", "") != "1":
+        seq = _streamed_sequence(argv[0])
+    else:
+        edges = load_edges(argv[0])
+        seq = degree_sequence(edges.tail, edges.head)
     write_sequence(seq, argv[1])
     print_phase_ms("Sorted", clock.total_seconds())
     return 0

@@ -24,7 +24,9 @@ def degree_sequence_from_degrees(deg: np.ndarray,
     if impl != "python":
         from .. import native
         if native.available():
-            return native.degree_sequence_from_degrees(deg)
+            seq = native.degree_sequence_from_degrees(deg)
+            if seq is not None:  # None: degree range too wide for buckets
+                return seq
     vids = np.nonzero(deg)[0]
     order = np.lexsort((vids, deg[vids]))  # primary: degree asc, tie: vid asc
     return vids[order].astype(np.uint32)

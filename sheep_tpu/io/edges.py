@@ -176,6 +176,8 @@ def iter_dat_blocks(path: str, block_edges: int, part: int = 0,
                       "reader; dedup the file up front instead")
     nbytes = os.path.getsize(path)
     num_records = nbytes // _XS1_DTYPE.itemsize
+    if num_records == 0:
+        return  # an empty file yields no blocks (mmap would reject it)
     start, stop = partial_range(num_records, part, num_parts) if num_parts \
         else (0, num_records)
     mm = np.memmap(path, dtype=_XS1_DTYPE, mode="r")

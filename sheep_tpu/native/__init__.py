@@ -149,10 +149,17 @@ def degree_histogram(tail: np.ndarray, head: np.ndarray, n: int) -> np.ndarray:
 
 
 def degree_sequence_from_degrees(deg: np.ndarray) -> np.ndarray:
-    """Counting-sort degree sequence (ascending degree, vid tie break)."""
+    """Counting-sort degree sequence (ascending degree, vid tie break).
+
+    Returns None when the degree range is too wide for counting buckets
+    (a multigraph hub can push max_degree far past n); callers fall back
+    to the comparison sort.
+    """
+    deg = np.ascontiguousarray(deg, dtype=np.int64)
+    if len(deg) and int(deg.max()) > max(4 * len(deg), 1 << 20):
+        return None
     lib = _load()
     assert lib is not None
-    deg = np.ascontiguousarray(deg, dtype=np.int64)
     seq = np.empty(len(deg), dtype=np.uint32)
     k = lib.sheep_degree_sequence(deg, len(deg), seq)
     return seq[:k].copy()

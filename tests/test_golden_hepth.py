@@ -28,6 +28,48 @@ def test_hepth_degree_sequence_tree_facts(hep_edges):
     assert facts.fill == 0
 
 
+def test_hepth_published_quality_sweep(hep_edges):
+    """ECV(down) for 2..9 parts matches the reference's published sweep
+    byte-for-byte (data/quality/hep.degree.cost:1-8) — including the FFD
+    bin-packing, whose tie order therefore agrees with the published run."""
+    from sheep_tpu.partition.evaluate import evaluate_partition
+    from sheep_tpu.partition.partition import Partition
+
+    published = [521, 888, 1177, 1342, 1532, 1661, 1818, 1922]
+    seq = degree_sequence(hep_edges.tail, hep_edges.head)
+    forest = build_forest(hep_edges.tail, hep_edges.head, seq)
+    got = []
+    for p in range(2, 10):
+        part = Partition.from_forest(seq, forest, p,
+                                     max_vid=hep_edges.max_vid)
+        rep = evaluate_partition(part.parts, hep_edges.tail, hep_edges.head,
+                                 seq, p, max_vid=hep_edges.max_vid,
+                                 file_edges=hep_edges.num_edges)
+        got.append(rep.ecv_down)
+    assert got == published
+
+
+def test_hepth_published_bipartition_metrics(hep_edges):
+    """Full 2-part evaluator report matches the published run
+    (data/quality/hep.degree.raw:14-22)."""
+    from sheep_tpu.partition.evaluate import evaluate_partition
+    from sheep_tpu.partition.partition import Partition
+
+    seq = degree_sequence(hep_edges.tail, hep_edges.head)
+    forest = build_forest(hep_edges.tail, hep_edges.head, seq)
+    part = Partition.from_forest(seq, forest, 2, max_vid=hep_edges.max_vid)
+    sizes = [(part.parts == 0).sum(), (part.parts == 1).sum()]
+    assert sizes == [3409, 4201]
+    rep = evaluate_partition(part.parts, hep_edges.tail, hep_edges.head,
+                             seq, 2, max_vid=hep_edges.max_vid,
+                             file_edges=hep_edges.num_edges)
+    assert rep.edges_cut == 2811
+    assert rep.vcom_vol == 2061
+    assert rep.ecv_hash == 1311
+    assert rep.ecv_down == 521
+    assert rep.ecv_up == 1539
+
+
 def test_hepth_tree_valid(hep_edges):
     seq = degree_sequence(hep_edges.tail, hep_edges.head)
     forest = build_forest(hep_edges.tail, hep_edges.head, seq)

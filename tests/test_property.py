@@ -8,7 +8,7 @@ from hypothesis import assume, given, settings, strategies as st
 
 from sheep_tpu import INVALID_PART, native
 from sheep_tpu.core.forest import build_forest, merge_forests
-from sheep_tpu.core.sequence import degree_sequence, sequence_positions
+from sheep_tpu.core.sequence import degree_sequence
 from sheep_tpu.core.validate import is_valid_forest
 from sheep_tpu.io.edges import EdgeList, dedup_edges
 from sheep_tpu.partition.evaluate import evaluate_partition

@@ -23,23 +23,21 @@ _BLOCK = 1 << 24  # 16M records (~192MB) per streamed block
 
 
 def _streamed_sequence(path: str) -> np.ndarray:
-    from .. import native
+    from ..core.sequence import host_degree_histogram
 
-    deg = None
+    deg = np.zeros(0, dtype=np.int64)
+    n = 0
     for tail, head in iter_dat_blocks(path, _BLOCK):
         n_blk = int(max(tail.max(initial=0), head.max(initial=0))) + 1
-        if deg is None:
-            deg = np.zeros(n_blk, dtype=np.int64)
-        elif n_blk > len(deg):
-            deg = np.concatenate([deg, np.zeros(n_blk - len(deg), np.int64)])
-        if native.available():
-            deg[:n_blk] += native.degree_histogram(tail, head, n_blk)
-        else:
-            deg[:n_blk] += np.bincount(tail, minlength=n_blk) \
-                + np.bincount(head, minlength=n_blk)
-    if deg is None:
+        n = max(n, n_blk)
+        if n > len(deg):  # geometric growth: amortized O(n) total copying
+            grown = np.zeros(max(n, 2 * len(deg)), dtype=np.int64)
+            grown[: len(deg)] = deg
+            deg = grown
+        deg[:n_blk] += host_degree_histogram(tail, head, n_blk)
+    if n == 0:
         return np.empty(0, dtype=np.uint32)
-    return degree_sequence_from_degrees(deg)
+    return degree_sequence_from_degrees(deg[:n])
 
 
 def main(argv: list[str] | None = None) -> int:

@@ -18,6 +18,18 @@ from __future__ import annotations
 import numpy as np
 
 
+def host_degree_histogram(tail: np.ndarray, head: np.ndarray,
+                          n: int) -> np.ndarray:
+    """Undirected-doubled degrees on host: native C++ when built, numpy
+    bincount otherwise.  Each record adds 1 to both endpoints; a self-loop
+    adds 2 (graph_wrapper.h:87-89 semantics)."""
+    from .. import native
+    if native.available():
+        return native.degree_histogram(tail, head, n)
+    return (np.bincount(tail, minlength=n)
+            + np.bincount(head, minlength=n)).astype(np.int64)
+
+
 def degree_sequence_from_degrees(deg: np.ndarray,
                                  impl: str = "auto") -> np.ndarray:
     """Sequence from a dense degree histogram (vid-indexed)."""
@@ -38,8 +50,7 @@ def degree_sequence(tail: np.ndarray, head: np.ndarray,
     n = num_vertices
     if n is None:
         n = int(max(tail.max(initial=0), head.max(initial=0))) + 1 if len(tail) else 0
-    deg = np.bincount(tail, minlength=n) + np.bincount(head, minlength=n)
-    return degree_sequence_from_degrees(deg)
+    return degree_sequence_from_degrees(host_degree_histogram(tail, head, n))
 
 
 def default_sequence(deg: np.ndarray) -> np.ndarray:

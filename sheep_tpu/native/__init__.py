@@ -148,7 +148,7 @@ def degree_histogram(tail: np.ndarray, head: np.ndarray, n: int) -> np.ndarray:
     return deg
 
 
-def degree_sequence_from_degrees(deg: np.ndarray) -> np.ndarray:
+def degree_sequence_from_degrees(deg: np.ndarray) -> np.ndarray | None:
     """Counting-sort degree sequence (ascending degree, vid tie break).
 
     Returns None when the degree range is too wide for counting buckets

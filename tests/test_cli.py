@@ -103,6 +103,29 @@ def test_graph2tree_mesh_ir():
     assert "Reduced in:" in out
 
 
+def test_path_equivalence_serial_vs_mesh(tmp_path):
+    """SURVEY §4.6: the same problem through the serial, -i, -r, and -ir
+    paths must produce byte-identical trees (the merge is exact given a
+    shared sequence; data/pll-10{,-i,-r,-ir} is the reference experiment)."""
+    seq = str(tmp_path / "hep.seq")
+    run_cli(["degree_sequence", HEP, seq])
+    run_cli(["graph2tree", HEP, "-s", seq, "-o", str(tmp_path / "serial.tre")])
+    # -r: file-given sequence, mesh reduce
+    run_cli(["graph2tree", HEP, "-r", "-s", seq,
+             "-o", str(tmp_path / "r.tre")], env_extra={"SHEEP_WORKERS": "8"})
+    # -ir: mesh sort + mesh reduce (sequence computed on device)
+    run_cli(["graph2tree", HEP, "-i", "-r",
+             "-o", str(tmp_path / "ir.tre")], env_extra={"SHEEP_WORKERS": "8"})
+    # -i: mesh sort + per-worker partials; merge them back through the CLI
+    run_cli(["graph2tree", HEP, "-i", "-s", str(tmp_path / "i.seq"),
+             "-o", str(tmp_path / "i")], env_extra={"SHEEP_WORKERS": "2"})
+    run_cli(["merge_trees", str(tmp_path / "i00r0.tre"),
+             str(tmp_path / "i01r0.tre"), "-o", str(tmp_path / "i.tre")])
+    serial = open(tmp_path / "serial.tre", "rb").read()
+    for name in ("r.tre", "ir.tre", "i.tre"):
+        assert open(tmp_path / name, "rb").read() == serial, name
+
+
 def test_dist_partition_script(tmp_path):
     env = dict(os.environ)
     env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")

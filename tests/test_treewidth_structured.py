@@ -1,0 +1,56 @@
+"""Treewidth-mode exactness on structured graphs with known widths.
+
+The max tree width in jxn mode is (treewidth of the elimination order) + 1:
+paths -> 2, cycles -> 3, cliques K_k -> k, stars -> 2 (leaf-first order).
+These are order-dependent quantities; the asserted orders make them exact.
+"""
+
+import numpy as np
+
+from sheep_tpu.core.jxn import JxnOptions, build_jxn_tree
+
+_OPTS = JxnOptions(make_kids=True, make_pst=True, make_jxn=True)
+
+
+def _width(tail, head, seq):
+    tree = build_jxn_tree(np.asarray(tail, np.uint32),
+                          np.asarray(head, np.uint32),
+                          np.asarray(seq, np.uint32), _OPTS)
+    return int(tree.widths.max())
+
+
+def test_path_graph_width():
+    n = 30
+    tail = np.arange(n - 1)
+    head = np.arange(1, n)
+    assert _width(tail, head, np.arange(n)) == 2  # treewidth 1
+
+
+def test_cycle_graph_width():
+    n = 24
+    tail = np.arange(n)
+    head = (np.arange(n) + 1) % n
+    assert _width(tail, head, np.arange(n)) == 3  # treewidth 2
+
+
+def test_clique_width():
+    k = 9
+    tail, head = np.triu_indices(k, 1)
+    assert _width(tail, head, np.arange(k)) == k  # treewidth k-1
+
+
+def test_star_leaf_first_width():
+    n = 20
+    tail = np.zeros(n - 1, dtype=np.int64)
+    head = np.arange(1, n)
+    seq = np.concatenate([np.arange(1, n), [0]])  # leaves first, hub last
+    assert _width(tail, head, seq) == 2  # treewidth 1
+
+
+def test_grid_width_bound():
+    """k x k grid, row-major order: width == k + 1 (bandwidth elimination)."""
+    k = 6
+    idx = np.arange(k * k).reshape(k, k)
+    tail = np.concatenate([idx[:, :-1].ravel(), idx[:-1, :].ravel()])
+    head = np.concatenate([idx[:, 1:].ravel(), idx[1:, :].ravel()])
+    assert _width(tail, head, np.arange(k * k)) == k + 1

@@ -1,0 +1,107 @@
+"""Op-level TPU diagnostic for the device build kernel.
+
+Runs ONE (op, log_n) measurement and prints a JSON line; drive it from a
+shell loop with one subprocess per case so a device fault in one op cannot
+take down the sweep.  Edge data is cached in .npy files under /tmp so the
+1-core host pays R-MAT generation once per size.
+
+Usage: python scripts/tpu_diag.py OP LOG_N
+Ops: hist order links scatter_min gather_e gather_n sort_e sort_n loop100
+     round fix build
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def edges(log_n: int, factor: int = 8):
+    path = f"/tmp/rmat_{log_n}_{factor}.npz"
+    if not os.path.exists(path):
+        from sheep_tpu.utils import rmat_edges
+        tail, head = rmat_edges(log_n, factor << log_n, seed=1)
+        np.savez(path, tail=tail, head=head)
+    d = np.load(path)
+    return d["tail"], d["head"]
+
+
+def main() -> None:
+    op, log_n = sys.argv[1], int(sys.argv[2])
+    n = 1 << log_n
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from sheep_tpu.ops.sort import degree_histogram, degree_order, edge_links
+    from sheep_tpu.ops.forest import forest_fixpoint, _round_step
+    from sheep_tpu.ops import build_step
+
+    platform = jax.devices()[0].platform
+    tail, head = edges(log_n)
+    t = jax.device_put(jnp.asarray(tail, jnp.int32))
+    h = jax.device_put(jnp.asarray(head, jnp.int32))
+    deg = degree_histogram(t, h, n)
+    _, pos, _ = degree_order(deg)
+    lo, hi = edge_links(t, h, pos, n)
+    lo, hi = jax.block_until_ready((lo, hi))
+    e = lo.shape[0]
+
+    if op == "hist":
+        fn = jax.jit(lambda: degree_histogram(t, h, n))
+    elif op == "order":
+        fn = jax.jit(lambda: degree_order(deg))
+    elif op == "links":
+        fn = jax.jit(lambda: edge_links(t, h, pos, n))
+    elif op == "scatter_min":
+        fn = jax.jit(
+            lambda: jnp.full(n + 1, n, jnp.int32).at[lo].min(hi))
+    elif op == "gather_e":
+        fn = jax.jit(lambda: pos[lo % n])
+    elif op == "gather_n":
+        fn = jax.jit(lambda: pos[pos % n])
+    elif op == "sort_e":
+        fn = jax.jit(lambda: lax.sort((lo, hi), num_keys=2))
+    elif op == "sort_n":
+        fn = jax.jit(lambda: lax.sort((pos, pos), num_keys=2))
+    elif op == "loop100":
+        def loop(x):
+            return lax.while_loop(
+                lambda s: s[1] < 100,
+                lambda s: (s[0] * 2 - s[0] // 2, s[1] + 1), (x, 0))[0]
+        fn = jax.jit(lambda: loop(pos))
+    elif op == "round":
+        fn = jax.jit(lambda: _round_step(
+            lo, hi, jnp.bool_(False), n, 6))
+    elif op == "fix":
+        fn = jax.jit(lambda: forest_fixpoint(lo, hi, n))
+    elif op == "build":
+        fn = jax.jit(lambda: build_step(t, h, n))
+    else:
+        raise SystemExit(f"unknown op {op}")
+
+    t0 = time.perf_counter()
+    out = jax.block_until_ready(fn())
+    compile_s = time.perf_counter() - t0
+    times = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        jax.block_until_ready(fn())
+        times.append(time.perf_counter() - t0)
+    rec = {"op": op, "log_n": log_n, "e": int(e), "platform": platform,
+           "compile_s": round(compile_s, 3), "best_s": round(min(times), 4),
+           "times": [round(x, 4) for x in times]}
+    if op == "fix":
+        rec["rounds"] = int(out[1])
+    if op == "build":
+        rec["rounds"] = int(out[5])
+    print(json.dumps(rec), flush=True)
+
+
+if __name__ == "__main__":
+    main()

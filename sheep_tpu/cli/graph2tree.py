@@ -128,6 +128,15 @@ def main(argv: list[str] | None = None) -> int:
 
     if verbose:
         print(f"Loading {graph_filename}...")
+    if use_mesh and num_parts:
+        # The reference clobbers -l under MPI: part/num_parts become the
+        # rank mapping (graph2tree.cpp:134-143), so every record is still
+        # processed across the job.  The mesh path is that whole job in one
+        # process; a user-supplied -l cannot be honored — say so instead of
+        # silently processing the full graph.
+        print(f"warning: -l {part}/{num_parts} is superseded by -i/-r "
+              f"(the mesh processes all records, like the reference's "
+              f"MPI rank mapping); ignoring -l", file=sys.stderr)
     edges = load_edges(graph_filename, part, num_parts) if not use_mesh \
         else load_edges(graph_filename)
     if verbose:

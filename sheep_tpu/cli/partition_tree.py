@@ -15,7 +15,7 @@ import getopt
 import sys
 
 from ..core.facts import compute_facts
-from ..core.forest import Forest
+from ..core.forest import Forest, pre_weights
 from ..core.sequence import degree_sequence
 from ..io.edges import load_edges
 from ..io.seqfile import read_sequence
@@ -88,7 +88,14 @@ def main(argv: list[str] | None = None) -> int:
         compute_facts(forest).print()
 
     if graph_filename == "":
-        # Partition-only print
+        # Partition-only print.  Without a graph, pre weights cannot be
+        # recomputed (the 2-field .tre stores none, like the reference's
+        # default non-USE_PRE_WEIGHT build where pre_weight() reads 0) — say
+        # so instead of a silent no-op.
+        if pre_weight:
+            print("warning: -u without -g contributes zero pre_weight "
+                  "(pre weights are recomputed from the graph; pass -g)",
+                  file=sys.stderr)
         seq = read_sequence(sequence_filename)
         for parts_arg in args[2:]:
             num_parts = int(parts_arg)
@@ -99,11 +106,13 @@ def main(argv: list[str] | None = None) -> int:
         edges = load_edges(graph_filename)
         seq = degree_sequence(edges.tail, edges.head) \
             if sequence_filename == "-" else read_sequence(sequence_filename)
+        pre = pre_weights(edges.tail, edges.head, seq,
+                          max_vid=edges.max_vid) if pre_weight else None
         for parts_arg in args[2:]:
             num_parts = int(parts_arg)
             pclock = PhaseClock()
             part = Partition.from_forest(seq, forest, num_parts, popts,
-                                         max_vid=edges.max_vid)
+                                         max_vid=edges.max_vid, pre=pre)
             if verbose:
                 print(f"Partitioning took: {pclock.phase_seconds():f} seconds")
             part.print()
@@ -115,10 +124,12 @@ def main(argv: list[str] | None = None) -> int:
         edges = load_edges(graph_filename)
         seq = degree_sequence(edges.tail, edges.head) \
             if sequence_filename == "-" else read_sequence(sequence_filename)
+        pre = pre_weights(edges.tail, edges.head, seq,
+                          max_vid=edges.max_vid) if pre_weight else None
         num_parts = int(args[2])
         pclock = PhaseClock()
         part = Partition.from_forest(seq, forest, num_parts, popts,
-                                     max_vid=edges.max_vid)
+                                     max_vid=edges.max_vid, pre=pre)
         if verbose:
             print(f"Partitioning took: {pclock.phase_seconds():f} seconds")
         part.print()

@@ -116,26 +116,65 @@ def build_forest_links(lo: np.ndarray, hi: np.ndarray, n: int,
     ``impl``: "auto" uses the C++ runtime when built (sheep_tpu.native),
     "python" forces this module's loop (the oracle), "native" requires C++.
     """
+    forest, _ = _build_forest_links_pre(lo, hi, n, pst, False, impl)
+    return forest
+
+
+def _build_forest_links_pre(lo, hi, n, pst, compute_pre: bool, impl: str):
+    """Shared worker: returns (Forest, pre | None).
+
+    ``compute_pre`` adds the reference's USE_PRE_WEIGHT accounting
+    (lib/jnode.h:174-176 meetKid): each tree link adds 1 to pre[r] where r
+    is lo's component root *before* this hi-group's adoptions — unions are
+    deferred to the end of the group, matching adoptKids running after the
+    whole edge scan (jtree.cpp:102)."""
     native = native_or_none(impl)
     if native is not None:
-        p, w = native.build_forest_links(lo, hi, n, pst)
-        return Forest(p, w)
+        out = native.build_forest_links(lo, hi, n, pst,
+                                        compute_pre=compute_pre)
+        if compute_pre:
+            return Forest(out[0], out[1]), out[2]
+        return Forest(out[0], out[1]), None
     if pst is None:
         pst = np.bincount(lo, minlength=n).astype(np.uint32)
     parent = np.full(n, INVALID_JNID, dtype=np.uint32)
+    pre = np.zeros(n, dtype=np.uint32) if compute_pre else None
     uf = np.arange(n, dtype=np.int64)
     linked = hi < n  # hi >= n marks pst-only links (absent endpoint)
     lo, hi = lo[linked], hi[linked]
     order = np.argsort(hi, kind="stable")
     lo_s, hi_s = lo[order], hi[order]
-    for i in range(len(lo_s)):
+    m = len(lo_s)
+    i = 0
+    while i < m:
         h = int(hi_s[i])
-        r = _find(uf, int(lo_s[i]))
-        if r != h:
-            # r is the max of its component and h > r: attach and re-root.
-            parent[r] = h
+        adopted = []
+        while i < m and int(hi_s[i]) == h:
+            r = _find(uf, int(lo_s[i]))
+            if pre is not None:
+                pre[r] += 1
+            if r != h and parent[r] == INVALID_JNID:
+                # r is the max of its component and h > r: attach.
+                parent[r] = h
+                adopted.append(r)
+            i += 1
+        for r in adopted:  # deferred re-root (adoptKids)
             uf[r] = h
-    return Forest(parent, pst.astype(np.uint32))
+    return Forest(parent, pst.astype(np.uint32)), pre
+
+
+def pre_weights(tail: np.ndarray, head: np.ndarray, seq: np.ndarray,
+                max_vid: int | None = None, impl: str = "auto") -> np.ndarray:
+    """The reference's pre_weight array for a graph + sequence.
+
+    pre[k] = number of graph edges between parent(k) and k's subtree at
+    adoption time (lib/jnode.h:174-176); the partitioner's -u weight model
+    sums each node's kids' pre (lib/partition.cpp:44-46).  Computed by
+    re-running the link build with meetKid accounting.
+    """
+    lo, hi = edges_to_positions(tail, head, seq, max_vid)
+    _, pre = _build_forest_links_pre(lo, hi, len(seq), None, True, impl)
+    return pre
 
 
 def build_forest(tail: np.ndarray, head: np.ndarray, seq: np.ndarray,

@@ -55,8 +55,10 @@ class JxnOptions:
     memory_limit: int = 1 << 30
     width_limit: int = 0  # 0 = unlimited (CLI -w unset)
     find_max_width: bool = False
+    # The reference also declares ``rooting_limit`` (lib/jtree.h:84) but
+    # never reads it outside the option-validity matrix (jtree.h:106) — it
+    # is dead there, so it is deliberately not mirrored here.
     do_rooting: bool = False
-    rooting_limit: int = 0
 
     def effective_width_limit(self) -> int:
         return self.width_limit if self.width_limit > 0 else (1 << 62)

@@ -34,4 +34,13 @@ def read_tree(path: str) -> tuple[np.ndarray, np.ndarray]:
     if end_id > len(rec):
         raise ValueError(f"{path}: end_id {end_id} > {len(rec)} stored nodes")
     rec = rec[:end_id]
-    return rec["parent"].copy(), rec["pst_weight"].copy()
+    parent = rec["parent"].copy()
+    # Reject corrupt trees up front: every parent must be INVALID or a valid
+    # node id (the reference dies on such input via live asserts; downstream
+    # passes here index by parent and must never see an OOB value).
+    bad = (parent != INVALID_JNID) & (parent >= end_id)
+    if bad.any():
+        raise ValueError(
+            f"{path}: corrupt tree — node {int(np.flatnonzero(bad)[0])} has "
+            f"parent {int(parent[bad][0])} >= end_id {end_id}")
+    return parent, rec["pst_weight"].copy()

@@ -67,7 +67,7 @@ def _bind(lib: ctypes.CDLL) -> None:
     lib.sheep_build_forest.restype = ctypes.c_int
     lib.sheep_build_forest.argtypes = [
         _u32p, _u32p, ctypes.c_int64, ctypes.c_int64,
-        ctypes.c_void_p, _u32p, _u32p]
+        ctypes.c_void_p, _u32p, _u32p, ctypes.c_void_p]
     lib.sheep_edges_to_links.restype = ctypes.c_int64
     lib.sheep_edges_to_links.argtypes = [
         _u32p, _u32p, ctypes.c_int64, _u32p, ctypes.c_int64, _u32p, _u32p]
@@ -87,8 +87,10 @@ def available() -> bool:
 
 
 def build_forest_links(lo: np.ndarray, hi: np.ndarray, n: int,
-                       pst: np.ndarray | None = None):
-    """Native elimination-forest build; returns (parent, pst) uint32 [n]."""
+                       pst: np.ndarray | None = None,
+                       compute_pre: bool = False):
+    """Native elimination-forest build; returns (parent, pst) uint32 [n],
+    plus a pre_weight array (lib/jnode.h:174-176) when ``compute_pre``."""
     lib = _load()
     assert lib is not None
     lo = np.ascontiguousarray(lo, dtype=np.uint32)
@@ -99,9 +101,14 @@ def build_forest_links(lo: np.ndarray, hi: np.ndarray, n: int,
     if pst is not None:
         pst = np.ascontiguousarray(pst, dtype=np.uint32)
         pst_ptr = pst.ctypes.data_as(ctypes.c_void_p)
-    rc = lib.sheep_build_forest(lo, hi, len(lo), n, pst_ptr, parent, pst_out)
+    pre_out = np.empty(n, dtype=np.uint32) if compute_pre else None
+    pre_ptr = pre_out.ctypes.data_as(ctypes.c_void_p) if compute_pre else None
+    rc = lib.sheep_build_forest(lo, hi, len(lo), n, pst_ptr, parent, pst_out,
+                                pre_ptr)
     if rc != 0:
         raise RuntimeError(f"sheep_build_forest failed rc={rc}")
+    if compute_pre:
+        return parent, pst_out, pre_out
     return parent, pst_out
 
 
@@ -133,6 +140,10 @@ def forward_partition(parent: np.ndarray, weights: np.ndarray,
         raise ValueError(
             f"max_component {max_component} smaller than the heaviest node; "
             f"request fewer partitions or a larger balance factor")
+    if rc == -3:
+        raise ValueError(
+            "corrupt tree: a parent entry is neither INVALID nor a valid "
+            "node id (malformed .tre input?)")
     if rc < 0:
         raise RuntimeError(f"sheep_forward_partition failed rc={rc}")
     return parts
@@ -144,7 +155,12 @@ def degree_histogram(tail: np.ndarray, head: np.ndarray, n: int) -> np.ndarray:
     tail = np.ascontiguousarray(tail, dtype=np.uint32)
     head = np.ascontiguousarray(head, dtype=np.uint32)
     deg = np.empty(n, dtype=np.int64)
-    lib.sheep_degree_histogram(tail, head, len(tail), n, deg)
+    rc = lib.sheep_degree_histogram(tail, head, len(tail), n, deg)
+    if rc == -3:
+        raise ValueError(
+            f"corrupt edge records: a vid is out of range for n={n}")
+    if rc != 0:
+        raise RuntimeError(f"sheep_degree_histogram failed rc={rc}")
     return deg
 
 

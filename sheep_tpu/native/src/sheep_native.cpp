@@ -47,10 +47,19 @@ extern "C" {
 //   pst_in     [n]  uint32 or NULL; when NULL each link adds 1 to pst[lo]
 //   parent_out [n]  uint32, kInvalid for roots
 //   pst_out    [n]  uint32
+//   pre_out    [n]  uint32 or NULL; when given, filled with the reference's
+//              USE_PRE_WEIGHT accounting (lib/jnode.h:174-176 meetKid): each
+//              tree link (lo -> h) adds 1 to pre[r] where r is lo's
+//              component root *before* h's adoptions — the number of graph
+//              edges between parent(r) and r's subtree.  Unions are deferred
+//              to the end of each h-group to match the reference, which
+//              unifies only in adoptKids after the whole edge scan
+//              (lib/jnode.h:184-188, jtree.cpp:102).
 //   scratch: internally allocates ~ (m + 2n) * 4 bytes.
 int sheep_build_forest(const uint32_t* lo, const uint32_t* hi, int64_t m,
                        int64_t n, const uint32_t* pst_in,
-                       uint32_t* parent_out, uint32_t* pst_out) {
+                       uint32_t* parent_out, uint32_t* pst_out,
+                       uint32_t* pre_out) {
   if (n < 0 || m < 0) return -1;
   for (int64_t i = 0; i < m; ++i)
     if (lo[i] >= (uint64_t)n) return -3;  // malformed link
@@ -75,18 +84,25 @@ int sheep_build_forest(const uint32_t* lo, const uint32_t* hi, int64_t m,
   }
 
   for (int64_t v = 0; v < n; ++v) parent_out[v] = kInvalid;
+  if (pre_out) std::memset(pre_out, 0, sizeof(uint32_t) * (size_t)n);
   std::vector<uint32_t> uf((size_t)n);
   for (int64_t v = 0; v < n; ++v) uf[(size_t)v] = (uint32_t)v;
 
+  std::vector<uint32_t> adopted;
   for (int64_t h = 0; h < n; ++h) {
     const uint32_t hh = (uint32_t)h;
+    adopted.clear();
     for (int64_t i = offs[h]; i < offs[h + 1]; ++i) {
       uint32_t r = uf_find(uf.data(), lo_by_hi[(size_t)i]);
-      if (r != hh) {
+      if (pre_out) ++pre_out[r];
+      if (r != hh && parent_out[r] == kInvalid) {
         parent_out[r] = hh;  // adopt: lib/jnode.h:158-162
-        uf[r] = hh;
+        adopted.push_back(r);
       }
     }
+    // Deferred unify (adoptKids): repeat edges into the same component
+    // within one group keep finding the old root, as in the reference.
+    for (uint32_t r : adopted) uf[r] = hh;
   }
   return 0;
 }
@@ -123,11 +139,15 @@ int64_t sheep_edges_to_links(const uint32_t* tail, const uint32_t* head,
 //   weights  [n] int64 node weights
 //   parts_out[n] int32, filled 0..num_parts-1
 // Returns number of bins opened, or negative on error (-2: a single node
-// outweighs max_component, which would loop forever in the reference).
+// outweighs max_component, which would loop forever in the reference; -3: a
+// parent entry is neither kInvalid nor < n, e.g. a corrupt .tre file — the
+// reference dies on such input via live asserts / .at(), lib/jdata.h:36-40).
 int64_t sheep_forward_partition(const uint32_t* parent, const int64_t* weights,
                                 int64_t n, int64_t max_component,
                                 int32_t* parts_out) {
   constexpr int32_t kNoPart = -1;
+  for (int64_t i = 0; i < n; ++i)
+    if (parent[i] != kInvalid && parent[i] >= (uint64_t)n) return -3;
   std::vector<int64_t> component_below(weights, weights + n);
   for (int64_t i = 0; i < n; ++i) {
     if (weights[i] > max_component) return -2;
@@ -194,10 +214,14 @@ int64_t sheep_forward_partition(const uint32_t* parent, const int64_t* weights,
 
 // Per-vertex degree accumulation for the sequence sort: each record adds 1
 // to both endpoints (undirected-doubled semantics, graph_wrapper.h:87-89).
+// Returns 0, or -3 when a record names a vid >= n (corrupt input; the
+// reference's LLAMA path sizes the table from the real max vid, so an
+// out-of-range vid can only come from a malformed file).
 int sheep_degree_histogram(const uint32_t* tail, const uint32_t* head,
                            int64_t m, int64_t n, int64_t* deg_out) {
   std::memset(deg_out, 0, sizeof(int64_t) * (size_t)n);
   for (int64_t i = 0; i < m; ++i) {
+    if (tail[i] >= (uint64_t)n || head[i] >= (uint64_t)n) return -3;
     ++deg_out[tail[i]];
     ++deg_out[head[i]];
   }

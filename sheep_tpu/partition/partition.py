@@ -28,8 +28,9 @@ class Partition:
     def from_forest(cls, seq: np.ndarray, forest: Forest, num_parts: int,
                     opts: TreePartitionOptions | None = None,
                     strategy: str = "forward",
-                    max_vid: int | None = None) -> "Partition":
-        jparts = partition_forest(forest, num_parts, opts, strategy)
+                    max_vid: int | None = None,
+                    pre: np.ndarray | None = None) -> "Partition":
+        jparts = partition_forest(forest, num_parts, opts, strategy, pre=pre)
         n = int(max_vid) + 1 if max_vid is not None else 0
         n = max(n, (int(seq.max()) + 1) if len(seq) else 0)
         vparts = np.full(n, INVALID_PART, dtype=np.int64)

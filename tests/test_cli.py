@@ -21,7 +21,7 @@ pytestmark = pytest.mark.skipif(not os.path.exists(HEP),
                                 reason="hep-th.dat not bundled")
 
 
-def run_cli(args, timeout=600, env_extra=None):
+def cli_env(env_extra=None):
     env = dict(os.environ)
     env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
     env["JAX_PLATFORMS"] = "cpu"  # the host env may pin a hardware platform
@@ -31,11 +31,26 @@ def run_cli(args, timeout=600, env_extra=None):
                             " --xla_force_host_platform_device_count=8").strip()
     if env_extra:
         env.update(env_extra)
+    return env
+
+
+def run_cli_proc(args, timeout=600, env_extra=None, check=True):
     proc = subprocess.run([sys.executable, "-m", f"sheep_tpu.cli.{args[0]}"]
                           + args[1:], capture_output=True, text=True,
-                          timeout=timeout, env=env, cwd=REPO)
-    assert proc.returncode == 0, proc.stdout + proc.stderr
-    return proc.stdout
+                          timeout=timeout, env=cli_env(env_extra), cwd=REPO)
+    if check:
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+    return proc
+
+
+def run_cli(args, timeout=600, env_extra=None):
+    return run_cli_proc(args, timeout, env_extra).stdout
+
+
+def stable_lines(out):
+    """stdout minus the nondeterministic phase-timing lines."""
+    return [ln for ln in out.splitlines()
+            if " in: " not in ln and " took: " not in ln]
 
 
 def test_degree_sequence_cli(tmp_path):
@@ -127,13 +142,34 @@ def test_path_equivalence_serial_vs_mesh(tmp_path):
 
 
 def test_dist_partition_script(tmp_path):
-    env = dict(os.environ)
-    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
-    env["JAX_PLATFORMS"] = "cpu"
     proc = subprocess.run(
         ["bash", os.path.join(REPO, "scripts", "dist-partition.sh"),
          "-w", "2", "data/hep-th.dat", "2"],
-        capture_output=True, text=True, timeout=600, env=env, cwd=REPO)
+        capture_output=True, text=True, timeout=600, env=cli_env(), cwd=REPO)
     assert proc.returncode == 0, proc.stdout + proc.stderr
     assert "ECV(down): 521" in proc.stdout
     assert "Mapped in" in proc.stdout and "Reduced in" in proc.stdout
+
+
+def test_partition_tree_pre_weight(tmp_path):
+    # -u with -g recomputes the reference's USE_PRE_WEIGHT model from the
+    # graph (lib/partition.cpp:38-48) and must actually shift the weights:
+    # a -u-only partition differs from silently falling back to pst.
+    tre = str(tmp_path / "hep.tre")
+    seq = str(tmp_path / "hep.seq")
+    run_cli(["degree_sequence", HEP, seq])
+    run_cli(["graph2tree", HEP, "-s", seq, "-o", tre])
+    out_pre = run_cli(["partition_tree", "-u", "-g", HEP, seq, tre, "2"])
+    out_pst = run_cli(["partition_tree", "-g", HEP, seq, tre, "2"])
+    assert "Actually created 2 partitions." in out_pre
+    # Timing lines are nondeterministic; the partition/metric lines must
+    # genuinely differ or -u was silently ignored.
+    assert stable_lines(out_pre) != stable_lines(out_pst)
+
+
+def test_graph2tree_l_with_mesh_warns(tmp_path):
+    # -l is superseded by -i/-r (the reference clobbers it with the MPI rank
+    # mapping, graph2tree.cpp:134-143); the CLI must say so on stderr.
+    proc = run_cli_proc(["graph2tree", HEP, "-l", "1/2", "-i", "-r", "-p", "2"])
+    assert "superseded" in proc.stderr
+    assert "Actually created 2 partitions." in proc.stdout

@@ -79,3 +79,13 @@ def test_tree_roundtrip(tmp_path):
     gp, gw = read_tree(p)
     np.testing.assert_array_equal(gp, parent)
     np.testing.assert_array_equal(gw, pst)
+
+
+def test_read_tree_rejects_corrupt_parent(tmp_path):
+    import pytest
+
+    path = str(tmp_path / "bad.tre")
+    write_tree(path, np.array([1, 999, INVALID_JNID], dtype=np.uint32),
+               np.zeros(3, dtype=np.uint32))
+    with pytest.raises(ValueError, match="corrupt"):
+        read_tree(path)

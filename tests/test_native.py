@@ -125,3 +125,69 @@ def test_degree_sequence_counting_sort(seed):
     nat = native.degree_sequence_from_degrees(deg)
     ref = degree_sequence_from_degrees(deg, impl="python")
     np.testing.assert_array_equal(nat, ref)
+
+
+def test_forward_partition_corrupt_parent_raises():
+    # A parent entry that is neither INVALID nor < n (e.g. from a corrupt
+    # .tre file) must be rejected, not dereferenced (sheep_native.cpp rc=-3;
+    # the reference dies on such input via live asserts, lib/jdata.h:36-40).
+    parent = np.array([1, 7, INVALID_JNID], dtype=np.uint32)
+    w = np.ones(3, dtype=np.int64)
+    with pytest.raises(ValueError, match="corrupt"):
+        native.forward_partition(parent, w, 10)
+
+
+def test_degree_histogram_out_of_range_vid_raises():
+    tail = np.array([0, 99], dtype=np.uint32)
+    head = np.array([1, 1], dtype=np.uint32)
+    with pytest.raises(ValueError, match="out of range"):
+        native.degree_histogram(tail, head, 50)
+
+
+def _pre_oracle(tail, head, seq):
+    # Brute force meetKid semantics (lib/jnode.h:174-176): replay the
+    # reference's sequential insert with unions deferred per vertex.
+    pos = {int(v): i for i, v in enumerate(seq)}
+    n = len(seq)
+    uf = list(range(n))
+
+    def find(x):
+        while uf[x] != x:
+            x = uf[x]
+        return x
+
+    pre = np.zeros(n, dtype=np.uint32)
+    parent = np.full(n, -1, dtype=np.int64)
+    adj = {}
+    for t, h in zip(tail.tolist(), head.tolist()):
+        if t == h or t not in pos or h not in pos:
+            continue
+        a, b = pos[t], pos[h]
+        lo, hi = min(a, b), max(a, b)
+        adj.setdefault(hi, []).append(lo)
+    for h in range(n):
+        adopted = []
+        for lo in adj.get(h, []):
+            r = find(lo)
+            pre[r] += 1
+            if r != h and parent[r] == -1:
+                parent[r] = h
+                adopted.append(r)
+        for r in adopted:
+            uf[r] = h
+    return pre
+
+
+@pytest.mark.parametrize("seed", range(8))
+def test_pre_weights_native_python_oracle_agree(seed):
+    from sheep_tpu.core.forest import pre_weights
+    from sheep_tpu.core.sequence import degree_sequence
+
+    rng = np.random.default_rng(700 + seed)
+    tail, head = _rand_graph(rng, 40, 160)
+    seq = degree_sequence(tail, head)
+    ref = _pre_oracle(tail, head, seq)
+    np.testing.assert_array_equal(
+        pre_weights(tail, head, seq, impl="python"), ref)
+    np.testing.assert_array_equal(
+        pre_weights(tail, head, seq, impl="native"), ref)

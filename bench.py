@@ -1,21 +1,32 @@
 """Benchmark: device elimination-forest build throughput (edges/sec).
 
-Prints ONE JSON line.  The metric is end-to-end edges/sec of the fused
-single-chip build step (degree histogram + (degree,vid) sort + edge links +
-forest fixpoint + pst) on an R-MAT power-law graph — the analog of the
-reference's load-free sort+map phases.  ``vs_baseline`` compares against the
-reference's best aggregate MPI throughput on twitter-2010: 1,468,364,884
-edges / 18.7 s map = 78.5M edges/s across 18 ranks (BASELINE.md,
-data/slurm-twitter/slurm-25.avg:15); the north-star target is 10x that.
+Prints ONE JSON line on stdout (the driver contract).  The metric is
+end-to-end edges/sec of the fused single-chip build step (degree histogram +
+(degree,vid) sort + edge links + forest fixpoint + pst) on an R-MAT
+power-law graph — the analog of the reference's load-free sort+map phases.
+``vs_baseline`` compares against the reference's best aggregate MPI
+throughput on twitter-2010: 1,468,364,884 edges / 18.7 s map = 78.5M edges/s
+across 18 ranks (BASELINE.md, data/slurm-twitter/slurm-25.avg:15); the
+north-star target is 10x that.
 
-Sizes are env-tunable: SHEEP_BENCH_LOG_N (default 23), SHEEP_BENCH_EDGE_FACTOR
-(default 8 edges per vertex), SHEEP_BENCH_REPS (default 3).
+Robustness (round-2 lesson: one device fault at the default size produced an
+empty BENCH file): each size runs in its OWN subprocess (``--one``), per-size
+records stream to stderr as they complete, and the final stdout line is the
+largest passing size — annotated with the whole sweep and the first faulting
+size when one faults.  A crash can reduce coverage but can no longer erase
+the result.
+
+Env: SHEEP_BENCH_SIZES (csv of log2 sizes; default "16,18,20,22,23" on
+accelerators, "16,18" on cpu), SHEEP_BENCH_LOG_N (single size override),
+SHEEP_BENCH_EDGE_FACTOR (default 8), SHEEP_BENCH_REPS (default 3),
+SHEEP_BENCH_TIMEOUT (seconds per size, default 900).
 """
 
 from __future__ import annotations
 
 import json
 import os
+import subprocess
 import sys
 import time
 
@@ -24,45 +35,36 @@ import numpy as np
 _BASELINE_EDGES_PER_SEC = 1_468_364_884 / 18.7  # twitter map, 18 MPI ranks
 
 
-def _probe_hardware(timeout_s: int = 180) -> bool:
-    """True when the default JAX backend initializes within the timeout.
+def _probe_hardware(timeout_s: int = 180) -> str | None:
+    """The default backend's platform name, or None when it won't come up.
 
     A tunneled TPU plugin can hang backend init indefinitely when the
     tunnel is down; probing in a subprocess lets the benchmark fall back
     to CPU (clearly labeled) instead of hanging the driver.
     """
-    import subprocess
-
     try:
         proc = subprocess.run(
             [sys.executable, "-c",
              "import jax; print(jax.devices()[0].platform)"],
             capture_output=True, text=True, timeout=timeout_s)
-        return proc.returncode == 0
     except subprocess.TimeoutExpired:
-        return False
+        return None
+    if proc.returncode != 0:
+        return None
+    lines = proc.stdout.strip().splitlines()
+    return lines[-1] if lines else None
 
 
-def main() -> None:
+def _run_one(log_n: int) -> dict:
+    """Measure one size in this process; returns the result record."""
     from sheep_tpu.cli.common import ensure_jax_platform
-    ensure_jax_platform()  # honor JAX_PLATFORMS even under a forced plugin
-    fell_back = False
-    if os.environ.get("JAX_PLATFORMS", "") != "cpu" \
-            and not os.environ.get("SHEEP_BENCH_NO_PROBE") \
-            and not _probe_hardware():
-        print("bench: hardware backend unreachable; falling back to CPU",
-              file=sys.stderr)
-        os.environ["JAX_PLATFORMS"] = "cpu"
-        ensure_jax_platform()
-        fell_back = True
+    ensure_jax_platform()
     import jax
     import jax.numpy as jnp
     from sheep_tpu.ops import build_step
     from sheep_tpu.utils import rmat_edges
 
     platform = jax.devices()[0].platform
-    on_accel = platform not in ("cpu",)
-    log_n = int(os.environ.get("SHEEP_BENCH_LOG_N", "23" if on_accel else "18"))
     factor = int(os.environ.get("SHEEP_BENCH_EDGE_FACTOR", "8"))
     reps = int(os.environ.get("SHEEP_BENCH_REPS", "3"))
     n = 1 << log_n
@@ -73,11 +75,9 @@ def main() -> None:
     t = jax.device_put(jnp.asarray(tail, jnp.int32))
     h = jax.device_put(jnp.asarray(head, jnp.int32))
 
-    # warmup / compile
-    out = build_step(t, h, n)
+    out = build_step(t, h, n)  # warmup / compile
     jax.block_until_ready(out)
     rounds = int(out[5])
-    print(f"bench: fixpoint rounds={rounds}", file=sys.stderr)
 
     times = []
     for _ in range(reps):
@@ -87,16 +87,97 @@ def main() -> None:
         times.append(time.perf_counter() - t0)
     best = min(times)
     eps = e / best
-    print(f"bench: times={['%.3f' % x for x in times]} best={best:.3f}s",
-          file=sys.stderr)
+    return {"log_n": log_n, "edges": e, "platform": platform,
+            "rounds": rounds, "best_s": round(best, 4),
+            "times": [round(x, 4) for x in times],
+            "edges_per_sec": round(eps, 1),
+            "vs_baseline": round(eps / _BASELINE_EDGES_PER_SEC, 4)}
+
+
+def main() -> None:
+    if len(sys.argv) > 2 and sys.argv[1] == "--one":
+        print(json.dumps(_run_one(int(sys.argv[2]))))
+        return
+
+    from sheep_tpu.cli.common import ensure_jax_platform
+    ensure_jax_platform()  # honor JAX_PLATFORMS even under a forced plugin
+    fell_back = False
+    platform = "cpu"
+    if os.environ.get("JAX_PLATFORMS", "") != "cpu" \
+            and not os.environ.get("SHEEP_BENCH_NO_PROBE"):
+        platform = _probe_hardware()
+        if platform is None:
+            print("bench: hardware backend unreachable; falling back to CPU",
+                  file=sys.stderr)
+            os.environ["JAX_PLATFORMS"] = "cpu"
+            fell_back = True
+            platform = "cpu"
+    on_accel = platform != "cpu"
+
+    factor = int(os.environ.get("SHEEP_BENCH_EDGE_FACTOR", "8"))
+    if os.environ.get("SHEEP_BENCH_LOG_N"):
+        sizes = [int(os.environ["SHEEP_BENCH_LOG_N"])]
+    else:
+        default = "16,18,20,22,23" if on_accel else "16,18"
+        sizes = [int(s) for s in
+                 os.environ.get("SHEEP_BENCH_SIZES", default).split(",")]
+    timeout_s = int(os.environ.get("SHEEP_BENCH_TIMEOUT", "900"))
+
+    sweep: list[dict] = []
+    first_fault: dict | None = None
+    for log_n in sizes:
+        try:
+            proc = subprocess.run(
+                [sys.executable, os.path.abspath(__file__),
+                 "--one", str(log_n)],
+                capture_output=True, text=True, timeout=timeout_s)
+        except subprocess.TimeoutExpired:
+            first_fault = {"log_n": log_n, "error": "timeout"}
+            print(f"bench: n=2^{log_n} TIMEOUT after {timeout_s}s",
+                  file=sys.stderr)
+            break
+        sys.stderr.write(proc.stderr)
+        if proc.returncode != 0:
+            err = (proc.stderr or "").strip().splitlines()
+            first_fault = {"log_n": log_n,
+                           "error": err[-1][:300] if err else "crash"}
+            print(f"bench: n=2^{log_n} FAULT rc={proc.returncode}",
+                  file=sys.stderr)
+            break
+        try:
+            rec = json.loads(proc.stdout.strip().splitlines()[-1])
+        except (IndexError, ValueError) as exc:
+            first_fault = {"log_n": log_n,
+                           "error": f"unparseable child output: {exc}"}
+            print(f"bench: n=2^{log_n} produced no record", file=sys.stderr)
+            break
+        sweep.append(rec)
+        print(f"bench: n=2^{log_n} -> {rec['edges_per_sec']:.0f} edges/s "
+              f"({rec['rounds']} rounds, best {rec['best_s']}s)",
+              file=sys.stderr)
 
     tag = "_cpu_fallback" if fell_back else ""
-    print(json.dumps({
-        "metric": f"device_build_edges_per_sec_rmat_n2^{log_n}_e{factor}x{tag}",
-        "value": round(eps, 1),
+    if not sweep:
+        # Even a total failure must yield a parseable record.
+        print(json.dumps({
+            "metric": f"device_build_edges_per_sec{tag}",
+            "value": 0.0, "unit": "edges/sec", "vs_baseline": 0.0,
+            "fault": first_fault}))
+        sys.exit(1)
+    top = max(sweep, key=lambda r: r["log_n"])
+    out = {
+        "metric": (f"device_build_edges_per_sec_rmat_n2^{top['log_n']}"
+                   f"_e{factor}x{tag}"),
+        "value": top["edges_per_sec"],
         "unit": "edges/sec",
-        "vs_baseline": round(eps / _BASELINE_EDGES_PER_SEC, 4),
-    }))
+        "vs_baseline": top["vs_baseline"],
+        "sweep": [{k: r[k] for k in
+                   ("log_n", "edges_per_sec", "rounds", "best_s")}
+                  for r in sweep],
+    }
+    if first_fault is not None:
+        out["first_fault"] = first_fault
+    print(json.dumps(out))
 
 
 if __name__ == "__main__":

@@ -2,12 +2,15 @@
 
 Runs ONE (op, log_n) measurement and prints a JSON line; drive it from a
 shell loop with one subprocess per case so a device fault in one op cannot
-take down the sweep.  Edge data is cached in .npy files under /tmp so the
+take down the sweep.  Edge data is cached in .npz files under /tmp so the
 1-core host pays R-MAT generation once per size.
 
-Usage: python scripts/tpu_diag.py OP LOG_N
-Ops: hist order links scatter_min gather_e gather_n sort_e sort_n loop100
-     round fix build
+All measured callables take their arrays as jit ARGUMENTS — closing over
+device arrays embeds them as HLO constants, and the axon tunnel ships the
+compile request over HTTP with a body-size limit (observed: HTTP 413 at
+2^23 with captured 33MB constants).
+
+Usage: python scripts/tpu_diag.py OP LOG_N [EXTRA]
 """
 
 from __future__ import annotations
@@ -34,7 +37,9 @@ def edges(log_n: int, factor: int = 8):
 
 def main() -> None:
     op, log_n = sys.argv[1], int(sys.argv[2])
+    extra = int(sys.argv[3]) if len(sys.argv) > 3 else 0
     n = 1 << log_n
+    import functools
     import jax
     import jax.numpy as jnp
     from jax import lax
@@ -49,55 +54,99 @@ def main() -> None:
     deg = degree_histogram(t, h, n)
     _, pos, _ = degree_order(deg)
     lo, hi = edge_links(t, h, pos, n)
-    lo, hi = jax.block_until_ready((lo, hi))
+    lo, hi, pos = jax.block_until_ready((lo, hi, pos))
     e = lo.shape[0]
+    args = ()
 
     if op == "hist":
-        fn = jax.jit(lambda: degree_histogram(t, h, n))
+        fn, args = jax.jit(
+            functools.partial(degree_histogram, n=n)), (t, h)
     elif op == "order":
-        fn = jax.jit(lambda: degree_order(deg))
+        fn, args = jax.jit(degree_order), (deg,)
     elif op == "links":
-        fn = jax.jit(lambda: edge_links(t, h, pos, n))
+        fn, args = jax.jit(
+            functools.partial(edge_links, n=n)), (t, h, pos)
     elif op == "scatter_min":
-        fn = jax.jit(
-            lambda: jnp.full(n + 1, n, jnp.int32).at[lo].min(hi))
+        fn = jax.jit(lambda a, b: jnp.full(n + 1, n, jnp.int32).at[a].min(b))
+        args = (lo, hi)
     elif op == "gather_e":
-        fn = jax.jit(lambda: pos[lo % n])
+        fn, args = jax.jit(lambda p, a: p[a % n]), (pos, lo)
     elif op == "gather_n":
-        fn = jax.jit(lambda: pos[pos % n])
+        fn, args = jax.jit(lambda p: p[p % n]), (pos,)
     elif op == "sort_e":
-        fn = jax.jit(lambda: lax.sort((lo, hi), num_keys=2))
+        fn = jax.jit(lambda a, b: lax.sort((a, b), num_keys=2))
+        args = (lo, hi)
     elif op == "sort_n":
-        fn = jax.jit(lambda: lax.sort((pos, pos), num_keys=2))
+        fn, args = jax.jit(lambda p: lax.sort((p, p), num_keys=2)), (pos,)
     elif op == "loop100":
         def loop(x):
             return lax.while_loop(
                 lambda s: s[1] < 100,
                 lambda s: (s[0] * 2 - s[0] // 2, s[1] + 1), (x, 0))[0]
-        fn = jax.jit(lambda: loop(pos))
+        fn, args = jax.jit(loop), (pos,)
     elif op == "round":
-        fn = jax.jit(lambda: _round_step(
-            lo, hi, jnp.bool_(False), n, 6))
+        fn = jax.jit(lambda a, b: _round_step(
+            a, b, jnp.bool_(False), n, extra or 6))
+        args = (lo, hi)
+    elif op == "fori":
+        # extra = K rounds in a fori_loop, no sort, no data-dependent cond:
+        # isolates the marginal in-loop cost of one jump round.
+        k = extra or 8
+        def kloops(a, b):
+            def body(_, st):
+                a2, b2, _ = _round_step(st[0], st[1], jnp.bool_(False), n, 6)
+                return (a2, b2, st[2])
+            return lax.fori_loop(0, k, body, (a, b, jnp.int32(0)))
+        fn, args = jax.jit(kloops), (lo, hi)
+    elif op == "while_nosort":
+        # the fixpoint loop with the lax.cond sort branch removed entirely
+        def nosort(a, b):
+            def cond(st):
+                return st[2] > 0
+            def body(st):
+                a2, b2, moved = _round_step(st[0], st[1], jnp.bool_(False),
+                                            n, extra or 6)
+                return (a2, b2, moved, st[3] + 1)
+            st = (a, b, jnp.maximum(jnp.max(a), 1), jnp.int32(0))
+            return lax.while_loop(cond, body, st)
+        fn, args = jax.jit(nosort), (lo, hi)
     elif op == "fix":
-        fn = jax.jit(lambda: forest_fixpoint(lo, hi, n))
+        fn = jax.jit(functools.partial(forest_fixpoint, n=n))
+        args = (lo, hi)
     elif op == "build":
-        fn = jax.jit(lambda: build_step(t, h, n))
+        fn = jax.jit(functools.partial(build_step, n=n))
+        args = (t, h)
     else:
         raise SystemExit(f"unknown op {op}")
 
+    # block_until_ready alone has been observed NOT to wait on this
+    # backend (0.1ms "timings" for 30ms+ ops); force completion by
+    # summing every output to one scalar on device and fetching it.
+    base = fn
+
+    def checked(*a):
+        out = base(*a)
+        leaves = jax.tree_util.tree_leaves(out)
+        return out, sum(jnp.sum(x.astype(jnp.int64)) for x in leaves
+                        if hasattr(x, "astype"))
+
+    fn2 = jax.jit(checked)
     t0 = time.perf_counter()
-    out = jax.block_until_ready(fn())
+    out, chk = fn2(*args)
+    chk = int(chk)
     compile_s = time.perf_counter() - t0
     times = []
     for _ in range(3):
         t0 = time.perf_counter()
-        jax.block_until_ready(fn())
+        _, chk = fn2(*args)
+        chk = int(chk)
         times.append(time.perf_counter() - t0)
-    rec = {"op": op, "log_n": log_n, "e": int(e), "platform": platform,
+    rec = {"op": op, "log_n": log_n, "extra": extra, "e": int(e),
+           "platform": platform, "checksum": chk,
            "compile_s": round(compile_s, 3), "best_s": round(min(times), 4),
            "times": [round(x, 4) for x in times]}
-    if op == "fix":
-        rec["rounds"] = int(out[1])
+    if op in ("fix", "while_nosort"):
+        rec["rounds"] = int(out[-1] if op == "while_nosort" else out[1])
     if op == "build":
         rec["rounds"] = int(out[5])
     print(json.dumps(rec), flush=True)

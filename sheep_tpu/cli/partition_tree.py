@@ -27,6 +27,66 @@ from .common import PhaseClock, print_phase
 
 USAGE = "USAGE: partition_tree [options] input_sequence input_tree parts [parts...]"
 
+#: .dat record count beyond which the evaluate mode streams blocks through
+#: the O(n)-memory evaluator (override: SHEEP_EVAL_STREAM=1 forces it on,
+#: =0 off, SHEEP_EVAL_STREAM_THRESHOLD sets the record count).
+_STREAM_THRESHOLD = 1 << 27
+
+
+def _streamed_eval_wanted(graph_filename: str, sequence_filename: str) -> bool:
+    import os
+
+    if not graph_filename.endswith(".dat") or sequence_filename == "-":
+        return False
+    if os.environ.get("SHEEP_DDUP_GRAPH", "") == "1":
+        # The block reader streams raw records (no load-level dedup), which
+        # would silently change every metric; keep the dense path.
+        return False
+    forced = os.environ.get("SHEEP_EVAL_STREAM")
+    if forced is not None:
+        return forced == "1"
+    threshold = int(os.environ.get("SHEEP_EVAL_STREAM_THRESHOLD",
+                                   _STREAM_THRESHOLD))
+    try:
+        records = os.path.getsize(graph_filename) // 12  # XS1 record size
+    except OSError:
+        return False
+    return records > threshold
+
+
+def _evaluate_streamed(graph_filename, sequence_filename, forest, popts,
+                       pre_weight, parts_args, verbose,
+                       block_edges: int = 1 << 24) -> None:
+    import numpy as np
+
+    from ..core.sequence import sequence_positions
+    from ..io.edges import iter_dat_blocks
+    from ..partition.evaluate import evaluate_partition_streamed
+
+    seq = read_sequence(sequence_filename)
+    if pre_weight:
+        print("warning: -u is unavailable in streamed evaluation "
+              "(pre weights need the in-memory link build); using pst",
+              file=sys.stderr)
+    # One cheap streaming pass for the vid space + record count.
+    mx = len(seq) and int(seq.max())
+    file_edges = 0
+    for t, h in iter_dat_blocks(graph_filename, block_edges):
+        file_edges += len(t)
+        mx = max(mx, int(t.max(initial=0)), int(h.max(initial=0)))
+    pos = sequence_positions(seq, mx).astype(np.int64)
+    factory = lambda: iter_dat_blocks(graph_filename, block_edges)
+    for parts_arg in parts_args:
+        num_parts = int(parts_arg)
+        pclock = PhaseClock()
+        part = Partition.from_forest(seq, forest, num_parts, popts,
+                                     max_vid=mx)
+        if verbose:
+            print(f"Partitioning took: {pclock.phase_seconds():f} seconds")
+        part.print()
+        evaluate_partition_streamed(part.parts, factory, pos, num_parts,
+                                    file_edges).print()
+
 
 def main(argv: list[str] | None = None) -> int:
     argv = sys.argv[1:] if argv is None else argv
@@ -102,23 +162,30 @@ def main(argv: list[str] | None = None) -> int:
             part = Partition.from_forest(seq, forest, num_parts, popts)
             part.print()
     elif output_filename == "":
-        # Partition and evaluate
-        edges = load_edges(graph_filename)
-        seq = degree_sequence(edges.tail, edges.head) \
-            if sequence_filename == "-" else read_sequence(sequence_filename)
-        pre = pre_weights(edges.tail, edges.head, seq,
-                          max_vid=edges.max_vid) if pre_weight else None
-        for parts_arg in args[2:]:
-            num_parts = int(parts_arg)
-            pclock = PhaseClock()
-            part = Partition.from_forest(seq, forest, num_parts, popts,
-                                         max_vid=edges.max_vid, pre=pre)
-            if verbose:
-                print(f"Partitioning took: {pclock.phase_seconds():f} seconds")
-            part.print()
-            evaluate_partition(part.parts, edges.tail, edges.head, seq,
-                               num_parts, max_vid=edges.max_vid,
-                               file_edges=edges.num_edges).print()
+        # Partition and evaluate.  Large .dat graphs stream through the
+        # O(n)-memory evaluator instead of materializing doubled key arrays
+        # (which peak ~50 GB at twitter scale); same numbers either way.
+        if _streamed_eval_wanted(graph_filename, sequence_filename):
+            _evaluate_streamed(graph_filename, sequence_filename, forest,
+                               popts, pre_weight, args[2:], verbose)
+        else:
+            edges = load_edges(graph_filename)
+            seq = degree_sequence(edges.tail, edges.head) \
+                if sequence_filename == "-" else read_sequence(sequence_filename)
+            pre = pre_weights(edges.tail, edges.head, seq,
+                              max_vid=edges.max_vid) if pre_weight else None
+            for parts_arg in args[2:]:
+                num_parts = int(parts_arg)
+                pclock = PhaseClock()
+                part = Partition.from_forest(seq, forest, num_parts, popts,
+                                             max_vid=edges.max_vid, pre=pre)
+                if verbose:
+                    print(f"Partitioning took: {pclock.phase_seconds():f} "
+                          f"seconds")
+                part.print()
+                evaluate_partition(part.parts, edges.tail, edges.head, seq,
+                                   num_parts, max_vid=edges.max_vid,
+                                   file_edges=edges.num_edges).print()
     else:
         # Partition and write per-part edge files
         edges = load_edges(graph_filename)

@@ -173,3 +173,16 @@ def test_graph2tree_l_with_mesh_warns(tmp_path):
     proc = run_cli_proc(["graph2tree", HEP, "-l", "1/2", "-i", "-r", "-p", "2"])
     assert "superseded" in proc.stderr
     assert "Actually created 2 partitions." in proc.stdout
+
+
+def test_partition_tree_streamed_eval_golden(tmp_path):
+    # Forcing the O(n)-memory streamed evaluator must reproduce the golden
+    # hep-th numbers exactly (same metrics as the dense path).
+    tre = str(tmp_path / "hep.tre")
+    seq = str(tmp_path / "hep.seq")
+    run_cli(["degree_sequence", HEP, seq])
+    run_cli(["graph2tree", HEP, "-s", seq, "-o", tre])
+    out = run_cli(["partition_tree", "-g", HEP, seq, tre, "2"],
+                  env_extra={"SHEEP_EVAL_STREAM": "1"})
+    assert "ECV(down): 521" in out
+    assert "edges cut: 2811" in out

@@ -8,6 +8,7 @@ edge shards must reproduce the oracle exactly, for any W, including W that
 does not divide |E| (phantom padding) and W > |components|.
 """
 
+import os
 import numpy as np
 import pytest
 
@@ -95,3 +96,33 @@ def test_hepth_distributed(hep_edges):
     want = build_forest(hep_edges.tail, hep_edges.head, want_seq)
     np.testing.assert_array_equal(forest.parent, want.parent)
     np.testing.assert_array_equal(forest.pst_weight, want.pst_weight)
+
+
+def test_init_distributed_two_process_cpu(tmp_path):
+    """init_distributed (parallel/mesh.py) joins a real 2-process
+    coordination service on CPU — the DCN/multi-host analog of the
+    reference's mpiexec across nodes (data/slurm-uk2007)."""
+    import socket
+    import subprocess
+    import sys
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    coord = f"127.0.0.1:{port}"
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    worker = os.path.join(repo, "tests", "distributed_worker.py")
+    env = dict(os.environ)
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    # one device per process: the mesh must span processes to work at all
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+    procs = [subprocess.Popen(
+        [sys.executable, worker, coord, "2", str(pid), str(tmp_path)],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True, env=env)
+        for pid in range(2)]
+    outs = [p.communicate(timeout=300) for p in procs]
+    for p, (out, err) in zip(procs, outs):
+        assert p.returncode == 0, out + err
+    assert os.path.exists(tmp_path / "ok.0")
+    assert os.path.exists(tmp_path / "ok.1")

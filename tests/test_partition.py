@@ -197,3 +197,37 @@ def test_balance_denominators_truncate(capsys):
     rep.print()
     out = capsys.readouterr().out
     assert "balance: 5 (1.250000%)" in out  # 5 / (9 // 2), not 5 / 4.5
+
+
+@pytest.mark.parametrize("num_parts", [2, 7, 100])
+def test_streamed_evaluator_matches_inmemory(num_parts):
+    # The O(n)-memory bitmap evaluator must be bit-identical to the dense
+    # one, including the >64-part multi-window path (num_parts=100).
+    from sheep_tpu.core.sequence import degree_sequence, sequence_positions
+    from sheep_tpu.partition.evaluate import (evaluate_partition,
+                                              evaluate_partition_streamed)
+
+    rng = np.random.default_rng(42 + num_parts)
+    n = 300
+    e = 1500
+    tail = rng.integers(0, n, e).astype(np.uint32)
+    head = rng.integers(0, n, e).astype(np.uint32)
+    seq = degree_sequence(tail, head)
+    parts = rng.integers(0, num_parts, n).astype(np.int64)
+
+    dense = evaluate_partition(parts, tail, head, seq, num_parts,
+                               max_vid=n - 1, file_edges=e)
+    pos = sequence_positions(seq, n - 1).astype(np.int64)
+
+    def blocks():
+        for a in range(0, e, 64):
+            yield tail[a:a + 64], head[a:a + 64]
+
+    stream = evaluate_partition_streamed(parts, blocks, pos, num_parts, e)
+    assert dense == stream
+
+    # sequence-free overload
+    dense_nf = evaluate_partition(parts, tail, head, None, num_parts,
+                                  max_vid=n - 1, file_edges=e)
+    stream_nf = evaluate_partition_streamed(parts, blocks, None, num_parts, e)
+    assert dense_nf == stream_nf

@@ -3,8 +3,9 @@
 Streams the edge file without building adjacency (the reference's
 fileSequence, lib/sequence.h:95-128 — the out-of-memory path), writes the
 sequence, prints ``Sorted in: Nms``.  Binary ``.dat`` files stream through
-a memmap block iterator so only the degree array is resident; text files
-fall back to an eager load.
+a memmap block iterator, text ``.net`` files through a chunked token
+parser — only the degree array is resident either way (the reference
+streams both formats, readerwriter.h suffix dispatch at sequence.h:124-128).
 """
 
 from __future__ import annotations
@@ -15,7 +16,7 @@ import sys
 import numpy as np
 
 from ..core.sequence import degree_sequence, degree_sequence_from_degrees
-from ..io.edges import iter_dat_blocks, load_edges
+from ..io.edges import iter_dat_blocks, iter_net_blocks, load_edges
 from ..io.seqfile import write_sequence
 from .common import PhaseClock, print_phase_ms
 
@@ -25,9 +26,11 @@ _BLOCK = 1 << 24  # 16M records (~192MB) per streamed block
 def _streamed_sequence(path: str) -> np.ndarray:
     from ..core.sequence import host_degree_histogram
 
+    blocks = iter_dat_blocks(path, _BLOCK) if path.endswith(".dat") \
+        else iter_net_blocks(path)
     deg = np.zeros(0, dtype=np.int64)
     n = 0
-    for tail, head in iter_dat_blocks(path, _BLOCK):
+    for tail, head in blocks:
         n_blk = int(max(tail.max(initial=0), head.max(initial=0))) + 1
         n = max(n, n_blk)
         if n > len(deg):  # geometric growth: amortized O(n) total copying
@@ -46,8 +49,8 @@ def main(argv: list[str] | None = None) -> int:
         print("USAGE: degree_sequence graph_file output_file", end="")
         return 1
     clock = PhaseClock()
-    if argv[0].endswith(".dat") and \
-            os.environ.get("SHEEP_DDUP_GRAPH", "") != "1":
+    if os.environ.get("SHEEP_DDUP_GRAPH", "") != "1":
+        # both formats stream (dedup needs the whole edge set in memory)
         seq = _streamed_sequence(argv[0])
     else:
         edges = load_edges(argv[0])

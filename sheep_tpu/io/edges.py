@@ -188,6 +188,54 @@ def iter_dat_blocks(path: str, block_edges: int, part: int = 0,
             np.ascontiguousarray(rec["head"])
 
 
+def iter_net_blocks(path: str, block_bytes: int = 1 << 26):
+    """Stream a SNAP ``.net`` text file as (tail, head) uint32 blocks.
+
+    The reference's fileSequence streams text files record by record
+    (lib/sequence.h:95-128); here chunks of ~block_bytes are read, split at
+    the last newline, comment lines dropped, and the tokens parsed in bulk.
+    A trailing half-record (odd token count in the whole file) raises like
+    :func:`read_net`.
+    """
+    carry = b""
+    pending = None  # a dangling tail token whose head is in the next chunk
+    with open(path, "rb") as f:
+        while True:
+            chunk = f.read(block_bytes)
+            if not chunk:
+                break
+            buf = carry + chunk
+            cut = buf.rfind(b"\n")
+            if cut < 0:
+                carry = buf
+                continue
+            carry, text = buf[cut + 1:], buf[:cut]
+            if b"#" in text:
+                text = b"\n".join(ln for ln in text.splitlines()
+                                  if not ln.lstrip().startswith(b"#"))
+            toks = text.split()
+            if pending is not None:
+                toks.insert(0, pending)
+                pending = None
+            if len(toks) % 2:
+                pending = toks.pop()
+            if toks:
+                flat = np.array(toks, dtype=np.uint32)
+                yield flat[0::2].copy(), flat[1::2].copy()
+    if carry.strip() and not carry.lstrip().startswith(b"#"):
+        toks = carry.split()
+        if pending is not None:
+            toks.insert(0, pending)
+            pending = None
+        if len(toks) % 2:
+            raise ValueError(f"{path}: odd token count")
+        if toks:
+            flat = np.array(toks, dtype=np.uint32)
+            yield flat[0::2].copy(), flat[1::2].copy()
+    elif pending is not None:
+        raise ValueError(f"{path}: odd token count")
+
+
 def write_dat(path: str, tail: np.ndarray, head: np.ndarray) -> None:
     rec = np.empty(len(tail), dtype=_XS1_DTYPE)
     rec["tail"] = tail

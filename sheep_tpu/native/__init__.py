@@ -80,6 +80,14 @@ def _bind(lib: ctypes.CDLL) -> None:
     lib.sheep_degree_sequence.restype = ctypes.c_int64
     lib.sheep_degree_sequence.argtypes = [
         _i64p, ctypes.c_int64, _u32p]
+    lib.sheep_fennel_vertex.restype = ctypes.c_int
+    lib.sheep_fennel_vertex.argtypes = [
+        _u32p, _u32p, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+        ctypes.c_double, ctypes.c_int, _i64p]
+    lib.sheep_fennel_edges.restype = ctypes.c_int
+    lib.sheep_fennel_edges.argtypes = [
+        _u32p, _u32p, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+        ctypes.c_double, _i64p]
 
 
 def available() -> bool:
@@ -162,6 +170,37 @@ def degree_histogram(tail: np.ndarray, head: np.ndarray, n: int) -> np.ndarray:
     if rc != 0:
         raise RuntimeError(f"sheep_degree_histogram failed rc={rc}")
     return deg
+
+
+def fennel_vertex(tail: np.ndarray, head: np.ndarray, n_vid: int,
+                  num_parts: int, balance_factor: float,
+                  edge_balanced: bool) -> np.ndarray:
+    """Native greedy Fennel vertex partition; int64 [n_vid], -1 invalid."""
+    lib = _load()
+    assert lib is not None
+    tail = np.ascontiguousarray(tail, dtype=np.uint32)
+    head = np.ascontiguousarray(head, dtype=np.uint32)
+    parts = np.empty(n_vid, dtype=np.int64)
+    rc = lib.sheep_fennel_vertex(tail, head, len(tail), n_vid, num_parts,
+                                 balance_factor, int(edge_balanced), parts)
+    if rc != 0:
+        raise ValueError(f"sheep_fennel_vertex failed rc={rc}")
+    return parts
+
+
+def fennel_edges(tail: np.ndarray, head: np.ndarray, n_vid: int,
+                 num_parts: int, balance_factor: float) -> np.ndarray:
+    """Native streaming Fennel edge partition; int64 [num_records]."""
+    lib = _load()
+    assert lib is not None
+    tail = np.ascontiguousarray(tail, dtype=np.uint32)
+    head = np.ascontiguousarray(head, dtype=np.uint32)
+    eparts = np.empty(len(tail), dtype=np.int64)
+    rc = lib.sheep_fennel_edges(tail, head, len(tail), n_vid, num_parts,
+                                balance_factor, eparts)
+    if rc != 0:
+        raise ValueError(f"sheep_fennel_edges failed rc={rc}")
+    return eparts
 
 
 def degree_sequence_from_degrees(deg: np.ndarray) -> np.ndarray | None:

@@ -11,6 +11,7 @@
 // (ctypes-friendly; no pybind11 in this toolchain).  All functions return 0
 // on success, negative on error.
 
+#include <cmath>
 #include <cstdint>
 #include <cstdio>
 #include <cstring>
@@ -246,6 +247,140 @@ int64_t sheep_degree_sequence(const int64_t* deg, int64_t n,
   for (int64_t v = 0; v < n; ++v)
     if (deg[v] > 0) seq_out[offs[deg[v]]++] = (uint32_t)v;
   return total;
+}
+
+// Fennel greedy streaming vertex partitioner (lib/partition.cpp:282-329).
+// Exact semantics of the python oracle (partition/fennel.py): vertices
+// stream in ascending-vid order; score = (neighbors already in part)
+// - a*((s+w)^1.5 - s^1.5); parts considered up to the first empty one;
+// capacity-violating parts are skipped; fallback part 0.
+//
+//   tail/head [m] uint32; parts_out [n_vid] int64 (-1 = INVALID_PART)
+// Returns 0, or -3 on a vid >= n_vid.
+int sheep_fennel_vertex(const uint32_t* tail, const uint32_t* head, int64_t m,
+                        int64_t n_vid, int64_t num_parts,
+                        double balance_factor, int edge_balanced,
+                        int64_t* parts_out) {
+  for (int64_t i = 0; i < m; ++i)
+    if (tail[i] >= (uint64_t)n_vid || head[i] >= (uint64_t)n_vid) return -3;
+
+  // CSR of the undirected-doubled graph via counting sort.
+  std::vector<int64_t> offs((size_t)n_vid + 1, 0);
+  for (int64_t i = 0; i < m; ++i) {
+    ++offs[tail[i] + 1];
+    ++offs[head[i] + 1];
+  }
+  for (int64_t v = 0; v < n_vid; ++v) offs[v + 1] += offs[v];
+  std::vector<uint32_t> dst((size_t)offs[n_vid]);
+  {
+    std::vector<int64_t> cur(offs.begin(), offs.end() - 1);
+    for (int64_t i = 0; i < m; ++i) {
+      dst[(size_t)cur[tail[i]]++] = head[i];
+      dst[(size_t)cur[head[i]]++] = tail[i];
+    }
+  }
+
+  int64_t n_active = 0;
+  for (int64_t v = 0; v < n_vid; ++v)
+    if (offs[v + 1] > offs[v]) ++n_active;
+  for (int64_t v = 0; v < n_vid; ++v) parts_out[v] = -1;
+  if (m == 0 || n_active == 0) return 0;
+
+  const double y = 1.5;
+  const double n = (double)n_active;
+  const double md = (double)(2 * m);
+  const double k = (double)num_parts;
+  const double a = edge_balanced ? n * std::pow(k / md, y)
+                                 : md * (std::pow(k, y - 1.0) / std::pow(n, y));
+  const int64_t total_weight = edge_balanced ? 2 * m : n_active;
+  const double max_component =
+      (double)(total_weight / num_parts) * balance_factor;
+
+  std::vector<double> part_size((size_t)num_parts, 0.0);
+  std::vector<int64_t> nbr_cnt((size_t)num_parts, 0);
+  for (int64_t X = 0; X < n_vid; ++X) {
+    if (offs[X + 1] == offs[X]) continue;
+    const double w = edge_balanced ? (double)(offs[X + 1] - offs[X]) : 1.0;
+    for (int64_t j = offs[X]; j < offs[X + 1]; ++j) {
+      int64_t p = parts_out[dst[(size_t)j]];
+      if (p >= 0) ++nbr_cnt[(size_t)p];
+    }
+    int64_t last = num_parts - 1;
+    for (int64_t p = 0; p < num_parts; ++p)
+      if (part_size[(size_t)p] == 0.0) { last = p; break; }
+    int64_t best = -1;
+    double best_score = 0.0;
+    for (int64_t p = 0; p <= last; ++p) {
+      if (part_size[(size_t)p] + w > max_component) continue;
+      double s = part_size[(size_t)p];
+      double score = (double)nbr_cnt[(size_t)p]
+          - a * (std::pow(s + w, y) - std::pow(s, y));
+      if (best < 0 || score > best_score) { best = p; best_score = score; }
+    }
+    if (best < 0) best = 0;  // reference fallback: max_part = 0
+    parts_out[X] = best;
+    part_size[(size_t)best] += w;
+    for (int64_t j = offs[X]; j < offs[X + 1]; ++j) {
+      int64_t p = parts_out[dst[(size_t)j]];
+      if (p >= 0) --nbr_cnt[(size_t)p];  // cheap reset (only touched slots)
+    }
+    nbr_cnt[(size_t)best] = 0;  // X itself may appear via self-loops
+  }
+  return 0;
+}
+
+// Fennel streaming edge partitioner (lib/partition.cpp:331-407 prototype,
+// slips corrected as in partition/fennel.py).  touches is a per-vertex
+// bitset of ceil(k/64) words.  eparts_out [m] int64.
+int sheep_fennel_edges(const uint32_t* tail, const uint32_t* head, int64_t m,
+                       int64_t n_vid, int64_t num_parts,
+                       double balance_factor, int64_t* eparts_out) {
+  for (int64_t i = 0; i < m; ++i)
+    if (tail[i] >= (uint64_t)n_vid || head[i] >= (uint64_t)n_vid) return -3;
+  if (m == 0) return 0;
+
+  std::vector<uint8_t> seen((size_t)n_vid, 0);
+  int64_t n_active = 0;
+  for (int64_t i = 0; i < m; ++i) {
+    if (!seen[tail[i]]) { seen[tail[i]] = 1; ++n_active; }
+    if (!seen[head[i]]) { seen[head[i]] = 1; ++n_active; }
+  }
+  if (n_active == 0) n_active = 1;
+
+  const double y = 1.5;
+  const double n = (double)n_active;
+  const double md = (double)(2 * m);
+  const double k = (double)num_parts;
+  const double a = md * (std::pow(k, y - 1.0) / std::pow(n, y));
+  const double max_component = (double)(m / num_parts) * balance_factor;
+
+  const int64_t words = (num_parts + 63) / 64;
+  std::vector<uint64_t> touch((size_t)(n_vid * words), 0);
+  std::vector<double> part_size((size_t)num_parts, 0.0);
+
+  for (int64_t i = 0; i < m; ++i) {
+    const uint64_t* tX = &touch[(size_t)(tail[i] * words)];
+    const uint64_t* tY = &touch[(size_t)(head[i] * words)];
+    int64_t last = num_parts - 1;
+    for (int64_t p = 0; p < num_parts; ++p)
+      if (part_size[(size_t)p] == 0.0) { last = p; break; }
+    int64_t best = -1;
+    double best_score = 0.0;
+    for (int64_t p = 0; p <= last; ++p) {
+      if (part_size[(size_t)p] + 1.0 > max_component) continue;
+      double s = part_size[(size_t)p];
+      double value = (double)((tX[p / 64] >> (p % 64)) & 1)
+                   + (double)((tY[p / 64] >> (p % 64)) & 1);
+      double score = value - a * (std::pow(s + 1.0, y) - std::pow(s, y));
+      if (best < 0 || score > best_score) { best = p; best_score = score; }
+    }
+    if (best < 0) best = 0;
+    eparts_out[i] = best;
+    part_size[(size_t)best] += 1.0;
+    touch[(size_t)(tail[i] * words) + best / 64] |= 1ull << (best % 64);
+    touch[(size_t)(head[i] * words) + best / 64] |= 1ull << (best % 64);
+  }
+  return 0;
 }
 
 }  // extern "C"

@@ -6,13 +6,19 @@ from .sort import (
 )
 from .forest import (
     forest_fixpoint,
+    forest_fixpoint_hosted,
+    fixpoint_chunk,
+    reduce_links_hosted,
+    parent_from_links,
     pst_weights,
     merge_parents,
     build_forest_device,
     merge_forests_device,
 )
-from .build import build_step, build_graph_device
-from .stream import (build_graph_streaming, stream_block_step,
+from .build import (build_step, build_graph_device, build_graph_hybrid,
+                    prepare_links)
+from .stream import (build_graph_streaming,
+                     build_graph_streaming_hosted, stream_block_step,
                      streaming_degree_histogram)
 
 __all__ = [
@@ -21,13 +27,20 @@ __all__ = [
     "edge_links",
     "degree_sequence_device",
     "forest_fixpoint",
+    "forest_fixpoint_hosted",
+    "fixpoint_chunk",
+    "reduce_links_hosted",
+    "parent_from_links",
     "pst_weights",
     "merge_parents",
     "build_forest_device",
     "merge_forests_device",
     "build_step",
     "build_graph_device",
+    "build_graph_hybrid",
+    "prepare_links",
     "build_graph_streaming",
+    "build_graph_streaming_hosted",
     "stream_block_step",
     "streaming_degree_histogram",
 ]

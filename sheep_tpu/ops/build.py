@@ -37,20 +37,101 @@ def build_step(tail: jnp.ndarray, head: jnp.ndarray, n: int):
     return seq, pos, m, parent, pst, rounds
 
 
-def build_graph_device(tail: np.ndarray, head: np.ndarray,
-                       num_vertices: int | None = None):
-    """Host-facing fused build: returns (seq uint32 [m], Forest over m)."""
-    n = num_vertices
-    if n is None:
-        n = int(max(tail.max(initial=0), head.max(initial=0))) + 1 if len(tail) else 0
-    if n == 0:
-        return np.empty(0, np.uint32), Forest(
-            np.empty(0, np.uint32), np.empty(0, np.uint32))
-    seq, _, m, parent, pst, _ = build_step(
-        jnp.asarray(tail), jnp.asarray(head), n)
+@functools.partial(jax.jit, static_argnames=("n",))
+def prepare_links(tail: jnp.ndarray, head: jnp.ndarray, n: int):
+    """Phases before the fixpoint, in one dispatch: degree histogram,
+    (degree, vid) sort, edge->link mapping, pst segment-sum.
+
+    Returns (seq, pos, num_active, lo, hi, pst) — pst is computed here
+    because the fixpoint rewrites lo in place and pst must count the
+    *original* links (jtree.cpp:47-49).
+    """
+    deg = degree_histogram(tail, head, n)
+    seq, pos, m = degree_order(deg)
+    lo, hi = edge_links(tail, head, pos, n)
+    pst = pst_weights(lo, n)
+    return seq, pos, m, lo, hi, pst
+
+
+def _finish(seq, m, parent, pst):
     m = int(m)
     seq = np.asarray(seq)[:m].astype(np.uint32)
     # Trimmed to the m active slots; parents of active nodes are active
     # positions (< m), so the converter's n=m sentinel check is exact.
     from .forest import _to_forest
     return seq, _to_forest(np.asarray(parent)[:m], np.asarray(pst)[:m], m)
+
+
+def build_graph_device(tail: np.ndarray, head: np.ndarray,
+                       num_vertices: int | None = None):
+    """Host-facing device build: returns (seq uint32 [m], Forest over m).
+
+    Uses the host-orchestrated chunked fixpoint (ops.forest), which is the
+    production path on real hardware: bounded per-dispatch execution time
+    (no device faults at large n) and live-edge compaction between chunks.
+    """
+    from .forest import forest_fixpoint_hosted
+
+    n = num_vertices
+    if n is None:
+        n = int(max(tail.max(initial=0), head.max(initial=0))) + 1 if len(tail) else 0
+    if n == 0:
+        return np.empty(0, np.uint32), Forest(
+            np.empty(0, np.uint32), np.empty(0, np.uint32))
+    seq, _, m, lo, hi, pst = prepare_links(
+        jnp.asarray(tail), jnp.asarray(head), n)
+    parent, _ = forest_fixpoint_hosted(lo, hi, n)
+    return _finish(seq, m, parent, pst)
+
+
+def build_graph_hybrid(tail: np.ndarray, head: np.ndarray,
+                       num_vertices: int | None = None,
+                       handoff_factor: int = 2):
+    """Flagship heterogeneous build: TPU reduction + native union-find tail.
+
+    The device runs the bandwidth-parallel phases (histogram, degree sort,
+    link mapping, pst, and a few reduction rounds that kill the ~90% of
+    links that are duplicates or star-collapsible); once at most
+    ``handoff_factor * n`` live links remain, they transfer to the host and
+    the C++ runtime finishes with the exact sequential union-find
+    (sheep_native.cpp), which chases pointers at rates no batched device
+    round can match.  Sound because every chunk round preserves threshold
+    connectivity, and the elimination forest is a function of threshold
+    connectivity only (module docstring of ops.forest).
+
+    Returns (seq uint32 [m], Forest over m), bit-identical to the oracle.
+    """
+    from .forest import reduce_links_hosted, parent_from_links
+    from ..core.forest import native_or_none
+
+    n = num_vertices
+    if n is None:
+        n = int(max(tail.max(initial=0), head.max(initial=0))) + 1 if len(tail) else 0
+    if n == 0:
+        return np.empty(0, np.uint32), Forest(
+            np.empty(0, np.uint32), np.empty(0, np.uint32))
+    seq, _, m, lo, hi, pst = prepare_links(
+        jnp.asarray(tail), jnp.asarray(head), n)
+    lo, hi, live, rounds, converged = reduce_links_hosted(
+        lo, hi, n, stop_live=handoff_factor * n)
+    if converged:
+        parent = parent_from_links(lo, hi, n)
+        return _finish(seq, m, parent, pst)
+    native = native_or_none("auto")
+    lo_h = np.asarray(lo[:live])
+    hi_h = np.asarray(hi[:live])
+    keep = lo_h < n  # a few scattered dead slots may remain in the prefix
+    lo_h, hi_h = lo_h[keep], hi_h[keep]
+    pst_h = np.asarray(pst).astype(np.uint32)
+    if native is not None:
+        parent_h, pst_out = native.build_forest_links(
+            lo_h.astype(np.uint32), hi_h.astype(np.uint32), n, pst_h)
+    else:
+        from ..core.forest import build_forest_links
+        forest = build_forest_links(lo_h.astype(np.int64),
+                                    hi_h.astype(np.int64), n, pst=pst_h,
+                                    impl="python")
+        parent_h, pst_out = forest.parent, forest.pst_weight
+    m = int(m)
+    seq_np = np.asarray(seq)[:m].astype(np.uint32)
+    return seq_np, Forest(parent_h[:m].copy(), pst_out[:m].copy())

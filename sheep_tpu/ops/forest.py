@@ -76,15 +76,13 @@ _I32_MAX = np.int32(np.iinfo(np.int32).max)
 _JUMP_LEVELS = 6
 
 
-def _sort_step(lo: jnp.ndarray, hi: jnp.ndarray, n: int):
-    """Star -> chain accelerator.  For a vertex v with up-neighbors
-    h1 < h2 < ... < hk, rewrite edges (v,h2..hk) to (h1,h2), (h2,h3), ...
-    — at any threshold t the connected set {v} + {hj <= t} is unchanged.
-    A pure jump round discovers a hub's chain only one link per round (the
-    f-frontier advances a single vertex); this sorted rewrite flattens the
-    whole star at once, so it runs periodically as an accelerator."""
+def _rewrite_sorted(lo: jnp.ndarray, hi: jnp.ndarray, n: int):
+    """Star -> chain rewrite + dedupe on SORTED (lo, hi) arrays.  For a
+    vertex v with up-neighbors h1 < h2 < ... < hk, rewrites edges
+    (v,h2..hk) to (h1,h2), (h2,h3), ... — at any threshold t the connected
+    set {v} + {hj <= t} is unchanged; exact duplicates die.  Returns
+    (lo, hi, applied_count)."""
     sent = jnp.int32(n)
-    lo, hi = lax.sort((lo, hi), num_keys=2)
     prev_same = jnp.concatenate(
         [jnp.zeros((1,), jnp.bool_), lo[1:] == lo[:-1]])
     prev_hi = jnp.concatenate([jnp.full((1,), sent, jnp.int32), hi[:-1]])
@@ -94,6 +92,30 @@ def _sort_step(lo: jnp.ndarray, hi: jnp.ndarray, n: int):
     dead = lo >= hi
     lo = jnp.where(dead, sent, lo)
     hi = jnp.where(dead, sent, hi)
+    return lo, hi, jnp.sum(applied, dtype=jnp.int32)
+
+
+def _jump(lo: jnp.ndarray, hi: jnp.ndarray, n: int, levels: int):
+    """Binary-lifted pointer jump: advance each lo to its maximal
+    f-ancestor strictly below hi, where f = min up-neighbor over the live
+    links (slot n absorbs sentinels).  Returns (lo, moved_count)."""
+    sent = jnp.int32(n)
+    lo_in = lo
+    f = jnp.full(n + 1, sent, jnp.int32).at[lo].min(hi)
+    tables = [f]
+    for _ in range(levels - 1):
+        tables.append(tables[-1][tables[-1]])
+    for table in reversed(tables):
+        nlo = table[lo]
+        lo = jnp.where(nlo < hi, nlo, lo)
+    return lo, jnp.sum(lo != lo_in, dtype=jnp.int32)
+
+
+def _sort_step(lo: jnp.ndarray, hi: jnp.ndarray, n: int):
+    """Sort + star->chain rewrite (the while_loop kernel's accelerator; a
+    pure jump round discovers a hub's chain only one link per round)."""
+    lo, hi = lax.sort((lo, hi), num_keys=2)
+    lo, hi, _ = _rewrite_sorted(lo, hi, n)
     return lo, hi
 
 
@@ -103,22 +125,10 @@ def _round_step(lo: jnp.ndarray, hi: jnp.ndarray, do_sort: jnp.ndarray,
     at n.  Returns (lo, hi, moved) where ``moved`` counts edges whose lo
     advanced this round; the caller loops while moved > 0 and schedules
     ``do_sort`` at exponentially spaced round indices."""
-    sent = jnp.int32(n)
     lo, hi = lax.cond(do_sort,
                       lambda args: _sort_step(*args, n=n),
                       lambda args: args, (lo, hi))
-    lo_in = lo
-    # f = min up-neighbor over live edges (slot n absorbs sentinels).
-    # Binary lifting: ancestor stride tables f^(2^k), then a greedy
-    # largest-stride-first walk to the maximal f-ancestor strictly below hi.
-    f = jnp.full(n + 1, sent, jnp.int32).at[lo].min(hi)
-    tables = [f]
-    for _ in range(levels - 1):
-        tables.append(tables[-1][tables[-1]])
-    for table in reversed(tables):
-        nlo = table[lo]
-        lo = jnp.where(nlo < hi, nlo, lo)
-    moved = jnp.sum(lo != lo_in, dtype=jnp.int32)
+    lo, moved = _jump(lo, hi, n, levels)
     return lo, hi, moved
 
 
@@ -168,6 +178,128 @@ def forest_fixpoint(lo: jnp.ndarray, hi: jnp.ndarray, n: int,
     lo, hi, _, rounds = lax.while_loop(cond, body, state)
     parent = jnp.full(n + 1, sent, jnp.int32).at[lo].min(hi)[:n]
     return parent, rounds
+
+
+# ---------------------------------------------------------------------------
+# Host-orchestrated chunked fixpoint — the production path on real hardware.
+#
+# The single-dispatch while_loop kernel above is correct but was measured to
+# be the wrong execution shape for the tunneled TPU backend (round-3 device
+# diagnostics, scripts/tpu_diag.py):
+#   - a while_loop execution faults once its wall-time grows past the
+#     backend's per-execution budget (n>=2^20 at 8 edges/vertex), and
+#   - every primitive costs ~the same ~100M elements/s, so the win comes
+#     from shrinking the arrays, not the op count: one sort round kills
+#     85-93% of the edges (duplicates + star collapse) within 2-4 rounds.
+#
+# The chunked driver therefore runs J rounds per dispatch with a
+# data-independent fori_loop (bounded execution time, no faults), reads the
+# live count between chunks, and re-dispatches on sliced arrays.  Measured
+# round structure (scripts/round_proto.py): sort every round + 10-level
+# lifting converges in ~30 rounds at 2^18 vs 42 for the exponential-sort
+# schedule, and live edges drop to ~15% of E by round 2.
+# ---------------------------------------------------------------------------
+
+
+def _chunk_round(lo, hi, n: int, levels: int):
+    """One production round: sort -> chain rewrite -> L-level jump.
+
+    Returns (lo, hi, moved, live) where ``live`` counts non-sentinel edges
+    right after the sort — the tail beyond it is dead in the *output* too
+    (rewrites never resurrect an edge), which is what makes host-side
+    slicing sound.
+    """
+    sent = jnp.int32(n)
+    lo, hi = lax.sort((lo, hi), num_keys=2)
+    live = jnp.sum(lo != sent, dtype=jnp.int32)
+    lo, hi, rewrites = _rewrite_sorted(lo, hi, n)
+    lo, jumped = _jump(lo, hi, n, levels)
+    return lo, hi, rewrites + jumped, live
+
+
+@functools.partial(jax.jit, static_argnames=("n", "levels", "jrounds"))
+def fixpoint_chunk(lo: jnp.ndarray, hi: jnp.ndarray, n: int,
+                   levels: int, jrounds: int):
+    """``jrounds`` chunk rounds in one dispatch (data-independent fori_loop).
+
+    Returns (lo, hi, moved_last_round, live_after_last_sort).
+    """
+    def body(_, st):
+        lo, hi, _, _ = st
+        return _chunk_round(lo, hi, n, levels)
+
+    state = (lo.astype(jnp.int32), hi.astype(jnp.int32),
+             jnp.int32(0), jnp.int32(lo.shape[0]))
+    return lax.fori_loop(0, jrounds, body, state)
+
+
+@functools.partial(jax.jit, static_argnames=("n",))
+def parent_from_links(lo: jnp.ndarray, hi: jnp.ndarray, n: int):
+    """Scatter-min parent extraction (valid once links form a forest)."""
+    sent = jnp.int32(n)
+    return jnp.full(n + 1, sent, jnp.int32).at[lo.astype(jnp.int32)].min(
+        hi.astype(jnp.int32))[:n]
+
+
+def _pad_pow2(x: int, lo_cap: int = 1 << 12) -> int:
+    p = lo_cap
+    while p < x:
+        p <<= 1
+    return p
+
+
+def reduce_links_hosted(lo, hi, n: int, stop_live: int = 0,
+                        levels: int = 10, jrounds: int = 4,
+                        first_levels: int = 4):
+    """Run chunk rounds until convergence (or until live <= stop_live),
+    compacting between dispatches.
+
+    lo/hi: int32 device or host arrays, sentinel n for dead slots.  Returns
+    (lo, hi, live, rounds, converged) with lo/hi on device, all remaining
+    live links in the first ``live`` slots' prefix region (plus possibly a
+    few dead ones — callers must still mask lo < n).
+
+    The first chunk runs a single light round (``first_levels``): it does
+    the bulk dedupe/star-collapse on the full-size arrays, after which
+    compaction makes the deep rounds cheap.
+    """
+    lo = jnp.asarray(lo, jnp.int32)
+    hi = jnp.asarray(hi, jnp.int32)
+    e = int(lo.shape[0])
+    if e == 0:
+        return lo, hi, 0, 0, True
+    pad = _pad_pow2(e)
+    if pad != e:
+        fill = jnp.full(pad - e, n, jnp.int32)
+        lo = jnp.concatenate([lo, fill])
+        hi = jnp.concatenate([hi, fill])
+    rounds = 0
+    first = True
+    while True:
+        j = 1 if first else jrounds
+        lv = first_levels if first else levels
+        lo, hi, moved, live = fixpoint_chunk(lo, hi, n, lv, j)
+        rounds += j
+        moved_i, live_i = int(moved), int(live)  # host sync point
+        first = False
+        if moved_i == 0:
+            return lo, hi, live_i, rounds, True
+        if stop_live and live_i <= stop_live:
+            return lo, hi, live_i, rounds, False
+        target = _pad_pow2(live_i)
+        if target <= lo.shape[0] // 2:
+            lo, hi = lo[:target], hi[:target]
+    # unreachable
+
+
+def forest_fixpoint_hosted(lo, hi, n: int, levels: int = 10,
+                           jrounds: int = 4):
+    """Host-orchestrated fixpoint: the production equivalent of
+    :func:`forest_fixpoint` for real hardware.  Returns (parent int32
+    device array [n] with n marking roots, rounds)."""
+    lo, hi, live, rounds, _ = reduce_links_hosted(
+        lo, hi, n, levels=levels, jrounds=jrounds)
+    return parent_from_links(lo, hi, n), rounds
 
 
 @functools.partial(jax.jit, static_argnames=("n",))

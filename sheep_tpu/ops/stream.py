@@ -36,11 +36,15 @@ def stream_block_step(parent: jnp.ndarray, pst: jnp.ndarray,
     """Fold one edge block into the carry forest.
 
     parent int32 [n] (n = root sentinel), pst int32 [n], tail/head int32 [B]
-    (pad with n), pos int32 [n+1] vid->position with pos[n] = n.
+    (pad with values >= V), pos int32 [V+1] over the FULL vid space (V =
+    max vid + 1, which can far exceed the n active positions — zero-degree
+    vids exist between active ones), absent vids and the pad slot mapped
+    to n.
     """
     sent = jnp.int32(n)
-    pt = pos[jnp.minimum(tail, sent)]
-    ph = pos[jnp.minimum(head, sent)]
+    vid_cap = jnp.int32(pos.shape[0] - 1)
+    pt = pos[jnp.minimum(tail, vid_cap)]
+    ph = pos[jnp.minimum(head, vid_cap)]
     lo = jnp.minimum(pt, ph)
     hi = jnp.maximum(pt, ph)
     # pst: every block edge with a present earlier endpoint, absent-endpoint
@@ -64,24 +68,21 @@ def build_graph_streaming(blocks, n: int, pos: np.ndarray,
                           block_edges: int):
     """Fold an iterator of (tail, head) uint32 blocks into a Forest.
 
-    ``pos``: vid -> position table over n slots (positions of the shared
-    sequence; INVALID for absent vids).  Returns (Forest over n positions,
-    total_rounds).  Memory: O(n + block_edges) device-resident.
+    ``pos``: vid -> position table over the FULL vid space (length >= max
+    vid + 1; INVALID for absent vids).  Returns (Forest over n positions,
+    total_rounds).  Memory: O(n + V + block_edges) device-resident.
     """
     sent = np.int32(n)
-    posx = np.full(n + 1, n, dtype=np.int32)
-    take = min(len(pos), n)
-    p = pos[:take].astype(np.int64)
-    posx[:take] = np.where((p < 0) | (p >= n), n, p).astype(np.int32)
-    pos_d = jnp.asarray(posx)
+    pos_d = jnp.asarray(_full_vid_pos(pos, n))
+    vid_pad = len(pos)  # pad records map to the table's sentinel slot
 
     parent = jnp.full(n, sent, jnp.int32)
     pst = jnp.zeros(n, jnp.int32)
     round_counts = []  # device arrays; summing later keeps dispatch async
     for tail, head in blocks:
         b = len(tail)
-        t = np.full(block_edges, n, dtype=np.int64)
-        h = np.full(block_edges, n, dtype=np.int64)
+        t = np.full(block_edges, vid_pad, dtype=np.int64)
+        h = np.full(block_edges, vid_pad, dtype=np.int64)
         t[:b] = tail
         h[:b] = head
         parent, pst, rounds = stream_block_step(
@@ -93,6 +94,78 @@ def build_graph_streaming(blocks, n: int, pos: np.ndarray,
     out = np.full(n, INVALID_JNID, dtype=np.uint32)
     live = parent_np < n
     out[live] = parent_np[live].astype(np.uint32)
+    return Forest(out, np.asarray(pst).astype(np.uint32)), total_rounds
+
+
+def _full_vid_pos(pos: np.ndarray, n: int) -> np.ndarray:
+    """Sanitize a vid->position table for device use: full vid space plus
+    one trailing sentinel slot; absent/invalid entries map to n."""
+    posx = np.full(len(pos) + 1, n, dtype=np.int32)
+    p = pos.astype(np.int64)
+    posx[:-1] = np.where((p < 0) | (p >= n), n, p).astype(np.int32)
+    return posx
+
+
+@functools.partial(jax.jit, static_argnames=("n",))
+def _block_links(tail, head, pos, n: int):
+    """Map one padded edge block to (lo, hi, pst_block) in one dispatch.
+
+    ``pos``: the _full_vid_pos table ([V+1], sentinel slot last)."""
+    sent = jnp.int32(n)
+    vid_cap = jnp.int32(pos.shape[0] - 1)
+    pt = pos[jnp.minimum(tail, vid_cap)]
+    ph = pos[jnp.minimum(head, vid_cap)]
+    lo = jnp.minimum(pt, ph)
+    hi = jnp.maximum(pt, ph)
+    pst = pst_weights(jnp.where(lo == hi, sent, lo), n)
+    dead = (lo >= hi) | (hi >= sent)
+    return jnp.where(dead, sent, lo), jnp.where(dead, sent, hi), pst
+
+
+def build_graph_streaming_hosted(blocks, n: int, pos: np.ndarray,
+                                 block_edges: int):
+    """Production OOM streaming build: hosted chunked reduction per block.
+
+    Same contract as :func:`build_graph_streaming` but the per-block fold
+    uses the host-orchestrated reducer (ops.forest.reduce_links_hosted):
+    bounded per-dispatch execution time (no device faults at scale) and
+    carry compaction between blocks — the carry is the live link set, at
+    most ~n entries once reduction converges, concatenated with each new
+    block's links.  Returns (Forest over n positions, total_rounds).
+    """
+    from .forest import parent_from_links, reduce_links_hosted
+
+    pos_d = jnp.asarray(_full_vid_pos(pos, n))
+    vid_pad = len(pos)
+
+    carry_lo = carry_hi = None
+    pst = jnp.zeros(n, jnp.int32)
+    total_rounds = 0
+    for tail, head in blocks:
+        b = len(tail)
+        t = np.full(block_edges, vid_pad, dtype=np.int64)
+        h = np.full(block_edges, vid_pad, dtype=np.int64)
+        t[:b] = tail
+        h[:b] = head
+        lo, hi, pst_b = _block_links(
+            jnp.asarray(t, jnp.int32), jnp.asarray(h, jnp.int32), pos_d, n)
+        pst = pst + pst_b
+        if carry_lo is not None:
+            lo = jnp.concatenate([carry_lo, lo])
+            hi = jnp.concatenate([carry_hi, hi])
+        lo, hi, live, rounds, _ = reduce_links_hosted(lo, hi, n)
+        total_rounds += rounds
+        from .forest import _pad_pow2
+        target = _pad_pow2(live)
+        carry_lo, carry_hi = lo[:target], hi[:target]
+    if carry_lo is None:
+        return Forest(np.full(n, INVALID_JNID, np.uint32),
+                      np.zeros(n, np.uint32)), 0
+    parent = parent_from_links(carry_lo, carry_hi, n)
+    parent_np = np.asarray(parent).astype(np.int64)
+    out = np.full(n, INVALID_JNID, dtype=np.uint32)
+    live_mask = parent_np < n
+    out[live_mask] = parent_np[live_mask].astype(np.uint32)
     return Forest(out, np.asarray(pst).astype(np.uint32)), total_rounds
 
 

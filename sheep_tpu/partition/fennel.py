@@ -42,10 +42,22 @@ def _csr(tail: np.ndarray, head: np.ndarray, n: int):
 def fennel_vertex(tail: np.ndarray, head: np.ndarray, num_parts: int,
                   balance_factor: float = 1.03,
                   edge_balanced: bool = True,
-                  max_vid: int | None = None) -> np.ndarray:
-    """vid-indexed parts (INVALID_PART where the vid has no edges)."""
+                  max_vid: int | None = None,
+                  impl: str = "auto") -> np.ndarray:
+    """vid-indexed parts (INVALID_PART where the vid has no edges).
+
+    The python loop below is the semantics oracle; ``impl="auto"`` runs the
+    C++ twin (sheep_native.cpp sheep_fennel_vertex) when built — the
+    reference's competitor table runs on 34M-117M-edge graphs
+    (data/runtimes/bipartition.time), far beyond an interpreter loop.
+    """
     n_vid = int(max_vid) + 1 if max_vid is not None else (
         int(max(tail.max(initial=0), head.max(initial=0))) + 1 if len(tail) else 0)
+    from ..core.forest import native_or_none
+    native = native_or_none(impl)
+    if native is not None and n_vid:
+        return native.fennel_vertex(tail, head, n_vid, num_parts,
+                                    balance_factor, edge_balanced)
     offs, dst = _csr(tail, head, n_vid)
     deg = np.diff(offs)
     active = deg > 0
@@ -89,13 +101,23 @@ def fennel_vertex(tail: np.ndarray, head: np.ndarray, num_parts: int,
 
 def fennel_edges(tail: np.ndarray, head: np.ndarray, num_parts: int,
                  balance_factor: float = 1.03,
-                 max_vid: int | None = None) -> np.ndarray:
-    """Per-edge-record parts (length == number of records)."""
+                 max_vid: int | None = None,
+                 impl: str = "auto") -> np.ndarray:
+    """Per-edge-record parts (length == number of records).
+
+    Python loop = oracle; ``impl="auto"`` dispatches to the C++ twin
+    (sheep_native.cpp sheep_fennel_edges) when built.
+    """
     n_vid = int(max_vid) + 1 if max_vid is not None else (
         int(max(tail.max(initial=0), head.max(initial=0))) + 1 if len(tail) else 0)
     e = len(tail)
     if e == 0:
         return np.empty(0, dtype=np.int64)
+    from ..core.forest import native_or_none
+    native = native_or_none(impl)
+    if native is not None:
+        return native.fennel_edges(tail, head, n_vid, num_parts,
+                                   balance_factor)
     # active-vertex count, consistent with fennel_vertex (sparse vid spaces
     # would otherwise inflate n and weaken the balance penalty)
     deg = np.bincount(tail, minlength=n_vid) + np.bincount(head, minlength=n_vid)

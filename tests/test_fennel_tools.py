@@ -133,3 +133,29 @@ def test_tools_end_to_end(tmp_path):
     np.savetxt(pfile, jparts, fmt="%d")
     out = _run_tool("read_partition", [HEP, pfile])
     assert "ECV(down): 521" in out
+
+
+@pytest.mark.parametrize("seed,num_parts,eb", [(0, 2, True), (1, 2, False),
+                                               (2, 5, True), (3, 7, False),
+                                               (4, 70, True)])
+def test_fennel_vertex_native_matches_python(seed, num_parts, eb):
+    rng = np.random.default_rng(800 + seed)
+    n, e = 120, 600
+    tail = rng.integers(0, n, e).astype(np.uint32)
+    head = rng.integers(0, n, e).astype(np.uint32)
+    py = fennel_vertex(tail, head, num_parts, edge_balanced=eb,
+                       impl="python")
+    nat = fennel_vertex(tail, head, num_parts, edge_balanced=eb,
+                        impl="native")
+    np.testing.assert_array_equal(py, nat)
+
+
+@pytest.mark.parametrize("seed,num_parts", [(0, 2), (1, 5), (2, 70)])
+def test_fennel_edges_native_matches_python(seed, num_parts):
+    rng = np.random.default_rng(850 + seed)
+    n, e = 120, 600
+    tail = rng.integers(0, n, e).astype(np.uint32)
+    head = rng.integers(0, n, e).astype(np.uint32)
+    py = fennel_edges(tail, head, num_parts, impl="python")
+    nat = fennel_edges(tail, head, num_parts, impl="native")
+    np.testing.assert_array_equal(py, nat)

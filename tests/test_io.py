@@ -1,3 +1,5 @@
+import os
+
 import numpy as np
 
 from sheep_tpu import INVALID_JNID
@@ -89,3 +91,53 @@ def test_read_tree_rejects_corrupt_parent(tmp_path):
                np.zeros(3, dtype=np.uint32))
     with pytest.raises(ValueError, match="corrupt"):
         read_tree(path)
+
+
+def test_iter_net_blocks_matches_eager(tmp_path):
+    import pytest
+    from sheep_tpu.io.edges import iter_net_blocks, read_net
+
+    rng = np.random.default_rng(9)
+    tail = rng.integers(0, 500, 4000).astype(np.uint32)
+    head = rng.integers(0, 500, 4000).astype(np.uint32)
+    p = str(tmp_path / "g.net")
+    with open(p, "w") as f:
+        f.write("# comment line\n")
+        for i, (t, h) in enumerate(zip(tail, head)):
+            f.write(f"{t}\t{h}\n")
+            if i == 100:
+                f.write("# interior comment\n")
+    eager = read_net(p)
+    # tiny blocks so records straddle chunk boundaries
+    ts, hs = [], []
+    for t, h in iter_net_blocks(p, block_bytes=97):
+        ts.append(t)
+        hs.append(h)
+    np.testing.assert_array_equal(np.concatenate(ts), eager.tail)
+    np.testing.assert_array_equal(np.concatenate(hs), eager.head)
+
+
+def test_streamed_net_sequence_cli(tmp_path):
+    import subprocess
+    import sys as _sys
+    from sheep_tpu.core.sequence import degree_sequence
+    from sheep_tpu.io.edges import read_net
+    from sheep_tpu.io.seqfile import read_sequence
+
+    rng = np.random.default_rng(10)
+    tail = rng.integers(0, 300, 2000).astype(np.uint32)
+    head = rng.integers(0, 300, 2000).astype(np.uint32)
+    p = str(tmp_path / "g.net")
+    with open(p, "w") as f:
+        for t, h in zip(tail, head):
+            f.write(f"{t} {h}\n")
+    out = str(tmp_path / "g.seq")
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    proc = subprocess.run(
+        [_sys.executable, "-m", "sheep_tpu.cli.degree_sequence", p, out],
+        capture_output=True, text=True, env=env, cwd=repo)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    np.testing.assert_array_equal(read_sequence(out),
+                                  degree_sequence(tail, head))

@@ -10,7 +10,11 @@ and the bundled hep-th graph.
 import numpy as np
 import pytest
 
+import jax.numpy as jnp
+
 from conftest import random_multigraph
+
+from sheep_tpu import INVALID_JNID
 
 from sheep_tpu.core import (
     build_forest, degree_sequence, merge_forests, edges_to_positions,
@@ -168,3 +172,56 @@ def test_hepth_fixpoint_rounds(hep_edges):
     _, rounds = forest_fixpoint(jnp.asarray(lo, jnp.int32),
                                 jnp.asarray(hi, jnp.int32), len(seq))
     assert int(rounds) < 64, f"hep-th took {int(rounds)} fixpoint rounds"
+
+
+@pytest.mark.parametrize("seed", range(10))
+def test_hosted_fixpoint_matches_oracle(seed):
+    # The chunked host-orchestrated fixpoint (production path on hardware)
+    # must produce the oracle parent array exactly.
+    from sheep_tpu.ops.forest import forest_fixpoint_hosted
+
+    rng = np.random.default_rng(900 + seed)
+    tail, head = random_multigraph(rng, 80, 400)
+    seq = degree_sequence(tail, head)
+    want = build_forest(tail, head, seq, impl="python")
+    from sheep_tpu.core.forest import edges_to_positions
+    lo, hi = edges_to_positions(tail, head, seq)
+    n = len(seq)
+    pst_only = hi >= n
+    lo_d = np.where(pst_only, n, lo)
+    hi_d = np.where(pst_only, n, hi)
+    parent, rounds = forest_fixpoint_hosted(
+        jnp.asarray(lo_d, jnp.int32), jnp.asarray(hi_d, jnp.int32), n)
+    parent = np.asarray(parent).astype(np.int64)
+    got = np.full(n, INVALID_JNID, dtype=np.uint32)
+    got[parent < n] = parent[parent < n].astype(np.uint32)
+    np.testing.assert_array_equal(got, want.parent)
+
+
+@pytest.mark.parametrize("seed,handoff", [(0, 2), (1, 2), (2, 1), (3, 1000)])
+def test_build_graph_hybrid_matches_oracle(seed, handoff):
+    # handoff=1000 exercises the handoff branch immediately (stop_live
+    # huge -> first chunk hands off); small handoffs converge on device.
+    from sheep_tpu.ops import build_graph_hybrid
+
+    rng = np.random.default_rng(950 + seed)
+    tail, head = random_multigraph(rng, 200, 1200)
+    want_seq = degree_sequence(tail, head)
+    want = build_forest(tail, head, want_seq)
+    seq, forest = build_graph_hybrid(tail, head, handoff_factor=handoff)
+    np.testing.assert_array_equal(seq, want_seq)
+    np.testing.assert_array_equal(forest.parent, want.parent)
+    np.testing.assert_array_equal(forest.pst_weight, want.pst_weight)
+
+
+def test_build_graph_device_rmat_oracle():
+    from sheep_tpu.ops import build_graph_device
+    from sheep_tpu.utils import rmat_edges
+
+    tail, head = rmat_edges(12, 4 << 12, seed=3)
+    want_seq = degree_sequence(tail, head)
+    want = build_forest(tail, head, want_seq)
+    seq, forest = build_graph_device(tail, head)
+    np.testing.assert_array_equal(seq, want_seq)
+    np.testing.assert_array_equal(forest.parent, want.parent)
+    np.testing.assert_array_equal(forest.pst_weight, want.pst_weight)

@@ -76,3 +76,49 @@ def test_streaming_end_to_end_hepth(hep_edges):
     m = len(seq)
     np.testing.assert_array_equal(forest.parent[:m], want.parent)
     np.testing.assert_array_equal(forest.pst_weight[:m], want.pst_weight)
+
+
+@pytest.mark.parametrize("blocksize", [7, 64, 1000])
+def test_streaming_hosted_matches_whole(blocksize):
+    from sheep_tpu.ops.stream import build_graph_streaming_hosted
+
+    rng = np.random.default_rng(77)
+    tail, head = random_multigraph(rng, 150, 900)
+    seq = degree_sequence(tail, head)
+    want = build_forest(tail, head, seq)
+    pos = sequence_positions(seq, int(max(tail.max(), head.max())))
+
+    def blocks():
+        for a in range(0, len(tail), blocksize):
+            yield tail[a:a + blocksize], head[a:a + blocksize]
+
+    forest, rounds = build_graph_streaming_hosted(
+        blocks(), len(seq), pos.astype(np.int64), blocksize)
+    np.testing.assert_array_equal(forest.parent, want.parent)
+    np.testing.assert_array_equal(forest.pst_weight, want.pst_weight)
+
+
+@pytest.mark.parametrize("hosted", [False, True])
+def test_streaming_sparse_vid_space(hosted):
+    # Regression: vids far beyond the active count (zero-degree gaps) must
+    # keep their positions — the pos table covers the vid space, not just
+    # the n active slots.
+    from sheep_tpu.ops import (build_graph_streaming,
+                               build_graph_streaming_hosted)
+
+    rng = np.random.default_rng(55)
+    vids = rng.choice(5000, size=60, replace=False).astype(np.uint32)
+    tail = rng.choice(vids, 300).astype(np.uint32)
+    head = rng.choice(vids, 300).astype(np.uint32)
+    seq = degree_sequence(tail, head)
+    want = build_forest(tail, head, seq)
+    pos = sequence_positions(seq, 4999).astype(np.int64)
+
+    def blocks():
+        for a in range(0, len(tail), 37):
+            yield tail[a:a + 37], head[a:a + 37]
+
+    fn = build_graph_streaming_hosted if hosted else build_graph_streaming
+    forest, _ = fn(blocks(), len(seq), pos, 37)
+    np.testing.assert_array_equal(forest.parent, want.parent)
+    np.testing.assert_array_equal(forest.pst_weight, want.pst_weight)

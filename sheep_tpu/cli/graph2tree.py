@@ -189,8 +189,11 @@ def main(argv: list[str] | None = None) -> int:
             given_seq = read_sequence(sequence_filename)
         # -i without -r: save exactly `workers` partial trees for the
         # file-path reduce tournament (reference rank-suffixed %02dr0.tre
-        # naming, graph2tree.cpp:146-149).  Partials are built host-side
-        # over contiguous record ranges — bit-identical to mesh shards.
+        # naming, graph2tree.cpp:146-149).  When the worker count fits the
+        # mesh, partials are built on device in one SPMD dispatch (each
+        # mesh shard is a partial graph); with more workers than devices
+        # the host builds partial_range slices instead (the reference's
+        # OOM regime, where ranks outnumber cores too).
         map_only = (use_mesh_sort and not use_mesh_reduce
                     and output_filename != "" and partitions == 0)
         if map_only:
@@ -199,19 +202,30 @@ def main(argv: list[str] | None = None) -> int:
             seq = given_seq if given_seq is not None else \
                 degree_sequence_device(edges.tail, edges.head)
             _finish_sort(seq, use_mesh_sort, sequence_filename, clock)
-            forest = None
             max_vid = edges.max_vid
-            for w in range(workers):
-                a, b = partial_range(edges.num_edges, w + 1, workers)
-                f = build_forest(edges.tail[a:b], edges.head[a:b], seq,
-                                 max_vid=max_vid)
-                write_tree(f"{output_filename}{w:02d}r0.tre",
-                           f.parent, f.pst_weight)
-                if forest is None:
-                    # -f/-c/-t report worker 0's partial view, like the
-                    # reference's rank 0 with its partial graph load.
-                    forest = f
-                    a0, b0 = a, b
+            if workers <= len(jax.devices()) and len(edges.tail):
+                from ..parallel.build import map_graph_distributed
+                _, partials = map_graph_distributed(
+                    edges.tail, edges.head, num_workers=workers, seq=seq)
+                for w, f in enumerate(partials):
+                    write_tree(f"{output_filename}{w:02d}r0.tre",
+                               f.parent, f.pst_weight)
+                # -f/-c/-t report worker 0's partial view, like the
+                # reference's rank 0 with its partial graph load.
+                forest = partials[0]
+                shard = -(-len(edges.tail) // workers)
+                a0, b0 = 0, min(shard, len(edges.tail))
+            else:
+                forest = None
+                for w in range(workers):
+                    a, b = partial_range(edges.num_edges, w + 1, workers)
+                    f = build_forest(edges.tail[a:b], edges.head[a:b], seq,
+                                     max_vid=max_vid)
+                    write_tree(f"{output_filename}{w:02d}r0.tre",
+                               f.parent, f.pst_weight)
+                    if forest is None:
+                        forest = f
+                        a0, b0 = a, b
             edges = EdgeList(edges.tail[a0:b0], edges.head[a0:b0],
                              file_edges=edges.file_edges, start=a0)
         else:

@@ -250,7 +250,11 @@ def build_jxn_tree(tail: np.ndarray, head: np.ndarray, seq: np.ndarray,
                 pvids.append(nbr)
         pst_weight.append(pw)
         if opts.make_pst:
-            pst_tbl.append(np.unique(np.asarray(pvids, dtype=np.int64)))
+            pvids_u = np.unique(np.asarray(pvids, dtype=np.int64))
+            # the reference's arena charges tail-phase pst allocations too
+            # (newPst -> JDataTable, jtree.cpp:168,177)
+            check_mem(len(pvids_u))
+            pst_tbl.append(pvids_u)
         # jxn is the trivially-shrinking remaining set (jtree.cpp:182-186);
         # only materialized (and charged against memory_limit) in jxn mode.
         if opts.make_jxn:
@@ -282,8 +286,26 @@ def _finish(parent, pst_weight, out_seq, kids_tbl, pst_tbl, jxn_tbl,
 
 
 def build_forest_jxn(tail: np.ndarray, head: np.ndarray, seq: np.ndarray,
-                     opts: JxnOptions):
-    """CLI adapter: returns (forest, effective_seq, widths-or-None)."""
+                     opts: JxnOptions, impl: str = "auto"):
+    """CLI adapter: returns (forest, effective_seq, widths-or-None).
+
+    Dispatches to the C++ twin (sheep_native.cpp sheep_jxn_build) when
+    built — the reference runs -kejx on million-vertex graphs, far beyond
+    the python oracle's reach.  The oracle (build_jxn_tree) additionally
+    materializes the kids/pst/jxn tables for tests and library callers.
+    """
+    from .forest import native_or_none
+    native = native_or_none(impl)
+    if native is not None:
+        n_vid = int(max(tail.max(initial=0), head.max(initial=0))) \
+            if len(tail) else -1
+        n_vid = max(n_vid + 1, int(seq.max(initial=0)) + 1 if len(seq) else 0)
+        parent, pst, out_seq, widths = native.jxn_build(
+            tail, head, seq, n_vid, opts.width_limit, opts.memory_limit,
+            opts.make_pad, opts.make_pst, opts.make_jxn,
+            opts.find_max_width, opts.do_rooting)
+        forest = Forest(parent, pst)
+        return forest, out_seq, (widths if opts.make_jxn else None)
     tree = build_jxn_tree(tail, head, seq, opts)
     widths = tree.widths if opts.make_jxn else None
     return tree.forest, tree.seq, widths

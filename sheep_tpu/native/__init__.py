@@ -80,6 +80,11 @@ def _bind(lib: ctypes.CDLL) -> None:
     lib.sheep_degree_sequence.restype = ctypes.c_int64
     lib.sheep_degree_sequence.argtypes = [
         _i64p, ctypes.c_int64, _u32p]
+    lib.sheep_jxn_build.restype = ctypes.c_int64
+    lib.sheep_jxn_build.argtypes = [
+        _u32p, _u32p, ctypes.c_int64, _u32p, ctypes.c_int64,
+        ctypes.c_int64, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+        _u32p, _u32p, _u32p, _i64p]
     lib.sheep_fennel_vertex.restype = ctypes.c_int
     lib.sheep_fennel_vertex.argtypes = [
         _u32p, _u32p, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
@@ -170,6 +175,40 @@ def degree_histogram(tail: np.ndarray, head: np.ndarray, n: int) -> np.ndarray:
     if rc != 0:
         raise RuntimeError(f"sheep_degree_histogram failed rc={rc}")
     return deg
+
+
+def jxn_build(tail: np.ndarray, head: np.ndarray, seq: np.ndarray,
+              n_vid: int, width_limit: int, memory_limit: int,
+              make_pad: bool, make_pst: bool, make_jxn: bool,
+              find_max_width: bool, do_rooting: bool):
+    """Native parameterized jxn insert (sheep_jxn_build).
+
+    Returns (parent, pst, out_seq, widths) trimmed to the effective node
+    count.  Raises MemoryError past memory_limit (rc -4) like the oracle.
+    """
+    lib = _load()
+    assert lib is not None
+    tail = np.ascontiguousarray(tail, dtype=np.uint32)
+    head = np.ascontiguousarray(head, dtype=np.uint32)
+    seq = np.ascontiguousarray(seq, dtype=np.uint32)
+    cap = len(seq)
+    parent = np.empty(cap, dtype=np.uint32)
+    pst = np.empty(cap, dtype=np.uint32)
+    out_seq = np.empty(cap, dtype=np.uint32)
+    widths = np.empty(cap, dtype=np.int64)
+    flags = (1 * make_pad) | (4 * make_pst) | (8 * make_jxn) | \
+        (16 * find_max_width) | (32 * do_rooting)
+    n_out = lib.sheep_jxn_build(tail, head, len(tail), seq, cap, n_vid,
+                                width_limit, memory_limit, flags,
+                                parent, pst, out_seq, widths)
+    if n_out == -4:
+        raise MemoryError(
+            f"pst/jxn tables exceed memory_limit={memory_limit}")
+    if n_out < 0:
+        raise ValueError(f"sheep_jxn_build failed rc={n_out}")
+    k = int(n_out)
+    return (parent[:k].copy(), pst[:k].copy(), out_seq[:k].copy(),
+            widths[:k].copy())
 
 
 def fennel_vertex(tail: np.ndarray, head: np.ndarray, n_vid: int,

@@ -186,3 +186,35 @@ def test_partition_tree_streamed_eval_golden(tmp_path):
                   env_extra={"SHEEP_EVAL_STREAM": "1"})
     assert "ECV(down): 521" in out
     assert "edges cut: 2811" in out
+
+
+def test_graph2tree_map_only_empty_graph(tmp_path):
+    # Regression: the device map-only branch must handle an empty graph
+    # (falls back to the host loop, which writes one empty partial per
+    # worker) instead of crashing.
+    import numpy as np
+    from sheep_tpu.io.edges import write_dat
+
+    empty = str(tmp_path / "empty.dat")
+    write_dat(empty, np.empty(0, np.uint32), np.empty(0, np.uint32))
+    out = run_cli(["graph2tree", empty, "-i", "-o", str(tmp_path / "e")],
+                  env_extra={"SHEEP_WORKERS": "2"})
+    assert os.path.exists(tmp_path / "e00r0.tre")
+    assert os.path.exists(tmp_path / "e01r0.tre")
+
+
+def test_graph2tree_map_only_worker0_view_consistent(tmp_path):
+    # -i -f -c report worker 0's partial view; with the device map the
+    # reported facts/validation must describe the written 00r0.tre partial.
+    seq = str(tmp_path / "hep.seq")
+    run_cli(["degree_sequence", HEP, seq])
+    out = run_cli(["graph2tree", HEP, "-i", "-s", seq, "-c", "-f",
+                   "-o", str(tmp_path / "w")], env_extra={"SHEEP_WORKERS": "2"})
+    assert "Tree is valid." in out
+    from sheep_tpu.core.facts import compute_facts
+    from sheep_tpu.core.forest import Forest
+    from sheep_tpu.io.trefile import read_tree
+    parent, pst = read_tree(str(tmp_path / "w00r0.tre"))
+    facts = compute_facts(Forest(parent, pst))
+    assert f"verts:{facts.vert_cnt}" in out
+    assert f"edges:{facts.edge_cnt}" in out

@@ -114,3 +114,69 @@ def test_memory_limit_enforced():
         build_jxn_tree(tail, head, seq,
                        JxnOptions(make_kids=True, make_pst=True,
                                   make_jxn=True, memory_limit=8))
+
+
+@pytest.mark.parametrize("seed", range(6))
+@pytest.mark.parametrize("kw", [
+    dict(),                                        # default insert
+    dict(make_kids=True, make_pst=True, make_jxn=True),
+    dict(make_kids=True, make_pst=True, make_jxn=True, width_limit=4),
+    dict(make_kids=True, make_pst=True, make_jxn=True, width_limit=6,
+         find_max_width=True),
+    dict(make_kids=True, make_pst=True, make_jxn=True, do_rooting=True),
+    dict(make_pst=True, width_limit=5),            # pst-only deferral
+])
+def test_jxn_native_matches_python(seed, kw):
+    from sheep_tpu.core.jxn import JxnOptions, build_forest_jxn
+
+    rng = np.random.default_rng(600 + seed)
+    tail, head = random_multigraph(rng, 40, 170)
+    opts = JxnOptions(**kw)
+    f_py, seq_py, w_py = build_forest_jxn(tail, head,
+                                          degree_sequence(tail, head),
+                                          opts, impl="python")
+    f_nat, seq_nat, w_nat = build_forest_jxn(tail, head,
+                                             degree_sequence(tail, head),
+                                             opts, impl="native")
+    np.testing.assert_array_equal(seq_nat, seq_py)
+    np.testing.assert_array_equal(f_nat.parent, f_py.parent)
+    np.testing.assert_array_equal(f_nat.pst_weight, f_py.pst_weight)
+    if w_py is None:
+        assert w_nat is None
+    else:
+        np.testing.assert_array_equal(w_nat, w_py)
+
+
+def test_jxn_native_memory_limit_raises():
+    from sheep_tpu.core.jxn import JxnOptions, build_forest_jxn
+
+    rng = np.random.default_rng(1234)
+    tail, head = random_multigraph(rng, 60, 400)
+    opts = JxnOptions(make_kids=True, make_pst=True, make_jxn=True,
+                      memory_limit=16)
+    for impl in ("python", "native"):
+        with pytest.raises(MemoryError):
+            build_forest_jxn(tail, head, degree_sequence(tail, head), opts,
+                             impl=impl)
+
+
+def test_jxn_tail_memory_accounting_parity():
+    # Differential case from review: a tight memory_limit whose budget is
+    # crossed only by TAIL-phase pst allocations must behave identically in
+    # both implementations (the reference's arena charges the tail too,
+    # jtree.cpp:168,177).
+    from sheep_tpu.core.jxn import JxnOptions, build_forest_jxn
+
+    rng = np.random.default_rng(77)
+    tail, head = random_multigraph(rng, 14, 20)
+    seq = degree_sequence(tail, head)
+    for limit in range(0, 200, 4):
+        opts = JxnOptions(make_pst=True, width_limit=2, memory_limit=limit)
+        outcomes = []
+        for impl in ("python", "native"):
+            try:
+                f, s, _ = build_forest_jxn(tail, head, seq, opts, impl=impl)
+                outcomes.append(("ok", f.parent.tolist(), s.tolist()))
+            except MemoryError:
+                outcomes.append(("memerr",))
+        assert outcomes[0] == outcomes[1], (limit, outcomes)

@@ -97,6 +97,27 @@ def _run_one(log_n: int) -> dict:
         return build_graph_hybrid(tail, head, n)  # host Forest: synced
 
     rec = {"log_n": log_n, "edges": e, "platform": platform}
+
+    # transparency: the pure host-native path (graph2tree's serial build),
+    # recorded but never the headline — the headline must exercise the
+    # accelerator
+    from sheep_tpu.core.forest import build_forest, native_or_none
+    from sheep_tpu.core.sequence import degree_sequence
+    if native_or_none("auto") is not None:
+        def host_build():  # same scope as device/hybrid: sort + links + UF
+            seq_host = degree_sequence(tail, head)
+            build_forest(tail, head, seq_host, max_vid=n - 1)
+
+        host_build()  # warmup (page in edge arrays, build the .so)
+        host_times = []
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            host_build()
+            host_times.append(time.perf_counter() - t0)
+        host_s = min(host_times)
+        rec["host_native"] = {"best_s": round(host_s, 4),
+                              "edges_per_sec": round(e / host_s, 1)}
+
     for name, fn in (("device", device_build), ("hybrid", hybrid_build)):
         out = fn()  # warmup / compile (all chunk shapes)
         times = []

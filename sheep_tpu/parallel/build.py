@@ -89,6 +89,12 @@ def _sharded_build(tail, head, given_pos, n: int, do_merge: bool = True):
         return seq, pos, m, parents, psts, lax.pmax(map_rounds, AXIS)
 
     # --- reduce: associative merge of the partial forests ---
+    # NOTE: this in-jit while_loop fixpoint is fine for the merge's input
+    # (<= W*n tree links, most of which are already final) but on the
+    # tunneled TPU backend very long data-dependent loops fault (see
+    # ops/forest.py); at multi-chip scale the merge should move to the
+    # chunked hosted driver between shard_map sections.  Single-chip
+    # hardware runs use ops.build / the hosted driver and never enter here.
     parents = lax.all_gather(parent_local, AXIS)  # [W, n]
     kid = jnp.broadcast_to(jnp.arange(n, dtype=jnp.int32), parents.shape)
     live = parents < n

@@ -122,3 +122,36 @@ def test_streaming_sparse_vid_space(hosted):
     forest, _ = fn(blocks(), len(seq), pos, 37)
     np.testing.assert_array_equal(forest.parent, want.parent)
     np.testing.assert_array_equal(forest.pst_weight, want.pst_weight)
+
+
+def test_streaming_composes_with_logical_workers():
+    # SURVEY §2 OOM row: streaming must compose with worker parallelism.
+    # W logical workers each stream their own partial edge range in blocks
+    # (the file path's map phase in OOM mode, more partials than cores);
+    # merging the W carried forests must equal the whole-graph tree.
+    from sheep_tpu.core.forest import merge_forests
+    from sheep_tpu.io.edges import partial_range
+    from sheep_tpu.ops.stream import build_graph_streaming_hosted
+
+    rng = np.random.default_rng(88)
+    tail, head = random_multigraph(rng, 200, 1400)
+    seq = degree_sequence(tail, head)
+    want = build_forest(tail, head, seq)
+    pos = sequence_positions(seq, int(max(tail.max(), head.max())))
+    workers, blocksize = 3, 53
+
+    partials = []
+    for w in range(workers):
+        a, b = partial_range(len(tail), w + 1, workers)
+
+        def blocks(a=a, b=b):
+            for s in range(a, b, blocksize):
+                e = min(s + blocksize, b)
+                yield tail[s:e], head[s:e]
+
+        f, _ = build_graph_streaming_hosted(
+            blocks(), len(seq), pos.astype(np.int64), blocksize)
+        partials.append(f)
+    merged = merge_forests(*partials)
+    np.testing.assert_array_equal(merged.parent, want.parent)
+    np.testing.assert_array_equal(merged.pst_weight, want.pst_weight)

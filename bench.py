@@ -17,7 +17,7 @@ size when one faults.  A crash can reduce coverage but can no longer erase
 the result.
 
 Env: SHEEP_BENCH_SIZES (csv of log2 sizes; default "16,18,20,22,23" on
-accelerators, "16,18" on cpu), SHEEP_BENCH_LOG_N (single size override),
+accelerators, "16,18,20" on cpu), SHEEP_BENCH_LOG_N (single size override),
 SHEEP_BENCH_EDGE_FACTOR (default 8), SHEEP_BENCH_REPS (default 3),
 SHEEP_BENCH_TIMEOUT (seconds per size, default 900).
 """
@@ -82,7 +82,23 @@ def _run_one(log_n: int) -> dict:
     e = factor * n
 
     print(f"bench: platform={platform} n=2^{log_n} edges={e}", file=sys.stderr)
-    tail, head = rmat_edges(log_n, e, seed=1)
+    # cache the synthetic graph across child processes (generation on the
+    # 1-core host costs ~a minute at 2^23 — real per-size-timeout budget)
+    cache = f"/tmp/rmat_{log_n}_{factor}.npz"
+    try:
+        d = np.load(cache)
+        tail, head = d["tail"], d["head"]
+    except Exception:  # missing, truncated, or foreign file: regenerate
+        try:
+            os.unlink(cache)
+        except OSError:
+            pass
+        tail, head = rmat_edges(log_n, e, seed=1)
+        try:
+            np.savez(f"{cache}.{os.getpid()}", tail=tail, head=head)
+            os.replace(f"{cache}.{os.getpid()}.npz", cache)
+        except OSError:
+            pass
     t = jax.device_put(jnp.asarray(tail, jnp.int32))
     h = jax.device_put(jnp.asarray(head, jnp.int32))
 
@@ -118,7 +134,11 @@ def _run_one(log_n: int) -> dict:
         rec["host_native"] = {"best_s": round(host_s, 4),
                               "edges_per_sec": round(e / host_s, 1)}
 
-    for name, fn in (("device", device_build), ("hybrid", hybrid_build)):
+    # hybrid first: it is the faster path, so if the per-size timeout cuts
+    # the slower pure-device measurement short, the partial record printed
+    # below still carries the headline-capable number (the parent parses
+    # the LAST stdout line).
+    for name, fn in (("hybrid", hybrid_build), ("device", device_build)):
         out = fn()  # warmup / compile (all chunk shapes)
         times = []
         for _ in range(reps):
@@ -133,14 +153,23 @@ def _run_one(log_n: int) -> dict:
             rec[name]["rounds"] = int(out[1])
         print(f"bench: n=2^{log_n} {name}: {e / best:.0f} edges/s "
               f"(best {best:.3f}s)", file=sys.stderr)
-    top = max(("device", "hybrid"), key=lambda k: rec[k]["edges_per_sec"])
+        partial = dict(rec)
+        _headline(partial)
+        print(json.dumps(partial), flush=True)
+    _headline(rec)
+    return rec
+
+
+def _headline(rec: dict) -> None:
+    """Fill the headline fields from whichever accelerator paths exist."""
+    paths = [k for k in ("device", "hybrid") if k in rec]
+    top = max(paths, key=lambda k: rec[k]["edges_per_sec"])
     rec["path"] = top
-    rec["rounds"] = rec["device"].get("rounds", 0)
+    rec["rounds"] = rec.get("device", {}).get("rounds", 0)
     rec["best_s"] = rec[top]["best_s"]
     rec["edges_per_sec"] = rec[top]["edges_per_sec"]
     rec["vs_baseline"] = round(
         rec[top]["edges_per_sec"] / _BASELINE_EDGES_PER_SEC, 4)
-    return rec
 
 
 def main() -> None:
@@ -170,43 +199,75 @@ def main() -> None:
     if os.environ.get("SHEEP_BENCH_LOG_N"):
         sizes = [int(os.environ["SHEEP_BENCH_LOG_N"])]
     else:
-        default = "16,18,20,22,23" if on_accel else "16,18"
+        default = "16,18,20,22,23" if on_accel else "16,18,20"
         sizes = [int(s) for s in
                  os.environ.get("SHEEP_BENCH_SIZES", default).split(",")]
     timeout_s = int(os.environ.get("SHEEP_BENCH_TIMEOUT", "900"))
 
+    def last_record(stdout) -> dict | None:
+        """Newest parseable JSON line — children stream partial records
+        after each measured path, so a timeout/crash mid-size still
+        yields whatever completed."""
+        if isinstance(stdout, bytes):
+            stdout = stdout.decode(errors="replace")
+        for line in reversed((stdout or "").strip().splitlines()):
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            if isinstance(rec, dict) and "edges_per_sec" in rec:
+                return rec
+        return None
+
     sweep: list[dict] = []
     first_fault: dict | None = None
+    progress_path = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "bench_progress.json")
+    try:
+        os.unlink(progress_path)  # never leave a stale sidecar looking live
+    except OSError:
+        pass
     for log_n in sizes:
+        rec = None
         try:
             proc = subprocess.run(
                 [sys.executable, os.path.abspath(__file__),
                  "--one", str(log_n)],
                 capture_output=True, text=True, timeout=timeout_s)
-        except subprocess.TimeoutExpired:
+        except subprocess.TimeoutExpired as exc:
             first_fault = {"log_n": log_n, "error": "timeout"}
             print(f"bench: n=2^{log_n} TIMEOUT after {timeout_s}s",
                   file=sys.stderr)
-            break
-        sys.stderr.write(proc.stderr)
-        if proc.returncode != 0:
-            err = (proc.stderr or "").strip().splitlines()
-            first_fault = {"log_n": log_n,
-                           "error": err[-1][:300] if err else "crash"}
-            print(f"bench: n=2^{log_n} FAULT rc={proc.returncode}",
+            rec = last_record(exc.stdout)
+        else:
+            sys.stderr.write(proc.stderr)
+            rec = last_record(proc.stdout)
+            if proc.returncode != 0:
+                err = (proc.stderr or "").strip().splitlines()
+                first_fault = {"log_n": log_n,
+                               "error": err[-1][:300] if err else "crash"}
+                print(f"bench: n=2^{log_n} FAULT rc={proc.returncode}",
+                      file=sys.stderr)
+            elif rec is None:
+                first_fault = {"log_n": log_n,
+                               "error": "unparseable child output"}
+                print(f"bench: n=2^{log_n} produced no record",
+                      file=sys.stderr)
+        if rec is not None:
+            if first_fault is not None:
+                rec["partial"] = True  # some paths of this size were lost
+            sweep.append(rec)
+            print(f"bench: n=2^{log_n} -> {rec['edges_per_sec']:.0f} edges/s "
+                  f"({rec['rounds']} rounds, best {rec['best_s']}s)",
                   file=sys.stderr)
+            # Sidecar survives the whole benchmark being killed mid-sweep.
+            try:
+                with open(progress_path, "w") as f:
+                    json.dump({"sweep": sweep}, f)
+            except OSError:
+                pass
+        if first_fault is not None:
             break
-        try:
-            rec = json.loads(proc.stdout.strip().splitlines()[-1])
-        except (IndexError, ValueError) as exc:
-            first_fault = {"log_n": log_n,
-                           "error": f"unparseable child output: {exc}"}
-            print(f"bench: n=2^{log_n} produced no record", file=sys.stderr)
-            break
-        sweep.append(rec)
-        print(f"bench: n=2^{log_n} -> {rec['edges_per_sec']:.0f} edges/s "
-              f"({rec['rounds']} rounds, best {rec['best_s']}s)",
-              file=sys.stderr)
 
     tag = "_cpu_fallback" if fell_back else ""
     if not sweep:
@@ -224,7 +285,8 @@ def main() -> None:
         "unit": "edges/sec",
         "vs_baseline": top["vs_baseline"],
         "sweep": [{k: r[k] for k in
-                   ("log_n", "edges_per_sec", "rounds", "best_s", "path")
+                   ("log_n", "edges_per_sec", "rounds", "best_s", "path",
+                    "partial")
                    if k in r}
                   for r in sweep],
     }

@@ -85,10 +85,18 @@ def _run_one(log_n: int) -> dict:
     # cache the synthetic graph across child processes (generation on the
     # 1-core host costs ~a minute at 2^23 — real per-size-timeout budget)
     cache = f"/tmp/rmat_{log_n}_{factor}.npz"
+    tail = head = None
     try:
         d = np.load(cache)
         tail, head = d["tail"], d["head"]
-    except Exception:  # missing, truncated, or foreign file: regenerate
+        # trust nothing from /tmp: wrong length or out-of-range vids mean
+        # a stale/foreign file and would silently skew the published number
+        if len(tail) != e or len(head) != e or \
+                (e and max(int(tail.max()), int(head.max())) >= n):
+            tail = head = None
+    except Exception:  # missing, truncated, or foreign file
+        pass
+    if tail is None:
         try:
             os.unlink(cache)
         except OSError:
@@ -174,7 +182,9 @@ def _headline(rec: dict) -> None:
 
 def main() -> None:
     if len(sys.argv) > 2 and sys.argv[1] == "--one":
-        print(json.dumps(_run_one(int(sys.argv[2]))))
+        # the per-path stream inside _run_one already printed the final
+        # record; printing it again would just duplicate the line
+        _run_one(int(sys.argv[2]))
         return
 
     from sheep_tpu.cli.common import ensure_jax_platform
@@ -236,6 +246,11 @@ def main() -> None:
                 capture_output=True, text=True, timeout=timeout_s)
         except subprocess.TimeoutExpired as exc:
             first_fault = {"log_n": log_n, "error": "timeout"}
+            err = exc.stderr
+            if isinstance(err, bytes):
+                err = err.decode(errors="replace")
+            if err:
+                sys.stderr.write(err)
             print(f"bench: n=2^{log_n} TIMEOUT after {timeout_s}s",
                   file=sys.stderr)
             rec = last_record(exc.stdout)

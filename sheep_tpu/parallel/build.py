@@ -177,6 +177,12 @@ def _run_distributed(tail, head, num_vertices, num_workers, seq, do_merge):
         n = max(n, int(seq.max()) + 1)
     if n == 0:
         return np.empty(0, np.uint32), None, None, 0, 0, mesh.size
+    if mesh.size == 1:
+        # A 1-worker mesh is a plain whole-graph build (merge of one
+        # partial).  Use the chunked hosted kernel: identical results, and
+        # it is the execution shape real hardware needs — the in-jit
+        # while_loop below faults on long runs there (ops/forest.py).
+        return _single_worker_build(tail, head, n, seq, do_merge)
     t, h = _pad_edges(tail, head, n, mesh.size)
     if seq is None:
         dseq, _, m, parent, pst, _ = distributed_build_step(
@@ -192,6 +198,41 @@ def _run_distributed(tail, head, num_vertices, num_workers, seq, do_merge):
         m = len(seq)
         out_seq = np.asarray(seq, dtype=np.uint32)
     return out_seq, parent, pst, n, m, mesh.size
+
+
+def _single_worker_build(tail, head, n, seq, do_merge):
+    """The mesh-of-one case via the hosted kernel (same output contract)."""
+    from ..core.sequence import sequence_positions
+    from ..ops.build import prepare_links
+    from ..ops.forest import forest_fixpoint_hosted
+
+    # vids are < n < 2^31: cast straight to int32, no int64 staging copy
+    # (two 8-byte staging arrays would cost ~2GB at the 134M-edge scale)
+    t = jnp.asarray(np.asarray(tail), jnp.int32)
+    h = jnp.asarray(np.asarray(head), jnp.int32)
+    if seq is None:
+        dseq, pos, m, lo, hi, pst = prepare_links(t, h, n)
+        m = int(m)
+        out_seq = np.asarray(dseq)[:m].astype(np.uint32)
+    else:
+        from ..ops.forest import pst_weights as pst_w
+        from ..ops.sort import edge_links
+        pos_np = sequence_positions(seq, n - 1).astype(np.int64)
+        pos_np = np.where((pos_np < 0) | (pos_np >= n), n, pos_np)
+        pos_d = jnp.asarray(pos_np, jnp.int32)
+        lo, hi = edge_links(t, h, pos_d, n)
+        # links to absent vids count toward pst but not the fixpoint
+        pst = pst_w(jnp.where(lo == hi, jnp.int32(n), lo), n)
+        dead = hi >= jnp.int32(n)
+        lo = jnp.where(dead, jnp.int32(n), lo)
+        hi = jnp.where(dead, jnp.int32(n), hi)
+        m = len(seq)
+        out_seq = np.asarray(seq, dtype=np.uint32)
+    parent, _ = forest_fixpoint_hosted(lo, hi, n)
+    if not do_merge:
+        parent = parent[None, :]
+        pst = pst[None, :]
+    return out_seq, parent, pst, n, m, 1
 
 
 def build_graph_distributed(tail: np.ndarray, head: np.ndarray,

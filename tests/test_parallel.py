@@ -126,3 +126,29 @@ def test_init_distributed_two_process_cpu(tmp_path):
         assert p.returncode == 0, out + err
     assert os.path.exists(tmp_path / "ok.0")
     assert os.path.exists(tmp_path / "ok.1")
+
+
+@pytest.mark.parametrize("with_seq", [False, True])
+@pytest.mark.parametrize("do_merge", [False, True])
+def test_single_worker_mesh_matches_oracle(with_seq, do_merge):
+    # A 1-worker mesh routes through the hosted kernel (the shard_map
+    # while_loop faults on real hardware); results must be unchanged.
+    from sheep_tpu.parallel import (build_graph_distributed,
+                                    map_graph_distributed)
+
+    rng = np.random.default_rng(4242)
+    tail, head = random_multigraph(rng, 120, 700)
+    want_seq = degree_sequence(tail, head)
+    want = build_forest(tail, head, want_seq)
+    seq_arg = want_seq if with_seq else None
+    if do_merge:
+        seq, forest = build_graph_distributed(tail, head, num_workers=1,
+                                              seq=seq_arg)
+        forests = [forest]
+    else:
+        seq, forests = map_graph_distributed(tail, head, num_workers=1,
+                                             seq=seq_arg)
+        assert len(forests) == 1
+    np.testing.assert_array_equal(seq, want_seq)
+    np.testing.assert_array_equal(forests[0].parent, want.parent)
+    np.testing.assert_array_equal(forests[0].pst_weight, want.pst_weight)

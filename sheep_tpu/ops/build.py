@@ -113,11 +113,18 @@ def build_graph_hybrid(tail: np.ndarray, head: np.ndarray,
     from ..core.forest import native_or_none
 
     if handoff_factor is None:
-        # 8 is tuned for the C++ union-find; the pure-python fallback loop
-        # pays per link, so without the native runtime keep reducing on
-        # device down to 2n before handing off.
+        # Tuned per platform: on cpu the "transfer" is free, so hand off as
+        # early as possible (8n ~ after the first dedupe round; measured
+        # 3.3x faster than reducing to 2n).  On a real accelerator the
+        # handoff is a device->host copy over the link (0.5GB at 2^23 for
+        # 8n), so reduce further first.  The pure-python fallback pays per
+        # link: keep reducing to 2n without the native runtime.
         from ..core.forest import native_or_none as _non
-        default = "8" if _non("auto") is not None else "2"
+        if _non("auto") is None:
+            default = "2"
+        else:
+            import jax
+            default = "8" if jax.devices()[0].platform == "cpu" else "3"
         handoff_factor = int(os.environ.get("SHEEP_HANDOFF_FACTOR", default))
     n = num_vertices
     if n is None:

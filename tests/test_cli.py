@@ -218,3 +218,21 @@ def test_graph2tree_map_only_worker0_view_consistent(tmp_path):
     facts = compute_facts(Forest(parent, pst))
     assert f"verts:{facts.vert_cnt}" in out
     assert f"edges:{facts.edge_cnt}" in out
+
+
+def test_make_parallel_harness_smoke(tmp_path):
+    # The L7 benchmark harness (data/make-parallel.sh) greps the phase-line
+    # grammar into .raw/.dat/.avg tables; one worker sweep on hep-th must
+    # produce non-empty tables (the stdout grammar is an API, SURVEY §5).
+    env = cli_env({"SHEEP_BENCH_GRAPHS": "data/hep-th.dat",
+                   "SHEEP_BENCH_WORKERS": "1 2",
+                   "RDIR": str(tmp_path)})
+    proc = subprocess.run(
+        ["bash", os.path.join(REPO, "data", "make-parallel.sh"),
+         "-m", "-p", "-t", "1"],
+        capture_output=True, text=True, timeout=900, env=env, cwd=REPO)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    raw = (tmp_path / "hep-th.raw").read_text()
+    assert "Mapped" in raw or "Partitioned" in raw, raw[:500]
+    avg = (tmp_path / "hep-th.avg").read_text().strip()
+    assert len(avg.splitlines()) == 2  # one row per worker count

@@ -236,3 +236,14 @@ def test_make_parallel_harness_smoke(tmp_path):
     assert "Mapped" in raw or "Partitioned" in raw, raw[:500]
     avg = (tmp_path / "hep-th.avg").read_text().strip()
     assert len(avg.splitlines()) == 2  # one row per worker count
+
+
+def test_dist_partition_vertical_mode(tmp_path):
+    # -a selects the vertical/affinity path (vertical-dist.sh + workers):
+    # same golden quality as the horizontal path on hep-th.
+    proc = subprocess.run(
+        ["bash", os.path.join(REPO, "scripts", "dist-partition.sh"),
+         "-a", "-w", "2", "data/hep-th.dat", "2"],
+        capture_output=True, text=True, timeout=600, env=cli_env(), cwd=REPO)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "ECV(down): 521" in proc.stdout

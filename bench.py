@@ -19,7 +19,9 @@ the result.
 Env: SHEEP_BENCH_SIZES (csv of log2 sizes; default "16,18,20,22,23" on
 accelerators, "16,18,20" on cpu), SHEEP_BENCH_LOG_N (single size override),
 SHEEP_BENCH_EDGE_FACTOR (default 8), SHEEP_BENCH_REPS (default 3),
-SHEEP_BENCH_TIMEOUT (seconds per size, default 900).
+SHEEP_BENCH_TIMEOUT (seconds per size, default 1500 — tunneled-backend
+compiles run 30-130s per program and each size is a fresh process, so a
+persistent jax compilation cache is also enabled under /tmp).
 """
 
 from __future__ import annotations
@@ -212,7 +214,12 @@ def main() -> None:
         default = "16,18,20,22,23" if on_accel else "16,18,20"
         sizes = [int(s) for s in
                  os.environ.get("SHEEP_BENCH_SIZES", default).split(",")]
-    timeout_s = int(os.environ.get("SHEEP_BENCH_TIMEOUT", "900"))
+    timeout_s = int(os.environ.get("SHEEP_BENCH_TIMEOUT", "1500"))
+    # amortize the slow per-process compiles across children (harmless
+    # where the backend ignores the persistent cache); per-user path so a
+    # foreign-owned dir on a shared host can't silently disable it
+    os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
+                          f"/tmp/jax_cache_{os.getuid()}")
 
     def last_record(stdout) -> dict | None:
         """Newest parseable JSON line — children stream partial records

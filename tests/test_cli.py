@@ -141,14 +141,24 @@ def test_path_equivalence_serial_vs_mesh(tmp_path):
         assert open(tmp_path / name, "rb").read() == serial, name
 
 
-def test_dist_partition_script(tmp_path):
+@pytest.mark.parametrize("mode", ["horizontal", "vertical"])
+def test_dist_partition_script(mode):
+    # -a selects the vertical/affinity path (vertical-dist.sh + workers);
+    # its workers emit the fixed "Reduced in 0.0 seconds." line
+    # (vertical-worker.sh:29), which the horizontal path never prints —
+    # asserting it pins that -a actually took the vertical path.
+    flags = ["-a"] if mode == "vertical" else []
     proc = subprocess.run(
-        ["bash", os.path.join(REPO, "scripts", "dist-partition.sh"),
-         "-w", "2", "data/hep-th.dat", "2"],
+        ["bash", os.path.join(REPO, "scripts", "dist-partition.sh")]
+        + flags + ["-w", "2", "data/hep-th.dat", "2"],
         capture_output=True, text=True, timeout=600, env=cli_env(), cwd=REPO)
     assert proc.returncode == 0, proc.stdout + proc.stderr
     assert "ECV(down): 521" in proc.stdout
     assert "Mapped in" in proc.stdout and "Reduced in" in proc.stdout
+    if mode == "vertical":
+        assert "Reduced in 0.0 seconds." in proc.stdout
+    else:
+        assert "Reduced in 0.0 seconds." not in proc.stdout
 
 
 def test_partition_tree_pre_weight(tmp_path):
@@ -236,14 +246,3 @@ def test_make_parallel_harness_smoke(tmp_path):
     assert "Mapped" in raw or "Partitioned" in raw, raw[:500]
     avg = (tmp_path / "hep-th.avg").read_text().strip()
     assert len(avg.splitlines()) == 2  # one row per worker count
-
-
-def test_dist_partition_vertical_mode(tmp_path):
-    # -a selects the vertical/affinity path (vertical-dist.sh + workers):
-    # same golden quality as the horizontal path on hep-th.
-    proc = subprocess.run(
-        ["bash", os.path.join(REPO, "scripts", "dist-partition.sh"),
-         "-a", "-w", "2", "data/hep-th.dat", "2"],
-        capture_output=True, text=True, timeout=600, env=cli_env(), cwd=REPO)
-    assert proc.returncode == 0, proc.stdout + proc.stderr
-    assert "ECV(down): 521" in proc.stdout

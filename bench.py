@@ -216,10 +216,14 @@ def main() -> None:
                  os.environ.get("SHEEP_BENCH_SIZES", default).split(",")]
     timeout_s = int(os.environ.get("SHEEP_BENCH_TIMEOUT", "1500"))
     # amortize the slow per-process compiles across children (harmless
-    # where the backend ignores the persistent cache); per-user path so a
-    # foreign-owned dir on a shared host can't silently disable it
-    os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
-                          f"/tmp/jax_cache_{os.getuid()}")
+    # where the backend ignores the persistent cache); under $HOME, not a
+    # guessable /tmp path a foreign user could pre-own or poison
+    cache_dir = os.path.join(os.path.expanduser("~"), ".cache", "sheep_jax")
+    try:
+        os.makedirs(cache_dir, exist_ok=True)
+        os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", cache_dir)
+    except OSError:
+        pass
 
     def last_record(stdout) -> dict | None:
         """Newest parseable JSON line — children stream partial records

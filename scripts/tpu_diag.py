@@ -39,6 +39,10 @@ def main() -> None:
     op, log_n = sys.argv[1], int(sys.argv[2])
     extra = int(sys.argv[3]) if len(sys.argv) > 3 else 0
     n = 1 << log_n
+    # honor JAX_PLATFORMS even though the sitecustomize force-registers
+    # the hardware plugin (whose dead tunnel would hang backend init)
+    from sheep_tpu.cli.common import ensure_jax_platform
+    ensure_jax_platform()
     import functools
     import jax
     import jax.numpy as jnp
@@ -113,6 +117,25 @@ def main() -> None:
     elif op == "fix":
         fn = jax.jit(functools.partial(forest_fixpoint, n=n))
         args = (lo, hi)
+    elif op == "hosted":
+        # the production chunked driver (not jittable as a whole: it is
+        # host-orchestrated); extra = jrounds per chunk
+        from sheep_tpu.ops.forest import forest_fixpoint_hosted
+
+        def hosted(a, b):
+            parent, rounds = forest_fixpoint_hosted(
+                a, b, n, jrounds=extra or 4)
+            import jax.numpy as _jnp
+            return _jnp.max(parent), rounds  # scalar forces completion
+        fn, args = hosted, (lo, hi)
+    elif op == "hybrid":
+        # flagship build end-to-end; extra = SHEEP_HANDOFF_FACTOR override
+        from sheep_tpu.ops import build_graph_hybrid
+
+        def hybrid():
+            return build_graph_hybrid(tail, head, n,
+                                      handoff_factor=extra or None)
+        fn, args = lambda *_: hybrid(), (lo, hi)
     elif op == "build":
         fn = jax.jit(functools.partial(build_step, n=n))
         args = (t, h)
@@ -122,25 +145,43 @@ def main() -> None:
     # block_until_ready alone has been observed NOT to wait on this
     # backend (0.1ms "timings" for 30ms+ ops); force completion by
     # summing every output to one scalar on device and fetching it.
-    base = fn
+    if op in ("hosted", "hybrid"):
+        # host-orchestrated paths: not jittable as a whole; they already
+        # end in a scalar fetch / host arrays, so plain timing is honest
+        def materialize(out):
+            leaves = jax.tree_util.tree_leaves(out)
+            return int(sum(int(jnp.sum(x)) if hasattr(x, "astype") else 0
+                           for x in leaves if hasattr(x, "astype")) or 0)
 
-    def checked(*a):
-        out = base(*a)
-        leaves = jax.tree_util.tree_leaves(out)
-        return out, sum(jnp.sum(x.astype(jnp.int64)) for x in leaves
-                        if hasattr(x, "astype"))
-
-    fn2 = jax.jit(checked)
-    t0 = time.perf_counter()
-    out, chk = fn2(*args)
-    chk = int(chk)
-    compile_s = time.perf_counter() - t0
-    times = []
-    for _ in range(3):
         t0 = time.perf_counter()
-        _, chk = fn2(*args)
+        chk = materialize(fn(*args))
+        compile_s = time.perf_counter() - t0
+        times = []
+        for _ in range(3):
+            t0 = time.perf_counter()
+            chk = materialize(fn(*args))
+            times.append(time.perf_counter() - t0)
+        out = None
+    else:
+        base = fn
+
+        def checked(*a):
+            out = base(*a)
+            leaves = jax.tree_util.tree_leaves(out)
+            return out, sum(jnp.sum(x.astype(jnp.int64)) for x in leaves
+                            if hasattr(x, "astype"))
+
+        fn2 = jax.jit(checked)
+        t0 = time.perf_counter()
+        out, chk = fn2(*args)
         chk = int(chk)
-        times.append(time.perf_counter() - t0)
+        compile_s = time.perf_counter() - t0
+        times = []
+        for _ in range(3):
+            t0 = time.perf_counter()
+            _, chk = fn2(*args)
+            chk = int(chk)
+            times.append(time.perf_counter() - t0)
     rec = {"op": op, "log_n": log_n, "extra": extra, "e": int(e),
            "platform": platform, "checksum": chk,
            "compile_s": round(compile_s, 3), "best_s": round(min(times), 4),

@@ -1,0 +1,95 @@
+"""Phase-level wall-clock breakdown of build_graph_hybrid on one size.
+
+Usage: python scripts/hybrid_profile.py LOG_N [HANDOFF_FACTOR]
+
+Prints one JSON line with per-phase seconds for the SECOND run (first run
+pays compiles).  Phases: h2d (edge transfer), prep (prepare_links),
+reduce (chunk rounds incl. between-chunk syncs), d2h (link fetch),
+native (C++ union-find tail + Forest build).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+from scripts.tpu_diag import edges  # cached R-MAT
+
+
+def main() -> None:
+    log_n = int(sys.argv[1])
+    factor = int(sys.argv[2]) if len(sys.argv) > 2 else 0
+    n = 1 << log_n
+    from sheep_tpu.cli.common import ensure_jax_platform
+    ensure_jax_platform()
+    import jax
+    import jax.numpy as jnp
+    from sheep_tpu.ops.build import prepare_links
+    from sheep_tpu.ops.forest import reduce_links_hosted, parent_from_links
+    from sheep_tpu.core.forest import native_or_none
+
+    platform = jax.devices()[0].platform
+    tail, head = edges(log_n)
+    if not factor:
+        factor = 8 if platform == "cpu" else 3
+
+    def one(record: dict | None):
+        def mark(key, t0):
+            t1 = time.perf_counter()
+            if record is not None:
+                record[key] = round(t1 - t0, 4)
+            return t1
+
+        t0 = time.perf_counter()
+        t = jax.device_put(jnp.asarray(tail, jnp.int32))
+        h = jax.device_put(jnp.asarray(head, jnp.int32))
+        jnp.max(t[:1]).block_until_ready()
+        t0 = mark("h2d", t0)
+        seq, _, m, lo, hi, pst = prepare_links(t, h, n)
+        int(jnp.max(lo[:1]) + jnp.max(hi[:1]))  # scalar fetch: sync
+        t0 = mark("prep", t0)
+        lo, hi, live, rounds, converged = reduce_links_hosted(
+            lo, hi, n, stop_live=factor * n)
+        if record is not None:
+            record["rounds"] = rounds
+            record["live"] = int(live)
+            record["converged"] = bool(converged)
+        t0 = mark("reduce", t0)
+        # same 64K-granular cut as build_graph_hybrid (exact [:live] slices
+        # would compile a fresh XLA program per live value).  NOTE: the
+        # production path also overlaps the seq/pst fetch with the reduce
+        # loop via a prefetch thread — this breakdown serializes it, so
+        # d2h here is an upper bound on production's visible fetch time.
+        cut = min(int(lo.shape[0]), -(-int(live) // (1 << 16)) * (1 << 16))
+        lo_h = np.asarray(lo[:cut])[:live]
+        hi_h = np.asarray(hi[:cut])[:live]
+        keep = lo_h < n
+        lo_h, hi_h = lo_h[keep], hi_h[keep]
+        pst_h = np.asarray(pst).astype(np.uint32)
+        seq_h = np.asarray(seq)
+        t0 = mark("d2h", t0)
+        native = native_or_none("auto")
+        parent_h, pst_out = native.build_forest_links(
+            lo_h.astype(np.uint32), hi_h.astype(np.uint32), n, pst_h)
+        t0 = mark("native", t0)
+        return parent_h
+
+    one(None)  # compile
+    rec = {"op": "hybrid_profile", "log_n": log_n, "platform": platform,
+           "handoff_factor": factor}
+    t0 = time.perf_counter()
+    one(rec)
+    rec["total"] = round(time.perf_counter() - t0, 4)
+    e = len(tail)
+    rec["edges_per_sec"] = round(e / rec["total"], 1)
+    print(json.dumps(rec))
+
+
+if __name__ == "__main__":
+    main()

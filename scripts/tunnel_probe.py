@@ -1,0 +1,67 @@
+"""Measure the tunneled backend's transfer/latency characteristics.
+
+Prints JSON: scalar round-trip latency, h2d and d2h bandwidth at 1/8/32 MB,
+and the per-dispatch floor for a trivial jitted op.  These set the design
+constants for chunk scheduling and handoff sizing in the hybrid build.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from sheep_tpu.cli.common import ensure_jax_platform
+
+ensure_jax_platform()
+import jax
+import jax.numpy as jnp
+
+
+def best(fn, reps=3):
+    fn()
+    ts = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        fn()
+        ts.append(time.perf_counter() - t0)
+    return min(ts)
+
+
+def main() -> None:
+    rec = {"platform": jax.devices()[0].platform}
+    small = jax.device_put(jnp.ones((8,), jnp.int32))
+    rec["scalar_fetch_ms"] = round(best(lambda: int(jnp.max(small))) * 1e3, 2)
+
+    tiny = jax.jit(lambda x: x + 1)
+    rec["dispatch_ms"] = round(
+        best(lambda: int(jnp.max(tiny(small)))) * 1e3, 2)
+
+    for mb in (1, 8, 32):
+        n = (mb << 20) // 4
+        host = np.arange(n, dtype=np.int32)
+        dev = jax.device_put(jnp.asarray(host))
+        int(jnp.max(dev[:1]))
+        s = best(lambda: jax.device_put(host).block_until_ready())
+        rec[f"h2d_{mb}mb_mbps"] = round(mb / s, 1)
+        # distinct arrays per rep: jax caches the host copy of an array
+        # that has already been fetched, which fakes TB/s rates
+        devs = [jax.device_put(jnp.asarray(host + i)) for i in range(4)]
+        for d in devs:
+            int(jnp.max(d[:1]))
+        ts = []
+        for d in devs[1:]:
+            t0 = time.perf_counter()
+            np.asarray(d)
+            ts.append(time.perf_counter() - t0)
+        rec[f"d2h_{mb}mb_mbps"] = round(mb / min(ts), 1)
+    print(json.dumps(rec))
+
+
+if __name__ == "__main__":
+    main()

@@ -134,17 +134,40 @@ def build_graph_hybrid(tail: np.ndarray, head: np.ndarray,
             np.empty(0, np.uint32), np.empty(0, np.uint32))
     seq, _, m, lo, hi, pst = prepare_links(
         jnp.asarray(tail), jnp.asarray(head), n)
+    # overlap the seq/pst result fetch with the reduction rounds: on the
+    # tunneled backend d2h runs ~10MB/s (scripts/tunnel_probe.py) and the
+    # reduce phase blocks on its own per-chunk round trips, so a second
+    # thread streaming these two n-slot arrays down hides up to ~n*8B of
+    # transfer behind the chunk loop
+    import threading
+    fetched: dict = {}
+
+    def _prefetch():
+        try:
+            fetched["seq"] = np.asarray(seq)
+            fetched["pst"] = np.asarray(pst)
+        except Exception:  # fall back to the synchronous fetch below
+            fetched.clear()
+
+    pre = threading.Thread(target=_prefetch, daemon=True)
+    pre.start()
     lo, hi, live, rounds, converged = reduce_links_hosted(
         lo, hi, n, stop_live=handoff_factor * n)
     if converged:
+        pre.join()
         parent = parent_from_links(lo, hi, n)
-        return _finish(seq, m, parent, pst)
+        return _finish(fetched.get("seq", seq), m, parent,
+                       fetched.get("pst", pst))
     native = native_or_none("auto")
-    lo_h = np.asarray(lo[:live])
-    hi_h = np.asarray(hi[:live])
+    # fetch a 64K-granular prefix, not [:live] exactly: each distinct
+    # slice length is a fresh XLA program, and tunneled compiles are slow
+    cut = min(int(lo.shape[0]), -(-live // (1 << 16)) * (1 << 16))
+    lo_h = np.asarray(lo[:cut])[:live]
+    hi_h = np.asarray(hi[:cut])[:live]
     keep = lo_h < n  # a few scattered dead slots may remain in the prefix
     lo_h, hi_h = lo_h[keep], hi_h[keep]
-    pst_h = np.asarray(pst).astype(np.uint32)
+    pre.join()
+    pst_h = np.asarray(fetched.get("pst", pst)).astype(np.uint32)
     if native is not None:
         parent_h, pst_out = native.build_forest_links(
             lo_h.astype(np.uint32), hi_h.astype(np.uint32), n, pst_h)
@@ -155,5 +178,5 @@ def build_graph_hybrid(tail: np.ndarray, head: np.ndarray,
                                     impl="python")
         parent_h, pst_out = forest.parent, forest.pst_weight
     m = int(m)
-    seq_np = np.asarray(seq)[:m].astype(np.uint32)
+    seq_np = np.asarray(fetched.get("seq", seq))[:m].astype(np.uint32)
     return seq_np, Forest(parent_h[:m].copy(), pst_out[:m].copy())

@@ -21,7 +21,10 @@ accelerators, "16,18,20" on cpu), SHEEP_BENCH_LOG_N (single size override),
 SHEEP_BENCH_EDGE_FACTOR (default 8), SHEEP_BENCH_REPS (default 3),
 SHEEP_BENCH_TIMEOUT (seconds per size, default 1500 — tunneled-backend
 compiles run 30-130s per program and each size is a fresh process, so a
-persistent jax compilation cache is also enabled under /tmp).
+persistent jax compilation cache is also enabled under /tmp),
+SHEEP_BENCH_STARTUP_TIMEOUT (seconds for a child to get past backend
+init, default 300; a child that hasn't printed its platform marker by
+then is recorded as ``backend_hang`` instead of eating the size timeout).
 """
 
 from __future__ import annotations
@@ -206,6 +209,10 @@ def main() -> None:
     fell_back = False
     if os.environ.get("JAX_PLATFORMS", "") == "cpu":
         platform = "cpu"
+        # children never need the tunnel on cpu; a sick-but-listening one
+        # can hang their startup in the plugin sitecustomize regardless of
+        # JAX_PLATFORMS, so strip the registration gate here too
+        os.environ.pop("PALLAS_AXON_POOL_IPS", None)
     elif os.environ.get("SHEEP_BENCH_NO_PROBE"):
         # probe skipped on operator's say-so: assume the accelerator is up
         platform = "accel"
@@ -215,6 +222,11 @@ def main() -> None:
             print("bench: hardware backend unreachable; falling back to CPU",
                   file=sys.stderr)
             os.environ["JAX_PLATFORMS"] = "cpu"
+            # a sick-but-listening tunnel can block interpreter STARTUP in
+            # the plugin-registering sitecustomize (observed: ~7min hangs
+            # even under JAX_PLATFORMS=cpu); dropping the gate env var
+            # skips registration entirely in the fallback children
+            os.environ.pop("PALLAS_AXON_POOL_IPS", None)
             fell_back = True
             platform = "cpu"
     on_accel = platform != "cpu"
@@ -260,31 +272,80 @@ def main() -> None:
         os.unlink(progress_path)  # never leave a stale sidecar looking live
     except OSError:
         pass
-    for log_n in sizes:
-        rec = None
-        try:
-            proc = subprocess.run(
+    # A sick tunnel blocks child interpreters before they print anything
+    # (backend init retry loop).  Give each child a short budget to produce
+    # its FIRST stderr line — printed right after backend init, before any
+    # compile — so a backend hang costs minutes, not the full per-size
+    # timeout.
+    startup_s = int(os.environ.get("SHEEP_BENCH_STARTUP_TIMEOUT", "300"))
+
+    def run_child(log_n: int):
+        """Returns (stdout, stderr, returncode, fault_kind|None)."""
+        import tempfile
+        with tempfile.TemporaryFile() as out_f, \
+                tempfile.TemporaryFile() as err_f:
+            proc = subprocess.Popen(
                 [sys.executable, os.path.abspath(__file__),
                  "--one", str(log_n)],
-                capture_output=True, text=True, timeout=timeout_s)
-        except subprocess.TimeoutExpired as exc:
-            first_fault = {"log_n": log_n, "error": "timeout"}
-            err = exc.stderr
-            if isinstance(err, bytes):
-                err = err.decode(errors="replace")
-            if err:
-                sys.stderr.write(err)
-            print(f"bench: n=2^{log_n} TIMEOUT after {timeout_s}s",
+                stdout=out_f, stderr=err_f)
+            t0 = time.monotonic()
+            fault = None
+            saw_marker = False
+
+            def marker_seen() -> bool:
+                # the marker prints right after jax.devices() returns;
+                # plugin warnings appear BEFORE the blocking init, so
+                # any-bytes is not a liveness signal.  Scan the first and
+                # last 64KiB so verbose output on either side of the
+                # marker can't hide it (pread keeps the child's shared
+                # write offset untouched), and latch the result.
+                fd = err_f.fileno()
+                if b"bench: platform" in os.pread(fd, 1 << 16, 0):
+                    return True
+                size = os.fstat(fd).st_size
+                return size > (1 << 16) and b"bench: platform" in \
+                    os.pread(fd, 1 << 16, size - (1 << 16))
+
+            while True:
+                rc = proc.poll()
+                if rc is not None:
+                    break
+                elapsed = time.monotonic() - t0
+                saw_marker = saw_marker or marker_seen()
+                if elapsed > timeout_s:
+                    fault = "timeout"
+                elif elapsed > startup_s and not saw_marker:
+                    fault = "backend_hang"
+                if fault:
+                    proc.kill()
+                    proc.wait()
+                    break
+                time.sleep(1)
+            out_f.seek(0)
+            err_f.seek(0)
+            return (out_f.read().decode(errors="replace"),
+                    err_f.read().decode(errors="replace"),
+                    proc.returncode, fault)
+
+    for log_n in sizes:
+        rec = None
+        stdout, stderr, rc_child, fault_kind = run_child(log_n)
+        if fault_kind is not None:
+            first_fault = {"log_n": log_n, "error": fault_kind}
+            if stderr:
+                sys.stderr.write(stderr)
+            budget = startup_s if fault_kind == "backend_hang" else timeout_s
+            print(f"bench: n=2^{log_n} {fault_kind.upper()} after {budget}s",
                   file=sys.stderr)
-            rec = last_record(exc.stdout)
+            rec = last_record(stdout)
         else:
-            sys.stderr.write(proc.stderr)
-            rec = last_record(proc.stdout)
-            if proc.returncode != 0:
-                err = (proc.stderr or "").strip().splitlines()
+            sys.stderr.write(stderr)
+            rec = last_record(stdout)
+            if rc_child != 0:
+                err = (stderr or "").strip().splitlines()
                 first_fault = {"log_n": log_n,
                                "error": err[-1][:300] if err else "crash"}
-                print(f"bench: n=2^{log_n} FAULT rc={proc.returncode}",
+                print(f"bench: n=2^{log_n} FAULT rc={rc_child}",
                       file=sys.stderr)
             elif rec is None:
                 first_fault = {"log_n": log_n,

@@ -291,20 +291,27 @@ def main() -> None:
             t0 = time.monotonic()
             fault = None
             saw_marker = False
+            scanned = 0  # stderr bytes checked for the marker so far
 
             def marker_seen() -> bool:
                 # the marker prints right after jax.devices() returns;
                 # plugin warnings appear BEFORE the blocking init, so
-                # any-bytes is not a liveness signal.  Scan the first and
-                # last 64KiB so verbose output on either side of the
-                # marker can't hide it (pread keeps the child's shared
-                # write offset untouched), and latch the result.
+                # any-bytes is not a liveness signal.  Scan incrementally
+                # (only newly appended bytes, with overlap for a marker
+                # split across polls) and latch — pread keeps the child's
+                # shared write offset untouched.
+                nonlocal scanned
                 fd = err_f.fileno()
-                if b"bench: platform" in os.pread(fd, 1 << 16, 0):
-                    return True
                 size = os.fstat(fd).st_size
-                return size > (1 << 16) and b"bench: platform" in \
-                    os.pread(fd, 1 << 16, size - (1 << 16))
+                while scanned < size:
+                    start = max(0, scanned - 32)  # overlap a split marker
+                    chunk = os.pread(fd, min(1 << 20, size - start), start)
+                    scanned = start + len(chunk)
+                    if b"bench: platform" in chunk:
+                        return True
+                    if not chunk:
+                        break
+                return False
 
             while True:
                 rc = proc.poll()

@@ -172,6 +172,13 @@ int64_t sheep_forward_partition(const uint32_t* parent, const int64_t* weights,
   for (int64_t i = 0; i < n; ++i) {
     if (component_below[i] > max_component) {
       ks.assign(kids.begin() + koffs[i], kids.begin() + koffs[i + 1]);
+      // descending weight, ascending-jnid ties (stable) — deterministic
+      // and identical to the python twin.  The reference uses an UNSTABLE
+      // std::sort here (partition.cpp:104-108), so its tie permutation is
+      // toolchain-defined; this rule matches 30/31 published rows of
+      // data/quality/hep.cost col 2 exactly (24 parts: 2723 vs 2720,
+      // +0.1% — no consistent tie direction reproduces that row without
+      // breaking others; see scripts/quality_sweep.py)
       std::stable_sort(ks.begin(), ks.end(),
                        [&](uint32_t a, uint32_t b) {
                          return component_below[a] > component_below[b];

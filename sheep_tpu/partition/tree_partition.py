@@ -105,7 +105,10 @@ def forward_partition(forest: Forest, max_component: int,
     for i in range(n):
         if component_below[i] > max_component:
             ks = kids[i]
-            # descending component weight, stable (ascending jnid tie-break)
+            # descending component weight, stable (ascending jnid ties) —
+            # matches the native runtime; the reference's unstable
+            # std::sort leaves ties toolchain-defined (see the note in
+            # sheep_native.cpp and scripts/quality_sweep.py)
             ks = ks[np.argsort(-component_below[ks], kind="stable")]
             while component_below[i] > max_component:
                 for kid in ks:

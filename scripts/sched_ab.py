@@ -1,0 +1,82 @@
+"""A/B the chunk-schedule variants for the hybrid's reduce phase.
+
+Variants (all reach the same forest; only cost differs):
+  base    — current reduce_links_hosted defaults
+  nosort1 — first chunk is a jump-only round (skips the full-size sort;
+            round 1 kills only ~6% of edges, so its sort may not pay)
+  lvl2    — first_levels=2 (cheaper full-size rounds)
+
+For each, measures wall time and rounds to the hybrid stop (live <=
+3n) and to full convergence, at one size.  Usage:
+  python scripts/sched_ab.py LOG_N [reps]
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+from scripts.tpu_diag import edges
+
+
+def main() -> None:
+    log_n = int(sys.argv[1]) if len(sys.argv) > 1 else 18
+    reps = int(sys.argv[2]) if len(sys.argv) > 2 else 2
+    n = 1 << log_n
+
+    from sheep_tpu.cli.common import ensure_jax_platform
+    ensure_jax_platform()
+    import jax
+    import jax.numpy as jnp
+    from sheep_tpu.ops.build import prepare_links
+    from sheep_tpu.ops import forest as F
+
+    tail, head = edges(log_n)
+    t = jax.device_put(jnp.asarray(tail, jnp.int32))
+    h = jax.device_put(jnp.asarray(head, jnp.int32))
+    _, _, _, lo0, hi0, _ = prepare_links(t, h, n)
+    lo0.block_until_ready()
+
+    import functools
+
+    @functools.partial(jax.jit, static_argnames=("n", "levels"))
+    def jump_only_chunk(lo, hi, n: int, levels: int):
+        sent = jnp.int32(n)
+        live = jnp.sum(lo != sent, dtype=jnp.int32)
+        lo, moved = F._jump(lo, hi, n, levels)
+        return lo, hi, jnp.stack([moved, live])
+
+    def reduce_with(first, stop_live):
+        lo, hi = lo0, hi0
+        rounds = 0
+        if first == "nosort1":
+            lo, hi, stats = jump_only_chunk(lo, hi, n, 4)
+            rounds += 1
+            moved_i, live_i = (int(x) for x in np.asarray(stats))
+        lo, hi, live, r, conv = F.reduce_links_hosted(
+            lo, hi, n, stop_live=stop_live,
+            first_levels=2 if first == "lvl2" else 4)
+        return rounds + r, live, conv
+
+    results = {}
+    for name in ("base", "nosort1", "lvl2"):
+        for stop, label in ((3 * n, "handoff"), (0, "converge")):
+            best = None
+            rr = ll = None
+            for _ in range(reps + 1):  # +1 warmup/compile
+                t0 = time.perf_counter()
+                rr, ll, _ = reduce_with(name, stop)
+                dt = time.perf_counter() - t0
+                best = dt if best is None else min(best, dt)
+            results[f"{name}_{label}"] = {
+                "s": round(best, 3), "rounds": rr, "live": ll}
+            print(name, label, results[f"{name}_{label}"], flush=True)
+
+
+if __name__ == "__main__":
+    main()

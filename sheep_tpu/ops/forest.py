@@ -238,6 +238,29 @@ def fixpoint_chunk(lo: jnp.ndarray, hi: jnp.ndarray, n: int,
     return lo, hi, jnp.stack([moved, live])
 
 
+@functools.partial(jax.jit, static_argnames=("n", "levels"))
+def jump_chunk(lo: jnp.ndarray, hi: jnp.ndarray, n: int, levels: int):
+    """One jump-only round (no sort): a cheap opener for full-size arrays.
+
+    Round 1's sort retires only ~6% of a power-law edge set (exact input
+    duplicates); the mass kill needs jump-induced lo collisions FIRST,
+    which the next round's sort then dedupes.  Skipping the opener's sort
+    was measured 26% faster to the hybrid handoff at 2^18 on the cpu
+    backend (scripts/sched_ab.py).  Returns (lo, hi, stats) like
+    :func:`fixpoint_chunk`, but with NO sort the returned ``live`` count
+    carries no prefix guarantee — live edges may sit anywhere in the
+    arrays, so callers must NOT compact on it (it is an upper bound on
+    the live population only, sound because the jump never resurrects a
+    dead edge).
+    """
+    sent = jnp.int32(n)
+    lo = lo.astype(jnp.int32)
+    hi = hi.astype(jnp.int32)
+    live = jnp.sum(lo != sent, dtype=jnp.int32)
+    lo, moved = _jump(lo, hi, n, levels)
+    return lo, hi, jnp.stack([moved, live])
+
+
 @functools.partial(jax.jit, static_argnames=("n",))
 def parent_from_links(lo: jnp.ndarray, hi: jnp.ndarray, n: int):
     """Scatter-min parent extraction (valid once links form a forest)."""
@@ -272,11 +295,12 @@ def reduce_links_hosted(lo, hi, n: int, stop_live: int = 0,
     live links in the first ``live`` slots' prefix region (plus possibly a
     few dead ones — callers must still mask lo < n).
 
-    Chunks follow ``_CHUNK_SCHEDULE`` then repeat ``jrounds``; light
-    ``first_levels`` lifting is used while the arrays are still at their
-    original size (early progress comes from dedupe/star-collapse, and
-    full-size gathers are the expensive ones), deep ``levels`` lifting
-    once compaction has halved them.
+    A sort-free jump-only opener round runs first, then chunks follow
+    ``_CHUNK_SCHEDULE`` and repeat ``jrounds``; light ``first_levels``
+    lifting is used while the arrays are still at their original size
+    (early progress comes from dedupe/star-collapse, and full-size
+    gathers are the expensive ones), deep ``levels`` lifting once
+    compaction has halved them.
     """
     lo = jnp.asarray(lo, jnp.int32)
     hi = jnp.asarray(hi, jnp.int32)
@@ -290,6 +314,15 @@ def reduce_links_hosted(lo, hi, n: int, stop_live: int = 0,
         hi = jnp.concatenate([hi, fill])
     rounds = 0
     chunk_i = 0
+    # Jump-only opener: on the full-size arrays the sort is the most
+    # expensive op and round 1's sort retires almost nothing (~6%) — the
+    # collisions this jump creates are what round 2's sort dedupes.  26%
+    # faster to the hybrid handoff at 2^18 (scripts/sched_ab.py).
+    lo, hi, stats = jump_chunk(lo, hi, n, first_levels)
+    rounds += 1
+    moved_i, live_i = (int(x) for x in np.asarray(stats))
+    if moved_i == 0 and live_i == 0:
+        return lo, hi, live_i, rounds, True
     while True:
         j = _CHUNK_SCHEDULE[chunk_i] if chunk_i < len(_CHUNK_SCHEDULE) \
             else jrounds

@@ -17,7 +17,7 @@ size when one faults.  A crash can reduce coverage but can no longer erase
 the result.
 
 Env: SHEEP_BENCH_SIZES (csv of log2 sizes; default "16,18,20,22,23" on
-accelerators, "16,18,20" on cpu), SHEEP_BENCH_LOG_N (single size override),
+accelerators, "16,18,20,22" on cpu), SHEEP_BENCH_LOG_N (single size override),
 SHEEP_BENCH_EDGE_FACTOR (default 8), SHEEP_BENCH_REPS (default 3),
 SHEEP_BENCH_TIMEOUT (seconds per size, default 1500 — tunneled-backend
 compiles run 30-130s per program and each size is a fresh process, so a
@@ -89,7 +89,10 @@ def _run_one(log_n: int) -> dict:
     print(f"bench: platform={platform} n=2^{log_n} edges={e}", file=sys.stderr)
     # cache the synthetic graph across child processes (generation on the
     # 1-core host costs ~a minute at 2^23 — real per-size-timeout budget)
-    cache = f"/tmp/rmat_{log_n}_{factor}.npz"
+    # rmat16: namespace bumped with the uint16-entropy generator — a
+    # stale cache from the float64 generator is a different graph and
+    # would pass the length/range validation below
+    cache = f"/tmp/rmat16_{log_n}_{factor}.npz"
     tail = head = None
     try:
         d = np.load(cache)
@@ -235,7 +238,7 @@ def main() -> None:
     if os.environ.get("SHEEP_BENCH_LOG_N"):
         sizes = [int(os.environ["SHEEP_BENCH_LOG_N"])]
     else:
-        default = "16,18,20,22,23" if on_accel else "16,18,20"
+        default = "16,18,20,22,23" if on_accel else "16,18,20,22"
         sizes = [int(s) for s in
                  os.environ.get("SHEEP_BENCH_SIZES", default).split(",")]
     timeout_s = int(os.environ.get("SHEEP_BENCH_TIMEOUT", "1500"))

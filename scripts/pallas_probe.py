@@ -1,0 +1,100 @@
+"""Probe whether the axon backend supports Pallas at all, then race a
+fused gather kernel against the XLA primitive it would replace.
+
+Stage 1: trivial elementwise pallas_call (VMEM in/out).  If this fails
+to lower/execute on the backend, stop — no Pallas fast path exists and
+the XLA-primitive kernel stands.
+Stage 2: a lifted-jump step (table gather + where) as a Pallas kernel vs
+the jnp formulation, timed with a scalar-fetch sync.
+
+Usage: python scripts/pallas_probe.py [LOG_N]   (default 2^18 elements)
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def main() -> None:
+    log_n = int(sys.argv[1]) if len(sys.argv) > 1 else 18
+    n = 1 << log_n
+    from sheep_tpu.cli.common import ensure_jax_platform
+    ensure_jax_platform()
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    rec = {"platform": jax.devices()[0].platform, "log_n": log_n}
+
+    # --- stage 1: trivial kernel -------------------------------------
+    def add_one_kernel(x_ref, o_ref):
+        o_ref[...] = x_ref[...] + 1
+
+    x = jnp.arange(n, dtype=jnp.int32).reshape(n // 256, 256)
+    try:
+        fn = jax.jit(lambda a: pl.pallas_call(
+            add_one_kernel,
+            out_shape=jax.ShapeDtypeStruct(a.shape, a.dtype))(a))
+        out = fn(x)
+        ok = int(jnp.sum(out)) == int(jnp.sum(x)) + n
+        rec["trivial_pallas"] = "ok" if ok else "WRONG RESULT"
+    except Exception as e:  # noqa: BLE001 — report whatever the backend throws
+        rec["trivial_pallas"] = f"{type(e).__name__}: {str(e)[:200]}"
+        print(json.dumps(rec))
+        return
+
+    # --- stage 2: jump step, pallas vs jnp ---------------------------
+    rng = np.random.default_rng(0)
+    f_np = np.minimum(np.arange(n) + rng.integers(1, 64, n), n - 1)
+    lo_np = rng.integers(0, n, n)
+    hi_np = np.minimum(lo_np + rng.integers(1, 1024, n), n)
+    f = jnp.asarray(f_np, jnp.int32)
+    lo = jnp.asarray(lo_np, jnp.int32)
+    hi = jnp.asarray(hi_np, jnp.int32)
+
+    @jax.jit
+    def jump_jnp(f, lo, hi):
+        nlo = f[lo]
+        return jnp.where(nlo < hi, nlo, lo)
+
+    def jump_kernel(f_ref, lo_ref, hi_ref, o_ref):
+        l = lo_ref[...]
+        nlo = f_ref[l]
+        o_ref[...] = jnp.where(nlo < hi_ref[...], nlo, l)
+
+    @jax.jit
+    def jump_pl(f, lo, hi):
+        return pl.pallas_call(
+            jump_kernel,
+            out_shape=jax.ShapeDtypeStruct(lo.shape, lo.dtype))(f, lo, hi)
+
+    def timed(fn, *args):
+        out = fn(*args)
+        _ = int(jnp.max(out))
+        ts = []
+        for _i in range(3):
+            t0 = time.perf_counter()
+            _ = int(jnp.max(fn(*args)))
+            ts.append(time.perf_counter() - t0)
+        return round(min(ts) * 1e3, 2)
+
+    rec["jump_jnp_ms"] = timed(jump_jnp, f, lo, hi)
+    try:
+        r = jump_pl(f, lo, hi)
+        same = bool(jnp.array_equal(r, jump_jnp(f, lo, hi)))
+        rec["jump_pallas_correct"] = same
+        rec["jump_pallas_ms"] = timed(jump_pl, f, lo, hi)
+    except Exception as e:  # noqa: BLE001
+        rec["jump_pallas"] = f"{type(e).__name__}: {str(e)[:200]}"
+    print(json.dumps(rec))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,10 +1,16 @@
-"""A/B the chunk-schedule variants for the hybrid's reduce phase.
+"""A/B chunk-schedule variants for the hybrid's reduce phase.
 
-Variants (all reach the same forest; only cost differs):
-  base    — current reduce_links_hosted defaults
-  nosort1 — first chunk is a jump-only round (skips the full-size sort;
-            round 1 kills only ~6% of edges, so its sort may not pay)
-  lvl2    — first_levels=2 (cheaper full-size rounds)
+HISTORY: the 2026-07-30 run of this script (variants base / nosort1 /
+lvl2, at 2^18 and 2^20 on the cpu backend) motivated the jump-only
+opener that now runs INSIDE reduce_links_hosted — nosort1 measured
+26-39% faster to the hybrid handoff and was productized.  The variants
+below reflect the post-opener world:
+
+  prod     — current reduce_links_hosted (opener + sorted schedule)
+  dblopen  — an EXTRA jump-only round before the production path (tests
+             whether a second sort-free round pays)
+  lvl2     — first_levels=2 (cheaper full-size rounds; rejected once,
+             kept here for re-testing on other backends)
 
 For each, measures wall time and rounds to the hybrid stop (live <=
 3n) and to full convergence, at one size.  Usage:
@@ -42,29 +48,19 @@ def main() -> None:
     _, _, _, lo0, hi0, _ = prepare_links(t, h, n)
     lo0.block_until_ready()
 
-    import functools
-
-    @functools.partial(jax.jit, static_argnames=("n", "levels"))
-    def jump_only_chunk(lo, hi, n: int, levels: int):
-        sent = jnp.int32(n)
-        live = jnp.sum(lo != sent, dtype=jnp.int32)
-        lo, moved = F._jump(lo, hi, n, levels)
-        return lo, hi, jnp.stack([moved, live])
-
-    def reduce_with(first, stop_live):
+    def reduce_with(variant, stop_live):
         lo, hi = lo0, hi0
         rounds = 0
-        if first == "nosort1":
-            lo, hi, stats = jump_only_chunk(lo, hi, n, 4)
+        if variant == "dblopen":
+            lo, hi, _ = F.jump_chunk(lo, hi, n, 4)
             rounds += 1
-            moved_i, live_i = (int(x) for x in np.asarray(stats))
         lo, hi, live, r, conv = F.reduce_links_hosted(
             lo, hi, n, stop_live=stop_live,
-            first_levels=2 if first == "lvl2" else 4)
+            first_levels=2 if variant == "lvl2" else 4)
         return rounds + r, live, conv
 
     results = {}
-    for name in ("base", "nosort1", "lvl2"):
+    for name in ("prod", "dblopen", "lvl2"):
         for stop, label in ((3 * n, "handoff"), (0, "converge")):
             best = None
             rr = ll = None

@@ -26,7 +26,9 @@ import numpy as np
 
 
 def edges(log_n: int, factor: int = 8):
-    path = f"/tmp/rmat_{log_n}_{factor}.npz"
+    # rmat16: post-uint16-entropy generator namespace — a stale cache
+    # from the float64 generator is a DIFFERENT graph
+    path = f"/tmp/rmat16_{log_n}_{factor}.npz"
     if not os.path.exists(path):
         from sheep_tpu.utils import rmat_edges
         tail, head = rmat_edges(log_n, factor << log_n, seed=1)

@@ -317,12 +317,12 @@ def reduce_links_hosted(lo, hi, n: int, stop_live: int = 0,
     # Jump-only opener: on the full-size arrays the sort is the most
     # expensive op and round 1's sort retires almost nothing (~6%) — the
     # collisions this jump creates are what round 2's sort dedupes.  26%
-    # faster to the hybrid handoff at 2^18 (scripts/sched_ab.py).
-    lo, hi, stats = jump_chunk(lo, hi, n, first_levels)
+    # faster to the hybrid handoff at 2^18 (scripts/sched_ab.py).  Its
+    # stats are deliberately NOT fetched (each host sync is a ~70ms
+    # tunnel round trip, and the streaming path calls this per block);
+    # an already-converged input just costs one cheap sorted chunk below.
+    lo, hi, _ = jump_chunk(lo, hi, n, first_levels)
     rounds += 1
-    moved_i, live_i = (int(x) for x in np.asarray(stats))
-    if moved_i == 0 and live_i == 0:
-        return lo, hi, live_i, rounds, True
     while True:
         j = _CHUNK_SCHEDULE[chunk_i] if chunk_i < len(_CHUNK_SCHEDULE) \
             else jrounds

@@ -18,10 +18,18 @@ def rmat_edges(log_n: int, num_edges: int, seed: int = 0,
     rng = np.random.default_rng(seed)
     tail = np.zeros(num_edges, dtype=np.uint32)
     head = np.zeros(num_edges, dtype=np.uint32)
+    # uint16 entropy instead of float64: the PRNG cost scales with output
+    # bytes (4x fewer), and this 1-core host generates doubles at only
+    # ~10M/s — at 2^25 x 44 (the twitter-scale stand-in) float64 draws
+    # alone cost ~1h.  Quadrant probabilities quantize to 1/65536, which
+    # is noise for benchmark graphs.
+    qa = np.uint16(min(round(a * 65536), 65535))
+    qab = np.uint16(min(round((a + b) * 65536), 65535))
+    qabc = np.uint16(min(round((a + b + c) * 65536), 65535))
     for bit in range(log_n):
-        u = rng.random(num_edges)
-        tbit = u >= (a + b)
-        hbit = ((u >= a) & (u < a + b)) | (u >= a + b + c)
+        u = rng.integers(0, 1 << 16, num_edges, dtype=np.uint16)
+        tbit = u >= qab
+        hbit = ((u >= qa) & (u < qab)) | (u >= qabc)
         tail |= tbit.astype(np.uint32) << np.uint32(bit)
         head |= hbit.astype(np.uint32) << np.uint32(bit)
     return tail, head

@@ -24,6 +24,8 @@ _tried = False
 _u32p = np.ctypeslib.ndpointer(np.uint32, flags="C_CONTIGUOUS")
 _i64p = np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS")
 _i32p = np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS")
+_u64p = np.ctypeslib.ndpointer(np.uint64, flags="C_CONTIGUOUS")
+_u8p = np.ctypeslib.ndpointer(np.uint8, flags="C_CONTIGUOUS")
 
 
 def _load() -> ctypes.CDLL | None:
@@ -93,6 +95,12 @@ def _bind(lib: ctypes.CDLL) -> None:
     lib.sheep_fennel_edges.argtypes = [
         _u32p, _u32p, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
         ctypes.c_double, _i64p]
+    lib.sheep_eval_block.restype = ctypes.c_int64
+    lib.sheep_eval_block.argtypes = [
+        _u32p, _u32p, ctypes.c_int64, _i64p, ctypes.c_int64,
+        ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64, ctypes.c_int32,
+        _u64p, _u64p, ctypes.c_void_p, ctypes.c_void_p,
+        _u8p, _i64p, _i64p, _i64p, ctypes.c_int64]
 
 
 def available() -> bool:
@@ -257,3 +265,45 @@ def degree_sequence_from_degrees(deg: np.ndarray) -> np.ndarray | None:
     seq = np.empty(len(deg), dtype=np.uint32)
     k = lib.sheep_degree_sequence(deg, len(deg), seq)
     return seq[:k].copy()
+
+
+def eval_block(tail: np.ndarray, head: np.ndarray, parts: np.ndarray,
+               pos: np.ndarray | None, w0: int, first_window: bool,
+               m_vcom: np.ndarray, m_hash: np.ndarray,
+               m_down: np.ndarray | None, m_up: np.ndarray | None,
+               deg_mask: np.ndarray, hash_loads: np.ndarray,
+               down_loads: np.ndarray, up_loads: np.ndarray,
+               num_parts: int) -> int:
+    """One block of the streamed partition evaluator (updates the window
+    bitmaps / load counters in place); returns the edges_cut increment.
+    All array arguments must be the caller-owned state buffers — they are
+    mutated, not copied.
+    """
+    lib = _load()
+    assert lib is not None
+    tail = np.ascontiguousarray(tail, dtype=np.uint32)
+    head = np.ascontiguousarray(head, dtype=np.uint32)
+    pos_ptr, pos_len = 0, 0
+    if pos is not None:
+        # the C kernel writes m_down/m_up whenever pos is given — a
+        # missing mask would be a null-pointer store
+        assert m_down is not None and m_up is not None, \
+            "pos requires both m_down and m_up buffers"
+        assert pos.dtype == np.uint32 and pos.flags["C_CONTIGUOUS"]
+        pos_ptr, pos_len = pos.ctypes.data, len(pos)
+        assert m_down.dtype == np.uint64 and m_down.flags["C_CONTIGUOUS"]
+        assert m_up.dtype == np.uint64 and m_up.flags["C_CONTIGUOUS"]
+    for arr, dt in ((parts, np.int64), (m_vcom, np.uint64),
+                    (m_hash, np.uint64), (deg_mask, np.uint8),
+                    (hash_loads, np.int64), (down_loads, np.int64),
+                    (up_loads, np.int64)):
+        assert arr.dtype == dt and arr.flags["C_CONTIGUOUS"]
+    down_ptr = m_down.ctypes.data if pos is not None else 0
+    up_ptr = m_up.ctypes.data if pos is not None else 0
+    rc = lib.sheep_eval_block(
+        tail, head, len(tail), parts, len(parts), pos_ptr, pos_len,
+        w0, 1 if first_window else 0, m_vcom, m_hash, down_ptr, up_ptr,
+        deg_mask, hash_loads, down_loads, up_loads, num_parts)
+    if rc < 0:
+        raise ValueError("sheep_eval_block: vid out of range")
+    return int(rc)

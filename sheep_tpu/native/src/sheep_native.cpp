@@ -612,4 +612,72 @@ int sheep_fennel_edges(const uint32_t* tail, const uint32_t* head, int64_t m,
   return 0;
 }
 
+// One edge block of the streamed O(n)-memory partition evaluator
+// (partition/evaluate.py evaluate_partition_streamed; reference metric
+// definitions at lib/partition.cpp:428-521).  Updates the caller's
+// window bitmaps / load counters in place; bit-identical to the python
+// block body.  ``pos`` may be null (sequence-free overload: m_down/m_up
+// untouched).  Returns the edges_cut increment (first window only,
+// else 0), or -1 on an out-of-range vid — the wrapper raises.
+int64_t sheep_eval_block(const uint32_t* tail, const uint32_t* head,
+                         int64_t e, const int64_t* parts, int64_t n,
+                         const uint32_t* pos, int64_t pos_len,
+                         int64_t w0, int32_t first_window,
+                         uint64_t* m_vcom, uint64_t* m_hash,
+                         uint64_t* m_down, uint64_t* m_up,
+                         uint8_t* deg_mask, int64_t* hash_loads,
+                         int64_t* down_loads, int64_t* up_loads,
+                         int64_t num_parts) {
+  constexpr uint32_t kMult = 2654435769u;  // floor(0.5*(sqrt(5)-1)*2^32)
+  const int64_t w_hi = w0 + 64;
+  int64_t edges_cut = 0;
+  for (int64_t i = 0; i < e; ++i) {
+    const uint32_t t = tail[i], h = head[i];
+    if (t >= (uint64_t)n || h >= (uint64_t)n) return -1;
+    if (pos && (t >= (uint64_t)pos_len || h >= (uint64_t)pos_len)) return -1;
+    const int64_t pt = parts[t], ph = parts[h];
+    if (first_window) {
+      deg_mask[t] = 1;
+      deg_mask[h] = 1;
+      edges_cut += pt != ph;
+    }
+    const uint32_t ht = t * kMult, hh = h * kMult;
+    const uint32_t post = pos ? pos[t] : 0, posh = pos ? pos[h] : 0;
+    for (int dir = 0; dir < 2; ++dir) {
+      const uint32_t X = dir ? h : t;
+      const int64_t pX = dir ? ph : pt, pY = dir ? pt : ph;
+      const uint32_t hX = dir ? hh : ht, hY = dir ? ht : hh;
+      const uint32_t sX = dir ? posh : post, sY = dir ? post : posh;
+      if (pY >= w0 && pY < w_hi) m_vcom[X] |= 1ull << (pY - w0);
+      const int64_t p_hash = hX < hY ? pX : pY;
+      if (p_hash >= w0 && p_hash < w_hi) m_hash[X] |= 1ull << (p_hash - w0);
+      if (pos) {
+        const int64_t p_down = sX < sY ? pX : pY;
+        if (p_down >= w0 && p_down < w_hi) m_down[X] |= 1ull << (p_down - w0);
+        const int64_t p_up = sX > sY ? pX : pY;
+        if (p_up >= w0 && p_up < w_hi) m_up[X] |= 1ull << (p_up - w0);
+      }
+    }
+    if (first_window) {
+      // the caller contract requires parts to cover every streamed vid;
+      // an INVALID_PART (-1) here would be heap corruption, and the
+      // python body's np.bincount raises on it — error out the same way
+      if (t != h) {
+        const uint32_t a = t < h ? t : h, b = t < h ? h : t;
+        const uint32_t ha = a * kMult, hb = b * kMult;
+        const int64_t p = ha < hb ? parts[a] : parts[b];
+        if (p < 0 || p >= num_parts) return -1;
+        ++hash_loads[p];
+      }
+      if (pos) {
+        if (pt < 0 || pt >= num_parts || ph < 0 || ph >= num_parts)
+          return -1;
+        if (post < posh) ++down_loads[pt]; else if (post > posh) ++up_loads[pt];
+        if (posh < post) ++down_loads[ph]; else if (posh > post) ++up_loads[ph];
+      }
+    }
+  }
+  return edges_cut;
+}
+
 }  // extern "C"

@@ -164,7 +164,8 @@ def evaluate_partition(parts: np.ndarray, tail: np.ndarray, head: np.ndarray,
 
 def evaluate_partition_streamed(parts: np.ndarray, blocks_factory,
                                 pos: np.ndarray | None, num_parts: int,
-                                file_edges: int) -> EvalReport:
+                                file_edges: int,
+                                impl: str = "auto") -> EvalReport:
     """Exact evaluator in O(n) memory for graphs whose doubled key arrays
     would not fit in host RAM (the in-memory path peaks at ~50 GB for
     twitter-2010; reference anchor lib/partition.cpp:428-521).
@@ -178,13 +179,22 @@ def evaluate_partition_streamed(parts: np.ndarray, blocks_factory,
     ``blocks_factory``: zero-arg callable returning a fresh iterator of
     (tail, head) uint32 blocks (e.g. ``lambda: iter_dat_blocks(path, B)``).
     ``pos``: vid -> sequence position table, or None for the sequence-free
-    overload.  ``parts`` must cover every vid in the stream.
+    overload.  ``parts`` must cover every vid in the stream.  ``impl``:
+    auto|native|python — the per-block work runs in the C runtime when
+    available (sheep_eval_block, ~4x at 1.476B edges), with the numpy
+    body as the oracle/fallback.
     """
     parts = np.ascontiguousarray(parts, dtype=np.int64)
     n = len(parts)
     P = max(int(parts.max(initial=0)) + 1, 1)
 
-    deg_mask = np.zeros(n, dtype=bool)
+    from ..core.forest import native_or_none
+    native = native_or_none(impl)
+    pos32 = None
+    if pos is not None and native is not None:
+        pos32 = np.ascontiguousarray(pos, dtype=np.uint32)
+
+    deg_mask = np.zeros(n, dtype=np.uint8)
     edges_cut = 0
     part_loads = np.zeros(P, dtype=np.int64)          # vertex balance
     hash_loads = np.zeros(P, dtype=np.int64)          # undirected hash loads
@@ -205,12 +215,21 @@ def evaluate_partition_streamed(parts: np.ndarray, blocks_factory,
                              np.uint64(1) << (p[sel] - w0).astype(np.uint64))
 
         for tail, head in blocks_factory():
+            if native is not None:
+                # one C pass per block updates every window bitmap / load
+                # counter in place — bit-identical to the numpy body
+                # below, ~40x faster (np.bitwise_or.at is unbuffered)
+                edges_cut += native.eval_block(
+                    tail, head, parts, pos32, w0, first_window,
+                    m_vcom, m_hash, m_down, m_up, deg_mask,
+                    hash_loads, down_loads, up_loads, P)
+                continue
             t = tail.astype(np.int64)
             h = head.astype(np.int64)
             pt, ph = parts[t], parts[h]
             if first_window:
-                deg_mask[t] = True
-                deg_mask[h] = True
+                deg_mask[t] = 1
+                deg_mask[h] = 1
                 edges_cut += int((pt != ph).sum())
 
             for X, Y, pX, pY in ((t, h, pt, ph), (h, t, ph, pt)):

@@ -199,10 +199,14 @@ def test_balance_denominators_truncate(capsys):
     assert "balance: 5 (1.250000%)" in out  # 5 / (9 // 2), not 5 / 4.5
 
 
+@pytest.mark.parametrize("impl", ["native", "python"])
 @pytest.mark.parametrize("num_parts", [2, 7, 100])
-def test_streamed_evaluator_matches_inmemory(num_parts):
+def test_streamed_evaluator_matches_inmemory(num_parts, impl):
     # The O(n)-memory bitmap evaluator must be bit-identical to the dense
-    # one, including the >64-part multi-window path (num_parts=100).
+    # one, including the >64-part multi-window path (num_parts=100) —
+    # through BOTH the native per-block kernel (sheep_eval_block) and the
+    # pure-numpy fallback body.  impl="native" raises if the runtime
+    # failed to build, so a broken .so can't silently skip the C coverage.
     from sheep_tpu.core.sequence import degree_sequence, sequence_positions
     from sheep_tpu.partition.evaluate import (evaluate_partition,
                                               evaluate_partition_streamed)
@@ -223,11 +227,13 @@ def test_streamed_evaluator_matches_inmemory(num_parts):
         for a in range(0, e, 64):
             yield tail[a:a + 64], head[a:a + 64]
 
-    stream = evaluate_partition_streamed(parts, blocks, pos, num_parts, e)
+    stream = evaluate_partition_streamed(parts, blocks, pos, num_parts, e,
+                                         impl=impl)
     assert dense == stream
 
     # sequence-free overload
     dense_nf = evaluate_partition(parts, tail, head, None, num_parts,
                                   max_vid=n - 1, file_edges=e)
-    stream_nf = evaluate_partition_streamed(parts, blocks, None, num_parts, e)
+    stream_nf = evaluate_partition_streamed(parts, blocks, None, num_parts, e,
+                                            impl=impl)
     assert dense_nf == stream_nf

@@ -1,0 +1,165 @@
+"""Betweenness-centrality sequence quality sweep on hep-th.
+
+The reference's third published hep.cost column (``sheep-BC``) partitions
+a tree built over a betweenness-ordered sequence (314 vs 521 ECV(down)
+at 2 parts — BASELINE.md).  The BC ordering itself was produced by an
+external tool and is NOT shipped in the reference's data, so exact row
+parity is not reproducible; this script computes exact Brandes
+betweenness (unweighted, undirected, dedup'd edges), orders ascending
+(ties by vid — same convention as the degree sequence), runs the same
+parts 2..40 sweep, and records both columns side by side in
+BCQUALITY_r03.json.  What it demonstrates: arbitrary external sequences
+drive the same pipeline (graph2tree -s), and a centrality order lands in
+the same quality band as the reference's.
+
+Usage: python scripts/bc_quality.py [graph.dat] [max_parts]
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+from scripts.quality_sweep import _REF_HEP_COST
+
+
+def brandes_betweenness(tail: np.ndarray, head: np.ndarray,
+                        n: int) -> np.ndarray:
+    """Exact unweighted betweenness (Brandes 2001), vectorized per level.
+
+    Undirected; parallel edges and self-loops are dropped.  Endpoints are
+    NOT counted (the standard convention).  O(V*E) worst case — fine for
+    the 7.6k-vertex hep-th graph.
+    """
+    und = tail != head
+    a = np.minimum(tail[und], head[und]).astype(np.int64)
+    b = np.maximum(tail[und], head[und]).astype(np.int64)
+    key = a * n + b
+    key = np.unique(key)
+    a, b = key // n, key % n
+    # CSR over both directions
+    src = np.concatenate([a, b])
+    dst = np.concatenate([b, a])
+    sort_idx = np.argsort(src, kind="stable")
+    adj = dst[sort_idx]
+    deg = np.bincount(src, minlength=n)
+    offs = np.zeros(n + 1, dtype=np.int64)
+    np.cumsum(deg, out=offs[1:])
+
+    def slices(frontier):
+        """Flattened adjacency of all frontier nodes + matching sources."""
+        counts = deg[frontier]
+        total = int(counts.sum())
+        within = np.arange(total) - np.repeat(
+            np.cumsum(counts) - counts, counts)
+        idx = np.repeat(offs[frontier], counts) + within
+        return adj[idx], np.repeat(frontier, counts)
+
+    bc = np.zeros(n, dtype=np.float64)
+    for s in range(n):
+        if offs[s] == offs[s + 1]:
+            continue
+        dist = np.full(n, -1, dtype=np.int64)
+        sigma = np.zeros(n, dtype=np.float64)
+        dist[s] = 0
+        sigma[s] = 1.0
+        frontier = np.array([s], dtype=np.int64)
+        levels = [frontier]
+        d = 0
+        while len(frontier):
+            nbrs, srcs = slices(frontier)
+            new_mask = dist[nbrs] == -1
+            if new_mask.any():
+                dist[nbrs[new_mask]] = d + 1
+            onlevel = dist[nbrs] == d + 1
+            np.add.at(sigma, nbrs[onlevel], sigma[srcs[onlevel]])
+            frontier = np.unique(nbrs[new_mask])
+            d += 1
+            if len(frontier):
+                levels.append(frontier)
+        delta = np.zeros(n, dtype=np.float64)
+        for frontier in reversed(levels[1:]):
+            nbrs, srcs = slices(frontier)
+            # neighbors one level CLOSER to s are the predecessors;
+            # accumulate each frontier node's dependency onto them
+            pred = dist[nbrs] == dist[srcs] - 1
+            contrib = (sigma[nbrs[pred]] / sigma[srcs[pred]]) * \
+                (1.0 + delta[srcs[pred]])
+            np.add.at(delta, nbrs[pred], contrib)
+        delta[s] = 0.0
+        bc += delta
+    return bc / 2.0  # undirected: each pair counted twice
+
+
+def main() -> None:
+    path = sys.argv[1] if len(sys.argv) > 1 else "data/hep-th.dat"
+    max_parts = int(sys.argv[2]) if len(sys.argv) > 2 else 40
+
+    from sheep_tpu.io import load_edges
+    from sheep_tpu.core import build_forest, compute_facts
+    from sheep_tpu.partition import Partition, evaluate_partition
+
+    el = load_edges(path)
+    n = el.max_vid + 1
+    t0 = time.time()
+    bc = brandes_betweenness(el.tail.astype(np.int64),
+                             el.head.astype(np.int64), n)
+    bc_s = round(time.time() - t0, 1)
+
+    # ascending importance, ties by vid; only vids with degree > 0
+    deg_mask = np.zeros(n, dtype=bool)
+    deg_mask[el.tail] = True
+    deg_mask[el.head] = True
+    active = np.nonzero(deg_mask)[0]
+    order = active[np.lexsort((active, bc[active]))]
+    seq = order.astype(np.uint32)
+
+    forest = build_forest(el.tail, el.head, seq)
+    facts = compute_facts(forest)
+
+    ref3: dict[int, int] = {}
+    try:
+        with open(_REF_HEP_COST) as f:
+            for line in f:
+                if line.startswith("#") or not line.strip():
+                    continue
+                toks = line.split()
+                ref3[int(toks[0])] = int(toks[2])
+    except OSError:
+        pass
+
+    rows = []
+    for parts in range(2, max_parts + 1):
+        p = Partition.from_forest(seq, forest, parts, max_vid=el.max_vid)
+        ev = evaluate_partition(p.parts, el.tail, el.head, seq, parts,
+                                max_vid=el.max_vid,
+                                file_edges=el.num_edges)
+        row = {"parts": parts, "ecv_down": int(ev.ecv_down)}
+        if parts in ref3:
+            row["ref_bc"] = ref3[parts]
+        rows.append(row)
+    rec = {
+        "graph": os.path.basename(path),
+        "bc_seconds": bc_s,
+        "tree_width": int(facts.width),
+        "note": ("reference BC ordering not shipped; rows are context, "
+                 "not an exact-parity gate (see module docstring)"),
+        "rows": rows,
+    }
+    out = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "BCQUALITY_r03.json")
+    with open(out, "w") as f:
+        json.dump(rec, f, indent=1)
+    head_rows = [r for r in rows if r["parts"] in (2, 3, 4, 8, 16, 32)]
+    print(json.dumps({k: rec[k] for k in rec if k != "rows"}))
+    print("sample rows:", head_rows)
+
+
+if __name__ == "__main__":
+    main()

@@ -293,11 +293,9 @@ def eval_block(tail: np.ndarray, head: np.ndarray, parts: np.ndarray,
         pos_ptr, pos_len = pos.ctypes.data, len(pos)
         assert m_down.dtype == np.uint64 and m_down.flags["C_CONTIGUOUS"]
         assert m_up.dtype == np.uint64 and m_up.flags["C_CONTIGUOUS"]
-    for arr, dt in ((parts, np.int64), (m_vcom, np.uint64),
-                    (m_hash, np.uint64), (deg_mask, np.uint8),
-                    (hash_loads, np.int64), (down_loads, np.int64),
-                    (up_loads, np.int64)):
-        assert arr.dtype == dt and arr.flags["C_CONTIGUOUS"]
+    # parts / masks / counters go through ndpointer argtypes, which
+    # already enforce dtype + contiguity with clear TypeErrors; only the
+    # raw-pointer (c_void_p) arguments need manual validation above.
     down_ptr = m_down.ctypes.data if pos is not None else 0
     up_ptr = m_up.ctypes.data if pos is not None else 0
     rc = lib.sheep_eval_block(
@@ -305,5 +303,8 @@ def eval_block(tail: np.ndarray, head: np.ndarray, parts: np.ndarray,
         w0, 1 if first_window else 0, m_vcom, m_hash, down_ptr, up_ptr,
         deg_mask, hash_loads, down_loads, up_loads, num_parts)
     if rc < 0:
-        raise ValueError("sheep_eval_block: vid out of range")
+        raise ValueError(
+            "sheep_eval_block: a vid is out of range of parts/pos, or a "
+            "streamed vertex has an invalid part id (e.g. INVALID_PART "
+            "-1) — parts must cover every vid in the edge stream")
     return int(rc)

@@ -137,7 +137,12 @@ def _run_one(log_n: int) -> dict:
         # the device->host fetch of the finished tree.  The one-time edge
         # upload runs ~15-25MB/s through the tunnel (scripts/
         # tunnel_probe.py) and is reported separately as ``h2d_s``.
-        return build_graph_hybrid(t, h, n)  # host Forest: synced
+        # after any real load phase the edges are resident in host RAM as
+        # well as HBM; on accelerators the host copy lets the hybrid
+        # recompute seq/pst host-side (bit-identical) instead of fetching
+        # 2n*4B through the ~10MB/s tunnel (on cpu the fetch is free)
+        he = (tail, head) if platform != "cpu" else None
+        return build_graph_hybrid(t, h, n, host_edges=he)
 
     rec = {"log_n": log_n, "edges": e, "platform": platform,
            "h2d_s": round(h2d_s, 4)}

@@ -26,7 +26,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import numpy as np
 
-from scripts.quality_sweep import _REF_HEP_COST
+from scripts.quality_sweep import ref_hep_column
 
 
 def brandes_betweenness(tail: np.ndarray, head: np.ndarray,
@@ -123,16 +123,7 @@ def main() -> None:
     forest = build_forest(el.tail, el.head, seq)
     facts = compute_facts(forest)
 
-    ref3: dict[int, int] = {}
-    try:
-        with open(_REF_HEP_COST) as f:
-            for line in f:
-                if line.startswith("#") or not line.strip():
-                    continue
-                toks = line.split()
-                ref3[int(toks[0])] = int(toks[2])
-    except OSError:
-        pass
+    ref3 = ref_hep_column(col=2)
 
     rows = []
     for parts in range(2, max_parts + 1):

@@ -25,8 +25,9 @@ import numpy as np
 _REF_HEP_COST = "/root/reference/data/quality/hep.cost"
 
 
-def ref_hep_column() -> dict[int, int]:
-    """parts -> published sheep-degree ECV(down) (hep.cost col 2)."""
+def ref_hep_column(col: int = 1) -> dict[int, int]:
+    """parts -> a published hep.cost column (1 = sheep-degree ECV(down),
+    2 = sheep-bc; the file is whitespace-columned with # comments)."""
     out: dict[int, int] = {}
     try:
         with open(_REF_HEP_COST) as f:
@@ -34,7 +35,7 @@ def ref_hep_column() -> dict[int, int]:
                 if line.startswith("#") or not line.strip():
                     continue
                 toks = line.split()
-                out[int(toks[0])] = int(toks[1])
+                out[int(toks[0])] = int(toks[col])
     except OSError:
         pass
     return out

@@ -84,9 +84,32 @@ def build_graph_device(tail: np.ndarray, head: np.ndarray,
     return _finish(seq, m, parent, pst)
 
 
+def _host_seq_pst(tail_np: np.ndarray, head_np: np.ndarray, n: int):
+    """Host-side (seq, pst) identical to the device's prepare_links outputs.
+
+    Same order (degree asc, vid asc — tested equal across all four build
+    implementations) and same pst semantics (one count per non-self-loop
+    record at the position of its earlier-in-sequence endpoint).  Chunked
+    gathers keep the peak at ~3 int32 arrays of one block, not of E.
+    """
+    from ..core.sequence import degree_sequence, sequence_positions
+
+    seq_h = degree_sequence(tail_np, head_np, n)
+    pos = sequence_positions(seq_h, n - 1)
+    pst = np.zeros(n, np.int64)
+    block = 1 << 24
+    for s in range(0, len(tail_np), block):
+        pt = pos[tail_np[s:s + block]].astype(np.int64)
+        ph = pos[head_np[s:s + block]].astype(np.int64)
+        lo = np.minimum(pt, ph)
+        pst += np.bincount(lo[pt != ph], minlength=n)[:n]
+    return seq_h, pst.astype(np.uint32)
+
+
 def build_graph_hybrid(tail: np.ndarray, head: np.ndarray,
                        num_vertices: int | None = None,
-                       handoff_factor: int | None = None):
+                       handoff_factor: int | None = None,
+                       host_edges: tuple[np.ndarray, np.ndarray] | None = None):
     """Flagship heterogeneous build: TPU reduction + native union-find tail.
 
     The device runs the bandwidth-parallel phases (histogram, degree sort,
@@ -106,6 +129,15 @@ def build_graph_hybrid(tail: np.ndarray, head: np.ndarray,
     1-core host, stopping after the first dedupe round (factor 8) beats
     reducing all the way to 2n by 3.3x — the native union-find retires
     links far faster than extra device rounds do.
+
+    ``host_edges`` — the same edge records as host numpy arrays, when the
+    caller has them (after any real load phase the graph is resident in
+    host RAM whether or not it was also uploaded).  With a host copy, seq
+    and pst are recomputed on the host concurrently with the device
+    reduction instead of fetched from the device — bit-identical either
+    way, but 2n*4B less d2h traffic, which on a tunneled backend
+    (~10MB/s, scripts/tunnel_probe.py) is seconds at 2^22+.  Numpy
+    tail/head inputs serve as their own host copy automatically.
     """
     import os
 
@@ -123,7 +155,6 @@ def build_graph_hybrid(tail: np.ndarray, head: np.ndarray,
         if _non("auto") is None:
             default = "2"
         else:
-            import jax
             default = "8" if jax.devices()[0].platform == "cpu" else "3"
         handoff_factor = int(os.environ.get("SHEEP_HANDOFF_FACTOR", default))
     n = num_vertices
@@ -132,20 +163,34 @@ def build_graph_hybrid(tail: np.ndarray, head: np.ndarray,
     if n == 0:
         return np.empty(0, np.uint32), Forest(
             np.empty(0, np.uint32), np.empty(0, np.uint32))
+    if host_edges is None and jax.devices()[0].platform != "cpu" \
+            and isinstance(tail, np.ndarray) and isinstance(head, np.ndarray):
+        # auto-detect only where the d2h saving is real: on the cpu
+        # backend the device "fetch" is a near-free copy and the host
+        # recompute would compete with the reduce loop for the same cores
+        host_edges = (tail, head)
     seq, _, m, lo, hi, pst = prepare_links(
         jnp.asarray(tail), jnp.asarray(head), n)
-    # overlap the seq/pst result fetch with the reduction rounds: on the
-    # tunneled backend d2h runs ~10MB/s (scripts/tunnel_probe.py) and the
-    # reduce phase blocks on its own per-chunk round trips, so a second
-    # thread streaming these two n-slot arrays down hides up to ~n*8B of
-    # transfer behind the chunk loop
+    # overlap seq/pst with the reduction rounds: with a host edge copy,
+    # recompute them on the host (no d2h at all); otherwise stream them
+    # down on a second thread — on the tunneled backend d2h runs ~10MB/s
+    # (scripts/tunnel_probe.py) and the reduce phase blocks on its own
+    # per-chunk round trips, so either way the work hides behind the
+    # chunk loop
     import threading
     fetched: dict = {}
 
     def _prefetch():
         try:
-            fetched["seq"] = np.asarray(seq)
-            fetched["pst"] = np.asarray(pst)
+            if host_edges is not None:
+                t_np, h_np = host_edges
+                fetched["seq"], fetched["pst"] = _host_seq_pst(t_np, h_np, n)
+                # host seq is already trimmed to the m active slots, so its
+                # length replaces the device scalar fetch (~70ms tunneled)
+                fetched["m"] = len(fetched["seq"])
+            else:
+                fetched["seq"] = np.asarray(seq)
+                fetched["pst"] = np.asarray(pst)
         except Exception:  # fall back to the synchronous fetch below
             fetched.clear()
 
@@ -156,7 +201,7 @@ def build_graph_hybrid(tail: np.ndarray, head: np.ndarray,
     if converged:
         pre.join()
         parent = parent_from_links(lo, hi, n)
-        return _finish(fetched.get("seq", seq), m, parent,
+        return _finish(fetched.get("seq", seq), fetched.get("m", m), parent,
                        fetched.get("pst", pst))
     native = native_or_none("auto")
     # fetch a 64K-granular prefix, not [:live] exactly: each distinct
@@ -177,6 +222,6 @@ def build_graph_hybrid(tail: np.ndarray, head: np.ndarray,
                                     hi_h.astype(np.int64), n, pst=pst_h,
                                     impl="python")
         parent_h, pst_out = forest.parent, forest.pst_weight
-    m = int(m)
+    m = int(fetched.get("m", m))
     seq_np = np.asarray(fetched.get("seq", seq))[:m].astype(np.uint32)
     return seq_np, Forest(parent_h[:m].copy(), pst_out[:m].copy())

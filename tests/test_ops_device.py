@@ -214,6 +214,58 @@ def test_build_graph_hybrid_matches_oracle(seed, handoff):
     np.testing.assert_array_equal(forest.pst_weight, want.pst_weight)
 
 
+@pytest.mark.parametrize("handoff", [2, 1000])
+def test_build_graph_hybrid_explicit_host_edges(handoff):
+    # the accelerator configuration: seq/pst recomputed host-side from the
+    # caller's edge copy instead of fetched from the device (auto-detect is
+    # gated off on the cpu backend, so pass host_edges explicitly here)
+    from sheep_tpu.ops import build_graph_hybrid
+
+    rng = np.random.default_rng(955)
+    tail, head = random_multigraph(rng, 200, 1200)
+    want_seq = degree_sequence(tail, head)
+    want = build_forest(tail, head, want_seq)
+    seq, forest = build_graph_hybrid(tail, head, handoff_factor=handoff,
+                                     host_edges=(tail, head))
+    np.testing.assert_array_equal(seq, want_seq)
+    np.testing.assert_array_equal(forest.parent, want.parent)
+    np.testing.assert_array_equal(forest.pst_weight, want.pst_weight)
+
+
+def test_build_graph_hybrid_device_inputs_no_host_copy():
+    # device-array inputs without host_edges exercise the d2h prefetch
+    # branch (numpy inputs auto-use the host recompute path)
+    import jax.numpy as jnp
+    from sheep_tpu.ops import build_graph_hybrid
+
+    rng = np.random.default_rng(960)
+    tail, head = random_multigraph(rng, 200, 1200)
+    want_seq = degree_sequence(tail, head)
+    want = build_forest(tail, head, want_seq)
+    n = int(max(tail.max(), head.max())) + 1
+    seq, forest = build_graph_hybrid(
+        jnp.asarray(tail), jnp.asarray(head), n, handoff_factor=1000)
+    np.testing.assert_array_equal(seq, want_seq)
+    np.testing.assert_array_equal(forest.parent, want.parent)
+    np.testing.assert_array_equal(forest.pst_weight, want.pst_weight)
+
+
+def test_host_seq_pst_matches_device():
+    from sheep_tpu.ops.build import _host_seq_pst, prepare_links
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(961)
+    tail, head = random_multigraph(rng, 300, 2000)  # includes self-loops
+    n = int(max(tail.max(), head.max())) + 1
+    seq_d, _, m, _, _, pst_d = prepare_links(
+        jnp.asarray(tail), jnp.asarray(head), n)
+    seq_h, pst_h = _host_seq_pst(tail, head, n)
+    m = int(m)
+    assert len(seq_h) == m
+    np.testing.assert_array_equal(seq_h, np.asarray(seq_d)[:m])
+    np.testing.assert_array_equal(pst_h, np.asarray(pst_d))
+
+
 def test_build_graph_device_rmat_oracle():
     from sheep_tpu.ops import build_graph_device
     from sheep_tpu.utils import rmat_edges

@@ -14,7 +14,11 @@ empty BENCH file): each size runs in its OWN subprocess (``--one``), per-size
 records stream to stderr as they complete, and the final stdout line is the
 largest passing size — annotated with the whole sweep and the first faulting
 size when one faults.  A crash can reduce coverage but can no longer erase
-the result.
+the result.  If the accelerator sweep yields NO records at all (round-3
+lesson: the probe can pass and the tunnel still degrade minutes later,
+hanging the first compile), the whole sweep reruns on CPU with the
+``_cpu_fallback`` tag and the accelerator fault recorded as ``accel_fault``
+— value 0 is never published while any backend can produce a number.
 
 Env: SHEEP_BENCH_SIZES (csv of log2 sizes; default "16,18,20,22,23" on
 accelerators, "16,18,20,22" on cpu), SHEEP_BENCH_LOG_N (single size override),
@@ -215,12 +219,19 @@ def main() -> None:
     from sheep_tpu.cli.common import ensure_jax_platform
     ensure_jax_platform()  # honor JAX_PLATFORMS even under a forced plugin
     fell_back = False
+
+    def _force_cpu():
+        """Point all future children at the CPU backend.  Popping the
+        plugin gate is load-bearing: a sick-but-listening tunnel can block
+        interpreter STARTUP in the plugin-registering sitecustomize even
+        under JAX_PLATFORMS=cpu (observed: ~7min hangs), so fallback
+        children must skip tunnel registration entirely."""
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+
     if os.environ.get("JAX_PLATFORMS", "") == "cpu":
         platform = "cpu"
-        # children never need the tunnel on cpu; a sick-but-listening one
-        # can hang their startup in the plugin sitecustomize regardless of
-        # JAX_PLATFORMS, so strip the registration gate here too
-        os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+        _force_cpu()  # children never need the tunnel on cpu
     elif os.environ.get("SHEEP_BENCH_NO_PROBE"):
         # probe skipped on operator's say-so: assume the accelerator is up
         platform = "accel"
@@ -229,12 +240,7 @@ def main() -> None:
         if platform is None:
             print("bench: hardware backend unreachable; falling back to CPU",
                   file=sys.stderr)
-            os.environ["JAX_PLATFORMS"] = "cpu"
-            # a sick-but-listening tunnel can block interpreter STARTUP in
-            # the plugin-registering sitecustomize (observed: ~7min hangs
-            # even under JAX_PLATFORMS=cpu); dropping the gate env var
-            # skips registration entirely in the fallback children
-            os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+            _force_cpu()
             fell_back = True
             platform = "cpu"
     on_accel = platform != "cpu"
@@ -272,8 +278,6 @@ def main() -> None:
                 return rec
         return None
 
-    sweep: list[dict] = []
-    first_fault: dict | None = None
     progress_path = os.path.join(
         os.path.dirname(os.path.abspath(__file__)), "bench_progress.json")
     try:
@@ -342,46 +346,73 @@ def main() -> None:
                     err_f.read().decode(errors="replace"),
                     proc.returncode, fault)
 
-    for log_n in sizes:
-        rec = None
-        stdout, stderr, rc_child, fault_kind = run_child(log_n)
-        if fault_kind is not None:
-            first_fault = {"log_n": log_n, "error": fault_kind}
-            if stderr:
+    def run_sweep(sizes) -> tuple[list[dict], dict | None]:
+        sweep: list[dict] = []
+        first_fault: dict | None = None
+        for log_n in sizes:
+            rec = None
+            stdout, stderr, rc_child, fault_kind = run_child(log_n)
+            if fault_kind is not None:
+                first_fault = {"log_n": log_n, "error": fault_kind}
+                if stderr:
+                    sys.stderr.write(stderr)
+                budget = startup_s if fault_kind == "backend_hang" \
+                    else timeout_s
+                print(f"bench: n=2^{log_n} {fault_kind.upper()} "
+                      f"after {budget}s", file=sys.stderr)
+                rec = last_record(stdout)
+            else:
                 sys.stderr.write(stderr)
-            budget = startup_s if fault_kind == "backend_hang" else timeout_s
-            print(f"bench: n=2^{log_n} {fault_kind.upper()} after {budget}s",
-                  file=sys.stderr)
-            rec = last_record(stdout)
-        else:
-            sys.stderr.write(stderr)
-            rec = last_record(stdout)
-            if rc_child != 0:
-                err = (stderr or "").strip().splitlines()
-                first_fault = {"log_n": log_n,
-                               "error": err[-1][:300] if err else "crash"}
-                print(f"bench: n=2^{log_n} FAULT rc={rc_child}",
+                rec = last_record(stdout)
+                if rc_child != 0:
+                    err = (stderr or "").strip().splitlines()
+                    first_fault = {"log_n": log_n,
+                                   "error": err[-1][:300] if err else "crash"}
+                    print(f"bench: n=2^{log_n} FAULT rc={rc_child}",
+                          file=sys.stderr)
+                elif rec is None:
+                    first_fault = {"log_n": log_n,
+                                   "error": "unparseable child output"}
+                    print(f"bench: n=2^{log_n} produced no record",
+                          file=sys.stderr)
+            if rec is not None:
+                if first_fault is not None:
+                    rec["partial"] = True  # some paths of this size were lost
+                sweep.append(rec)
+                print(f"bench: n=2^{log_n} -> "
+                      f"{rec['edges_per_sec']:.0f} edges/s "
+                      f"({rec['rounds']} rounds, best {rec['best_s']}s)",
                       file=sys.stderr)
-            elif rec is None:
-                first_fault = {"log_n": log_n,
-                               "error": "unparseable child output"}
-                print(f"bench: n=2^{log_n} produced no record",
-                      file=sys.stderr)
-        if rec is not None:
+                # Sidecar survives the benchmark being killed mid-sweep;
+                # it must carry the fallback marker so a mid-fallback kill
+                # can't pass CPU numbers off as accelerator results.
+                try:
+                    with open(progress_path, "w") as f:
+                        json.dump({"sweep": sweep,
+                                   "cpu_fallback": fell_back,
+                                   "accel_fault": accel_fault}, f)
+                except OSError:
+                    pass
             if first_fault is not None:
-                rec["partial"] = True  # some paths of this size were lost
-            sweep.append(rec)
-            print(f"bench: n=2^{log_n} -> {rec['edges_per_sec']:.0f} edges/s "
-                  f"({rec['rounds']} rounds, best {rec['best_s']}s)",
-                  file=sys.stderr)
-            # Sidecar survives the whole benchmark being killed mid-sweep.
-            try:
-                with open(progress_path, "w") as f:
-                    json.dump({"sweep": sweep}, f)
-            except OSError:
-                pass
-        if first_fault is not None:
-            break
+                break
+        return sweep, first_fault
+
+    accel_fault: dict | None = None
+    sweep, first_fault = run_sweep(sizes)
+    if not sweep and on_accel:
+        # The probe can pass and the tunnel still degrade minutes later
+        # (observed: backend init OK, first compile hangs).  An empty
+        # accelerator sweep must not publish value 0 — rerun on CPU,
+        # clearly labeled, and carry the accelerator fault alongside.
+        accel_fault = first_fault
+        print("bench: accelerator sweep produced no records; "
+              "falling back to CPU", file=sys.stderr)
+        _force_cpu()
+        fell_back = True
+        if not os.environ.get("SHEEP_BENCH_LOG_N") \
+                and not os.environ.get("SHEEP_BENCH_SIZES"):
+            sizes = [s for s in sizes if s <= 22]
+        sweep, first_fault = run_sweep(sizes)
 
     tag = "_cpu_fallback" if fell_back else ""
     if not sweep:
@@ -389,7 +420,7 @@ def main() -> None:
         print(json.dumps({
             "metric": f"device_build_edges_per_sec{tag}",
             "value": 0.0, "unit": "edges/sec", "vs_baseline": 0.0,
-            "fault": first_fault}))
+            "fault": first_fault, "accel_fault": accel_fault}))
         sys.exit(1)
     top = max(sweep, key=lambda r: r["log_n"])
     out = {
@@ -406,6 +437,8 @@ def main() -> None:
     }
     if first_fault is not None:
         out["first_fault"] = first_fault
+    if accel_fault is not None:
+        out["accel_fault"] = accel_fault
     print(json.dumps(out))
 
 

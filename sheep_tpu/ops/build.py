@@ -207,8 +207,16 @@ def build_graph_hybrid(tail: np.ndarray, head: np.ndarray,
     # fetch a 64K-granular prefix, not [:live] exactly: each distinct
     # slice length is a fresh XLA program, and tunneled compiles are slow
     cut = min(int(lo.shape[0]), -(-live // (1 << 16)) * (1 << 16))
-    lo_h = np.asarray(lo[:cut])[:live]
-    hi_h = np.asarray(hi[:cut])[:live]
+    pack = os.environ.get("SHEEP_PACK_HANDOFF", "")
+    if pack == "":  # default: pack where the fetch is byte-bound (tunnel)
+        pack = "0" if jax.devices()[0].platform == "cpu" else "1"
+    if pack == "1" and n < (1 << 24):
+        from .forest import pack_links_6b, unpack_links_6b
+        buf = np.asarray(pack_links_6b(lo[:cut], hi[:cut]))[:live]
+        lo_h, hi_h = unpack_links_6b(buf)
+    else:
+        lo_h = np.asarray(lo[:cut])[:live]
+        hi_h = np.asarray(hi[:cut])[:live]
     keep = lo_h < n  # a few scattered dead slots may remain in the prefix
     lo_h, hi_h = lo_h[keep], hi_h[keep]
     pre.join()

@@ -266,6 +266,35 @@ def test_host_seq_pst_matches_device():
     np.testing.assert_array_equal(pst_h, np.asarray(pst_d))
 
 
+def test_pack_links_6b_roundtrip():
+    from sheep_tpu.ops.forest import pack_links_6b, unpack_links_6b
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(962)
+    lo = rng.integers(0, (1 << 24) - 1, 5000).astype(np.int32)
+    hi = rng.integers(0, (1 << 24) - 1, 5000).astype(np.int32)
+    buf = np.asarray(pack_links_6b(jnp.asarray(lo), jnp.asarray(hi)))
+    assert buf.dtype == np.uint8 and buf.shape == (5000, 6)
+    lo2, hi2 = unpack_links_6b(buf)
+    np.testing.assert_array_equal(lo2, lo)
+    np.testing.assert_array_equal(hi2, hi)
+
+
+def test_build_graph_hybrid_packed_handoff(monkeypatch):
+    # force the packed 6-byte handoff (default-off on the cpu backend)
+    from sheep_tpu.ops import build_graph_hybrid
+
+    monkeypatch.setenv("SHEEP_PACK_HANDOFF", "1")
+    rng = np.random.default_rng(963)
+    tail, head = random_multigraph(rng, 300, 2000)
+    want_seq = degree_sequence(tail, head)
+    want = build_forest(tail, head, want_seq)
+    seq, forest = build_graph_hybrid(tail, head, handoff_factor=1000)
+    np.testing.assert_array_equal(seq, want_seq)
+    np.testing.assert_array_equal(forest.parent, want.parent)
+    np.testing.assert_array_equal(forest.pst_weight, want.pst_weight)
+
+
 def test_build_graph_device_rmat_oracle():
     from sheep_tpu.ops import build_graph_device
     from sheep_tpu.utils import rmat_edges

@@ -24,6 +24,17 @@ def ensure_jax_platform() -> None:
         return
     import jax
 
+    try:
+        from jax._src import distributed as _dist
+        if _dist.global_state.client is not None:
+            # A multi-host session is active (maybe_init_distributed ran):
+            # the platform was pinned before joining, and clearing backends
+            # now would re-register the topology with the coordination
+            # service (ALREADY_EXISTS crash).  Nothing to do.
+            return
+    except Exception:
+        pass
+
     # Never query the current backend here — that would *initialize* it,
     # which on a tunneled hardware platform can block for a long time.
     # Drop any already-initialized backends and pin the requested platform;
@@ -37,6 +48,32 @@ def ensure_jax_platform() -> None:
         jax.config.update("jax_platforms", want)
     except Exception:
         pass
+
+
+def maybe_init_distributed() -> int:
+    """Join a multi-host coordination service when the launcher asks for it.
+
+    The reference distributes `graph2tree -i -r` with `mpiexec` across
+    nodes (README:88-89, data/slurm-uk2007); the launcher analog here is
+    env vars: SHEEP_COORDINATOR=host:port plus SHEEP_NUM_PROCESSES /
+    SHEEP_PROCESS_ID per process.  After joining, jax.devices() spans all
+    hosts and the same SPMD build runs over the DCN mesh.  Returns this
+    process's index (0 when not distributed) for leader gating — the
+    reference's rank-0 logic (graph2tree.cpp:158-159).
+    """
+    import os
+
+    coord = os.environ.get("SHEEP_COORDINATOR")
+    if not coord:
+        return 0
+    from ..parallel import init_distributed
+    num = os.environ.get("SHEEP_NUM_PROCESSES")
+    pid = os.environ.get("SHEEP_PROCESS_ID")
+    init_distributed(coordinator_address=coord,
+                     num_processes=int(num) if num else None,
+                     process_id=int(pid) if pid else None)
+    import jax
+    return jax.process_index()
 
 
 class PhaseClock:

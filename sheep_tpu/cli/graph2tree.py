@@ -47,12 +47,15 @@ def _make_jopts(make_kids, make_pst, make_jxn, memory_limit, width_limit,
                       find_max_width=find_max_width)
 
 
-def _finish_sort(seq, use_mesh_sort, sequence_filename, clock):
+def _finish_sort(seq, use_mesh_sort, sequence_filename, clock,
+                 leader=True, writer=True):
     """Write the sequence when `-i -s` asked for it and emit the Sorted
-    phase line per the reference grammar (graph2tree.cpp:177-184)."""
-    if use_mesh_sort and sequence_filename:
+    phase line per the reference grammar (graph2tree.cpp:177-184).
+    ``leader``/``writer`` gate the print / shared-fs write in multi-host
+    runs (non-leader processes compute the same replicated results)."""
+    if use_mesh_sort and sequence_filename and writer:
         write_sequence(seq, sequence_filename)
-    if use_mesh_sort or sequence_filename == "":
+    if (use_mesh_sort or sequence_filename == "") and leader:
         print_phase("Sorted", clock.phase_seconds())
 
 
@@ -125,6 +128,19 @@ def main(argv: list[str] | None = None) -> int:
     clock = PhaseClock()
     use_mesh = use_mesh_sort or use_mesh_reduce
     is_leader = use_mesh or sequence_filename == ""
+    proc0 = True  # this process writes shared-filesystem outputs
+    if use_mesh:
+        # Multi-host launch (the reference's mpiexec across nodes): join
+        # the coordination service before any backend work; only process 0
+        # is the leader (rank-0 logic, graph2tree.cpp:158-159).  Unlike
+        # the reference's per-rank partial writes, every process here
+        # computes the full (replicated) result, so non-leaders skip
+        # writes entirely rather than racing on the same files.
+        from .common import ensure_jax_platform, maybe_init_distributed
+        ensure_jax_platform()
+        if maybe_init_distributed() != 0:
+            is_leader = False
+            proc0 = False
 
     if verbose:
         print(f"Loading {graph_filename}...")
@@ -163,14 +179,16 @@ def main(argv: list[str] | None = None) -> int:
             seq = read_sequence(sequence_filename)
         else:
             seq = degree_sequence_device(edges.tail, edges.head)
-        _finish_sort(seq, use_mesh_sort, sequence_filename, clock)
+        _finish_sort(seq, use_mesh_sort, sequence_filename, clock,
+                     leader=is_leader, writer=proc0)
         jopts = _make_jopts(make_kids, make_pst, make_jxn, memory_limit,
                             width_limit, find_max_width)
         forest, seq, widths = build_forest_jxn(
             edges.tail, edges.head, seq, jopts)
-        print_phase("Mapped", clock.phase_seconds())
-        if use_mesh_reduce:
-            print_phase("Reduced", clock.phase_seconds())
+        if is_leader:
+            print_phase("Mapped", clock.phase_seconds())
+            if use_mesh_reduce:
+                print_phase("Reduced", clock.phase_seconds())
     elif use_mesh:
         # Fused SPMD program over the device mesh: sort + map [+ reduce].
         from .common import ensure_jax_platform
@@ -184,6 +202,12 @@ def main(argv: list[str] | None = None) -> int:
         workers = int(os.environ.get("SHEEP_WORKERS") or 0) \
             or len(jax.devices())
         mesh_workers = min(workers, len(jax.devices()))
+        if jax.process_count() > 1:
+            # Multi-host: every process participates in the SPMD program,
+            # so the mesh must span all global devices — a smaller mesh
+            # would exclude later hosts' devices while those processes
+            # still drive the program (no addressable shards -> crash).
+            mesh_workers = len(jax.devices())
         given_seq = None
         if not use_mesh_sort and sequence_filename:
             given_seq = read_sequence(sequence_filename)
@@ -201,15 +225,17 @@ def main(argv: list[str] | None = None) -> int:
             from ..ops.sort import degree_sequence_device
             seq = given_seq if given_seq is not None else \
                 degree_sequence_device(edges.tail, edges.head)
-            _finish_sort(seq, use_mesh_sort, sequence_filename, clock)
+            _finish_sort(seq, use_mesh_sort, sequence_filename, clock,
+                         leader=is_leader, writer=proc0)
             max_vid = edges.max_vid
             if workers <= len(jax.devices()) and len(edges.tail):
                 from ..parallel.build import map_graph_distributed
                 _, partials = map_graph_distributed(
                     edges.tail, edges.head, num_workers=workers, seq=seq)
-                for w, f in enumerate(partials):
-                    write_tree(f"{output_filename}{w:02d}r0.tre",
-                               f.parent, f.pst_weight)
+                if proc0:
+                    for w, f in enumerate(partials):
+                        write_tree(f"{output_filename}{w:02d}r0.tre",
+                                   f.parent, f.pst_weight)
                 # -f/-c/-t report worker 0's partial view, like the
                 # reference's rank 0 with its partial graph load.
                 forest = partials[0]
@@ -221,21 +247,29 @@ def main(argv: list[str] | None = None) -> int:
                     a, b = partial_range(edges.num_edges, w + 1, workers)
                     f = build_forest(edges.tail[a:b], edges.head[a:b], seq,
                                      max_vid=max_vid)
-                    write_tree(f"{output_filename}{w:02d}r0.tre",
-                               f.parent, f.pst_weight)
+                    if proc0:
+                        write_tree(f"{output_filename}{w:02d}r0.tre",
+                                   f.parent, f.pst_weight)
                     if forest is None:
                         forest = f
                         a0, b0 = a, b
+                    if not proc0:
+                        # non-leader process: this host loop has no
+                        # collectives, and all writes are dropped — only
+                        # worker 0's view (for -f/-t/-c) is needed
+                        break
             edges = EdgeList(edges.tail[a0:b0], edges.head[a0:b0],
                              file_edges=edges.file_edges, start=a0)
         else:
             seq, forest = build_graph_distributed(
                 edges.tail, edges.head, num_workers=mesh_workers,
                 seq=given_seq)
-            _finish_sort(seq, use_mesh_sort, sequence_filename, clock)
-        print_phase("Mapped", clock.phase_seconds())
-        if use_mesh_reduce:
-            print_phase("Reduced", clock.phase_seconds())
+            _finish_sort(seq, use_mesh_sort, sequence_filename, clock,
+                         leader=is_leader, writer=proc0)
+        if is_leader:
+            print_phase("Mapped", clock.phase_seconds())
+            if use_mesh_reduce:
+                print_phase("Reduced", clock.phase_seconds())
     else:
         if sequence_filename:
             seq = read_sequence(sequence_filename)
@@ -259,31 +293,37 @@ def main(argv: list[str] | None = None) -> int:
         p = Partition.from_forest(seq, forest, partitions,
                                   max_vid=edges.max_vid)
         if output_filename:
-            prefix = output_filename + ("-w0000-p" if use_mesh_reduce else "")
-            p.write_partitioned_graph(edges.tail, edges.head, seq, prefix,
-                                      max_vid=edges.max_vid)
+            if proc0:
+                prefix = output_filename + \
+                    ("-w0000-p" if use_mesh_reduce else "")
+                p.write_partitioned_graph(edges.tail, edges.head, seq,
+                                          prefix, max_vid=edges.max_vid)
         elif is_leader:
             p.print()
-    elif output_filename and not map_only:
+    elif output_filename and not map_only and proc0:
         # Serial fast path builds straight into the output file
         # (graph2tree.cpp:185-188); with -r only the leader saves (:217-218).
         write_tree(output_filename, forest.parent, forest.pst_weight)
 
-    if verbose:
+    # Diagnostics print from process 0 only in multi-host runs (rank-0
+    # grammar; every process holds the same replicated result anyway).
+    # Single-process behavior is unchanged — proc0 is True there even for
+    # non-leader map workers.
+    if verbose and proc0:
         print_phase("Built", clock.total_seconds())
 
-    if do_faqs:
+    if do_faqs and proc0:
         compute_facts(forest, widths=widths).print()
-    if do_print:
+    if do_print and proc0:
         print_tree(seq, forest.parent, forest.pst_weight)
-    if do_validate:
+    if do_validate and proc0:
         if is_valid_forest(forest, edges.tail, edges.head, seq,
                            max_vid=edges.max_vid):
             print("Tree is valid.")
         else:
             print("ERROR: Tree is not valid.")
 
-    if verbose:
+    if verbose and proc0:
         print_phase("Finished", clock.total_seconds())
     return 0
 

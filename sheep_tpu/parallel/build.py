@@ -150,7 +150,29 @@ def _pad_edges(tail, head, n, w):
     h = np.full(e_pad, n, dtype=np.int64)
     t[:e] = tail
     h[:e] = head
-    return jnp.asarray(t, jnp.int32), jnp.asarray(h, jnp.int32)
+    return t.astype(np.int32), h.astype(np.int32)
+
+
+def _stage(x_np, mesh, spec):
+    """Host numpy -> device array under `spec`.  Single-process: a plain
+    transfer.  Multi-process (after init_distributed, the mpiexec-across-
+    nodes analog): every process holds the full array — the reference's
+    shared-filesystem load — and contributes the shards it addresses, so
+    the result is one global array spanning the DCN mesh."""
+    from jax.sharding import NamedSharding
+    sharding = NamedSharding(mesh, spec)
+    if jax.process_count() == 1:
+        return jax.device_put(jnp.asarray(x_np), sharding)
+    return jax.make_array_from_callback(
+        x_np.shape, sharding, lambda idx: x_np[idx])
+
+
+def _fetch(x):
+    """Replicated device array -> host numpy, multi-process safe (reads
+    this process's addressable copy; out_specs P() replicates)."""
+    if isinstance(x, jax.Array) and jax.process_count() > 1:
+        return np.asarray(x.addressable_data(0))
+    return np.asarray(x)
 
 
 def _to_forest(parent, pst, n, m):
@@ -183,21 +205,23 @@ def _run_distributed(tail, head, num_vertices, num_workers, seq, do_merge):
         # it is the execution shape real hardware needs — the in-jit
         # while_loop below faults on long runs there (ops/forest.py).
         return _single_worker_build(tail, head, n, seq, do_merge)
-    t, h = _pad_edges(tail, head, n, mesh.size)
+    t_np, h_np = _pad_edges(tail, head, n, mesh.size)
+    t = _stage(t_np, mesh, P(AXIS))
+    h = _stage(h_np, mesh, P(AXIS))
     if seq is None:
         dseq, _, m, parent, pst, _ = distributed_build_step(
             t, h, n, mesh, do_merge=do_merge)
-        m = int(m)
-        out_seq = np.asarray(dseq)[:m].astype(np.uint32)
+        m = int(_fetch(m))
+        out_seq = _fetch(dseq)[:m].astype(np.uint32)
     else:
         from ..core.sequence import sequence_positions
         pos = sequence_positions(seq, n - 1)
+        pos = _stage(pos.astype(np.int64).astype(np.int32), mesh, P())
         _, _, m, parent, pst, _ = distributed_build_step(
-            t, h, n, mesh, pos=jnp.asarray(pos.astype(np.int64), jnp.int32),
-            with_pos=True, do_merge=do_merge)
+            t, h, n, mesh, pos=pos, with_pos=True, do_merge=do_merge)
         m = len(seq)
         out_seq = np.asarray(seq, dtype=np.uint32)
-    return out_seq, parent, pst, n, m, mesh.size
+    return out_seq, _fetch(parent), _fetch(pst), n, m, mesh.size
 
 
 def _single_worker_build(tail, head, n, seq, do_merge):

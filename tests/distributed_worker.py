@@ -17,7 +17,10 @@ import sys
 def main() -> None:
     coord, num, pid, out_dir = (sys.argv[1], int(sys.argv[2]),
                                 int(sys.argv[3]), sys.argv[4])
+    mode = sys.argv[5] if len(sys.argv) > 5 else "degree"
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    if mode == "build":
+        return main_build(coord, num, pid, out_dir)
 
     import numpy as np
 
@@ -74,6 +77,38 @@ def main() -> None:
 
     want = np.bincount(tail, minlength=n) + np.bincount(head, minlength=n)
     np.testing.assert_array_equal(deg_local, want)
+
+    with open(os.path.join(out_dir, f"ok.{pid}"), "w") as f:
+        f.write("ok")
+
+
+def main_build(coord: str, num: int, pid: int, out_dir: str) -> None:
+    """Full `-i -r` pipeline across processes: build_graph_distributed
+    over a mesh spanning both processes (global-array staging via
+    parallel.build._stage), checked against the sequential oracle."""
+    from sheep_tpu.cli.common import ensure_jax_platform
+    ensure_jax_platform()
+    import jax
+
+    from sheep_tpu.parallel import init_distributed
+    init_distributed(coordinator_address=coord, num_processes=num,
+                     process_id=pid)
+    assert jax.process_count() == num, jax.process_count()
+
+    import numpy as np
+
+    from sheep_tpu.core.forest import build_forest
+    from sheep_tpu.core.sequence import degree_sequence
+    from sheep_tpu.parallel.build import build_graph_distributed
+    from sheep_tpu.utils import rmat_edges
+
+    tail, head = rmat_edges(9, 4 << 9, seed=31)
+    seq, forest = build_graph_distributed(tail, head)
+    want_seq = degree_sequence(tail, head)
+    want = build_forest(tail, head, want_seq)
+    np.testing.assert_array_equal(seq, want_seq)
+    np.testing.assert_array_equal(forest.parent, want.parent)
+    np.testing.assert_array_equal(forest.pst_weight, want.pst_weight)
 
     with open(os.path.join(out_dir, f"ok.{pid}"), "w") as f:
         f.write("ok")

@@ -98,27 +98,36 @@ def test_hepth_distributed(hep_edges):
     np.testing.assert_array_equal(forest.pst_weight, want.pst_weight)
 
 
-def test_init_distributed_two_process_cpu(tmp_path):
-    """init_distributed (parallel/mesh.py) joins a real 2-process
-    coordination service on CPU — the DCN/multi-host analog of the
-    reference's mpiexec across nodes (data/slurm-uk2007)."""
+def _two_process_env(repo):
     import socket
-    import subprocess
-    import sys
 
     with socket.socket() as s:
         s.bind(("127.0.0.1", 0))
         port = s.getsockname()[1]
     coord = f"127.0.0.1:{port}"
-    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    worker = os.path.join(repo, "tests", "distributed_worker.py")
     env = dict(os.environ)
     env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
     env["JAX_PLATFORMS"] = "cpu"
     # one device per process: the mesh must span processes to work at all
     env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+    return coord, env
+
+
+@pytest.mark.parametrize("mode", ["degree", "build"])
+def test_init_distributed_two_process_cpu(tmp_path, mode):
+    """init_distributed (parallel/mesh.py) joins a real 2-process
+    coordination service on CPU — the DCN/multi-host analog of the
+    reference's mpiexec across nodes (data/slurm-uk2007).  'degree' runs
+    the distributed degree sort; 'build' the full -i -r pipeline via
+    build_graph_distributed with global-array staging, oracle-checked."""
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    worker = os.path.join(repo, "tests", "distributed_worker.py")
+    coord, env = _two_process_env(repo)
     procs = [subprocess.Popen(
-        [sys.executable, worker, coord, "2", str(pid), str(tmp_path)],
+        [sys.executable, worker, coord, "2", str(pid), str(tmp_path), mode],
         stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True, env=env)
         for pid in range(2)]
     outs = [p.communicate(timeout=300) for p in procs]
@@ -126,6 +135,44 @@ def test_init_distributed_two_process_cpu(tmp_path):
         assert p.returncode == 0, out + err
     assert os.path.exists(tmp_path / "ok.0")
     assert os.path.exists(tmp_path / "ok.1")
+
+
+def test_graph2tree_cli_two_process(tmp_path):
+    """`graph2tree -i -r` under the multi-host launcher contract
+    (SHEEP_COORDINATOR/_NUM_PROCESSES/_PROCESS_ID): two processes join one
+    mesh, only the leader writes, and the tree is byte-identical to the
+    serial CLI's."""
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    graph = os.path.join(repo, "data", "hep-th.dat")
+    coord, env = _two_process_env(repo)
+    serial_tre = tmp_path / "serial.tre"
+    r = subprocess.run(
+        [sys.executable, "-m", "sheep_tpu.cli.graph2tree", graph,
+         "-o", str(serial_tre)],
+        capture_output=True, text=True, env=env, timeout=300)
+    assert r.returncode == 0, r.stdout + r.stderr
+
+    dist_tre = tmp_path / "dist.tre"
+    procs = []
+    for pid in range(2):
+        penv = dict(env)
+        penv.update({"SHEEP_COORDINATOR": coord,
+                     "SHEEP_NUM_PROCESSES": "2",
+                     "SHEEP_PROCESS_ID": str(pid)})
+        procs.append(subprocess.Popen(
+            [sys.executable, "-m", "sheep_tpu.cli.graph2tree", graph,
+             "-i", "-r", "-o", str(dist_tre)],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            env=penv))
+    outs = [p.communicate(timeout=300) for p in procs]
+    for p, (out, err) in zip(procs, outs):
+        assert p.returncode == 0, out + err
+    # leader-only phase grammar: process 1 must not print phase lines
+    assert "Mapped in:" in outs[0][0] and "Mapped in:" not in outs[1][0]
+    assert dist_tre.read_bytes() == serial_tre.read_bytes()
 
 
 @pytest.mark.parametrize("with_seq", [False, True])

@@ -228,7 +228,14 @@ def main(argv: list[str] | None = None) -> int:
             _finish_sort(seq, use_mesh_sort, sequence_filename, clock,
                          leader=is_leader, writer=proc0)
             max_vid = edges.max_vid
-            if workers <= len(jax.devices()) and len(edges.tail):
+            # Multi-host: the device path's mesh must span all global
+            # devices (a smaller mesh would exclude later hosts' devices
+            # while their processes still drive the program); any other
+            # worker count takes the host fallback, which has no
+            # collectives and keeps the W-partials file contract.
+            mesh_ok = jax.process_count() == 1 \
+                or workers == len(jax.devices())
+            if workers <= len(jax.devices()) and len(edges.tail) and mesh_ok:
                 from ..parallel.build import map_graph_distributed
                 _, partials = map_graph_distributed(
                     edges.tail, edges.head, num_workers=workers, seq=seq)

@@ -2,6 +2,7 @@ from .mesh import (AXIS, make_mesh, edge_sharding, replicated,
                    init_distributed)
 from .build import (distributed_build_step, build_graph_distributed,
                     map_graph_distributed)
+from .stream import build_graph_streaming_sharded
 
 __all__ = [
     "AXIS",
@@ -12,4 +13,5 @@ __all__ = [
     "distributed_build_step",
     "build_graph_distributed",
     "map_graph_distributed",
+    "build_graph_streaming_sharded",
 ]

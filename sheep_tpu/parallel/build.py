@@ -37,6 +37,37 @@ from ..ops.sort import degree_order
 from .mesh import AXIS, make_mesh
 
 
+def _links_from_positions(pt, ph, n: int):
+    """Shared per-shard link mapping: position pairs -> (lo, hi, pst_local).
+
+    The pst/absent-vid contract (jtree.cpp:47-49): every edge whose
+    earlier endpoint is present counts toward pst — including edges to
+    absent vids (position >= n), which never insert and stay postorder
+    forever; only self-loops/padding/both-absent (lo == hi) are excluded.
+    The returned lo/hi are sentinel-masked for the fixpoint, which must
+    see only fully-present links.
+    """
+    sent = jnp.int32(n)
+    lo = jnp.minimum(pt, ph)
+    hi = jnp.maximum(pt, ph)
+    pst_local = pst_weights(jnp.where(lo == hi, sent, lo), n)
+    dead = (lo >= hi) | (hi >= sent)
+    return jnp.where(dead, sent, lo), jnp.where(dead, sent, hi), pst_local
+
+
+def _gather_merge(parent_local, n: int):
+    """All-gather the per-worker partial forests and rebuild associatively
+    (the reference's non-commutative MPI_Reduce custom op,
+    lib/jnode.cpp:203-250).  Returns (parent, rounds), replicated."""
+    sent = jnp.int32(n)
+    parents = lax.all_gather(parent_local, AXIS)  # [W, n]
+    kid = jnp.broadcast_to(jnp.arange(n, dtype=jnp.int32), parents.shape)
+    live = parents < sent
+    mlo = jnp.where(live, kid, sent).reshape(-1)
+    mhi = jnp.where(live, parents, sent).reshape(-1)
+    return forest_fixpoint(mlo, mhi, n)
+
+
 def _sharded_build(tail, head, given_pos, n: int, do_merge: bool = True):
     """Per-shard body; runs under shard_map over the 'workers' axis.
 
@@ -68,19 +99,7 @@ def _sharded_build(tail, head, given_pos, n: int, do_merge: bool = True):
 
     # --- map: local partial forest over the shared sequence ---
     pos_ext = jnp.concatenate([pos, jnp.full((1,), sent, jnp.int32)])
-    pt = pos_ext[t]
-    ph = pos_ext[h]
-    lo = jnp.minimum(pt, ph)
-    hi = jnp.maximum(pt, ph)
-    # pst counts every edge whose earlier endpoint is present — including
-    # edges to absent vids (hi == sent), which never insert and so stay
-    # postorder forever (jtree.cpp:47-49).  Only self-loops / padding /
-    # both-absent (lo == hi) are excluded.
-    pst_local = pst_weights(jnp.where(lo == hi, sent, lo), n)
-    # The forest sees only fully-present links.
-    dead = (lo >= hi) | (hi >= sent)
-    lo = jnp.where(dead, sent, lo)
-    hi = jnp.where(dead, sent, hi)
+    lo, hi, pst_local = _links_from_positions(pos_ext[t], pos_ext[h], n)
     parent_local, map_rounds = forest_fixpoint(lo, hi, n)
 
     if not do_merge:
@@ -95,12 +114,7 @@ def _sharded_build(tail, head, given_pos, n: int, do_merge: bool = True):
     # ops/forest.py); at multi-chip scale the merge should move to the
     # chunked hosted driver between shard_map sections.  Single-chip
     # hardware runs use ops.build / the hosted driver and never enter here.
-    parents = lax.all_gather(parent_local, AXIS)  # [W, n]
-    kid = jnp.broadcast_to(jnp.arange(n, dtype=jnp.int32), parents.shape)
-    live = parents < n
-    mlo = jnp.where(live, kid, sent).reshape(-1)
-    mhi = jnp.where(live, parents, sent).reshape(-1)
-    parent, rounds = forest_fixpoint(mlo, mhi, n)
+    parent, rounds = _gather_merge(parent_local, n)
     pst = lax.psum(pst_local, AXIS)
     return seq, pos, m, parent, pst, rounds
 
@@ -162,7 +176,9 @@ def _stage(x_np, mesh, spec):
     from jax.sharding import NamedSharding
     sharding = NamedSharding(mesh, spec)
     if jax.process_count() == 1:
-        return jax.device_put(jnp.asarray(x_np), sharding)
+        # host memory straight into the shards — no staging copy on the
+        # default device first
+        return jax.device_put(x_np, sharding)
     return jax.make_array_from_callback(
         x_np.shape, sharding, lambda idx: x_np[idx])
 

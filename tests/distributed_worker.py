@@ -19,8 +19,8 @@ def main() -> None:
                                 int(sys.argv[3]), sys.argv[4])
     mode = sys.argv[5] if len(sys.argv) > 5 else "degree"
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
-    if mode == "build":
-        return main_build(coord, num, pid, out_dir)
+    if mode in ("build", "stream"):
+        return main_build(coord, num, pid, out_dir, mode)
 
     import numpy as np
 
@@ -82,10 +82,12 @@ def main() -> None:
         f.write("ok")
 
 
-def main_build(coord: str, num: int, pid: int, out_dir: str) -> None:
-    """Full `-i -r` pipeline across processes: build_graph_distributed
-    over a mesh spanning both processes (global-array staging via
-    parallel.build._stage), checked against the sequential oracle."""
+def main_build(coord: str, num: int, pid: int, out_dir: str,
+               mode: str) -> None:
+    """Cross-process pipelines over a mesh spanning both processes
+    (global-array staging via parallel.build._stage), checked against the
+    sequential oracle: 'build' = the full `-i -r` path, 'stream' = OOM
+    block streaming composed with the mesh."""
     from sheep_tpu.cli.common import ensure_jax_platform
     ensure_jax_platform()
     import jax
@@ -99,16 +101,32 @@ def main_build(coord: str, num: int, pid: int, out_dir: str) -> None:
 
     from sheep_tpu.core.forest import build_forest
     from sheep_tpu.core.sequence import degree_sequence
-    from sheep_tpu.parallel.build import build_graph_distributed
     from sheep_tpu.utils import rmat_edges
 
     tail, head = rmat_edges(9, 4 << 9, seed=31)
-    seq, forest = build_graph_distributed(tail, head)
     want_seq = degree_sequence(tail, head)
     want = build_forest(tail, head, want_seq)
-    np.testing.assert_array_equal(seq, want_seq)
-    np.testing.assert_array_equal(forest.parent, want.parent)
-    np.testing.assert_array_equal(forest.pst_weight, want.pst_weight)
+    if mode == "build":
+        from sheep_tpu.parallel.build import build_graph_distributed
+        seq, forest = build_graph_distributed(tail, head)
+        np.testing.assert_array_equal(seq, want_seq)
+        np.testing.assert_array_equal(forest.parent, want.parent)
+        np.testing.assert_array_equal(forest.pst_weight, want.pst_weight)
+    else:
+        from sheep_tpu.core.sequence import sequence_positions
+        from sheep_tpu.parallel import build_graph_streaming_sharded
+        n = int(max(tail.max(), head.max())) + 1
+        n = max(n, len(want_seq))
+        pos = sequence_positions(want_seq, n - 1)
+        block = len(tail) // 3 + 1
+        forest, _ = build_graph_streaming_sharded(
+            ((tail[a:a + block], head[a:a + block])
+             for a in range(0, len(tail), block)),
+            n, pos, block_edges=block)
+        m = len(want_seq)
+        np.testing.assert_array_equal(forest.parent[:m], want.parent)
+        np.testing.assert_array_equal(forest.pst_weight[:m],
+                                      want.pst_weight)
 
     with open(os.path.join(out_dir, f"ok.{pid}"), "w") as f:
         f.write("ok")

@@ -155,3 +155,41 @@ def test_streaming_composes_with_logical_workers():
     merged = merge_forests(*partials)
     np.testing.assert_array_equal(merged.parent, want.parent)
     np.testing.assert_array_equal(merged.pst_weight, want.pst_weight)
+
+
+@pytest.mark.parametrize("workers", [2, 3, 8])
+@pytest.mark.parametrize("block", [13, 256])
+def test_streaming_sharded_matches_oracle(workers, block):
+    """OOM streaming composed with the mesh: blocks sharded over the
+    'workers' axis, carry merged associatively per block."""
+    from sheep_tpu.parallel import build_graph_streaming_sharded
+
+    rng = np.random.default_rng(200 + workers)
+    tail, head = random_multigraph(rng, n_max=60, e_max=300)
+    seq = degree_sequence(tail, head)
+    n_vid = int(max(tail.max(), head.max())) + 1
+    n = max(n_vid, len(seq))
+    pos = sequence_positions(seq, n - 1)
+    forest, _ = build_graph_streaming_sharded(
+        _blocks(tail, head, block), n, pos, block_edges=block,
+        num_workers=workers)
+    want = build_forest(tail, head, seq, max_vid=n - 1, impl="python")
+    m = len(seq)
+    np.testing.assert_array_equal(forest.parent[:m], want.parent)
+    np.testing.assert_array_equal(forest.pst_weight[:m], want.pst_weight)
+    assert (forest.pst_weight[m:] == 0).all()
+
+
+def test_streaming_sharded_hepth(hep_edges):
+    from sheep_tpu.parallel import build_graph_streaming_sharded
+
+    seq = degree_sequence(hep_edges.tail, hep_edges.head)
+    n = max(hep_edges.max_vid + 1, len(seq))
+    pos = sequence_positions(seq, n - 1)
+    forest, _ = build_graph_streaming_sharded(
+        _blocks(hep_edges.tail, hep_edges.head, 8192), n, pos,
+        block_edges=8192, num_workers=8)
+    want = build_forest(hep_edges.tail, hep_edges.head, seq)
+    m = len(seq)
+    np.testing.assert_array_equal(forest.parent[:m], want.parent)
+    np.testing.assert_array_equal(forest.pst_weight[:m], want.pst_weight)

@@ -161,6 +161,24 @@ def test_dist_partition_script(mode):
         assert "Reduced in 0.0 seconds." not in proc.stdout
 
 
+def test_dist_partition_script_mesh_multiprocess():
+    """`dist-partition.sh -i -r` with SHEEP_PROCS=2: the script launches
+    two graph2tree processes joined into one jax.distributed mesh (the
+    mpiexec analog) and the quality goldens hold."""
+    env = cli_env({"SHEEP_PROCS": "2",
+                   # one local device per process: the mesh must span the
+                   # two processes for the build to work at all
+                   "XLA_FLAGS": "--xla_force_host_platform_device_count=1"})
+    proc = subprocess.run(
+        ["bash", os.path.join(REPO, "scripts", "dist-partition.sh"),
+         "-i", "-r", "-w", "2", "data/hep-th.dat", "2"],
+        capture_output=True, text=True, timeout=600, env=env, cwd=REPO)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "ECV(down): 521" in proc.stdout
+    # the leader prints the phase grammar exactly once
+    assert proc.stdout.count("Mapped in") == 1
+
+
 def test_partition_tree_pre_weight(tmp_path):
     # -u with -g recomputes the reference's USE_PRE_WEIGHT model from the
     # graph (lib/partition.cpp:38-48) and must actually shift the weights:

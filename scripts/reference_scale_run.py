@@ -61,21 +61,44 @@ def main() -> None:
     rec["sort_s"] = round(time.time() - t0, 2)
     print(f"Sorted in: {rec['sort_s']} seconds", flush=True)
 
-    # 3. load + native map
-    from sheep_tpu.io.edges import read_dat
-    t0 = time.time()
-    el = read_dat(path)
-    rec["load_s"] = round(time.time() - t0, 2)
-    print(f"Loaded graph in: {rec['load_s']} seconds", flush=True)
-
+    # 3. map.  Default: whole-graph load + one native pass (the
+    # reference's in-RAM map).  SHEEP_REFSCALE_STREAM=1 instead runs the
+    # bounded-memory carry-fold (core.build_forest_streaming, the
+    # data/oom analog): O(n + block) resident, never holding the 11.8GB
+    # edge arrays — the load phase disappears into the stream.
     from sheep_tpu.core.forest import native_or_none
     from sheep_tpu.core.sequence import sequence_positions
     native = native_or_none("auto")
     assert native is not None, "native runtime required at this scale"
-    t0 = time.time()
-    pos = sequence_positions(seq, el.max_vid)
-    lo, hi = native.edges_to_links(el.tail, el.head, pos)
-    parent, pst = native.build_forest_links(lo, hi, len(seq))
+    max_vid = int(seq.max()) if len(seq) else 0
+    if os.environ.get("SHEEP_REFSCALE_STREAM", "") == "1":
+        from sheep_tpu.core.forest import build_forest_streaming
+        from sheep_tpu.io.edges import iter_dat_blocks
+
+        class _El:  # the partition/eval tail only needs max_vid
+            pass
+        el = _El()
+        el.max_vid = max_vid
+        rec["load_s"] = 0.0
+        rec["oom_stream"] = True
+        print("Loaded graph in: 0.0 seconds", flush=True)
+        t0 = time.time()
+        forest = build_forest_streaming(
+            iter_dat_blocks(path, 1 << 24), seq, max_vid=max_vid)
+        pos = sequence_positions(seq, max_vid)
+    else:
+        from sheep_tpu.io.edges import read_dat
+        t0 = time.time()
+        el = read_dat(path)
+        rec["load_s"] = round(time.time() - t0, 2)
+        print(f"Loaded graph in: {rec['load_s']} seconds", flush=True)
+        t0 = time.time()
+        pos = sequence_positions(seq, el.max_vid)
+        lo, hi = native.edges_to_links(el.tail, el.head, pos)
+        parent, pst = native.build_forest_links(lo, hi, len(seq))
+        from sheep_tpu.core.forest import Forest
+        forest = Forest(parent, pst)
+        del lo, hi
     rec["map_s"] = round(time.time() - t0, 2)
     rec["edges_per_sec_native"] = round(records / rec["map_s"], 1)
     rec["vs_twitter_map_aggregate"] = round(
@@ -85,10 +108,6 @@ def main() -> None:
         3)
     print(f"Mapped in: {rec['map_s']} seconds "
           f"({rec['edges_per_sec_native']:.0f} edges/s)", flush=True)
-    del lo, hi
-
-    from sheep_tpu.core.forest import Forest
-    forest = Forest(parent, pst)
 
     # 4. facts
     from sheep_tpu.core.facts import compute_facts
@@ -119,8 +138,10 @@ def main() -> None:
             "ecv_down": int(ev.ecv_down),
             "ecv_down_frac": round(ev.ecv_down / records, 6)}
 
+    name = "REFSCALE_OOM_r03.json" if rec.get("oom_stream") \
+        else "REFSCALE_r03.json"
     out = os.path.join(os.path.dirname(os.path.dirname(
-        os.path.abspath(__file__))), "REFSCALE_r03.json")
+        os.path.abspath(__file__))), name)
     with open(out, "w") as f:
         json.dump(rec, f, indent=1)
     print(json.dumps(rec), flush=True)

@@ -69,16 +69,39 @@ def main() -> None:
     pos = sequence_positions(seq, max_vid).astype(np.int64)
 
     # --- streamed forest build (map+reduce phases fused) ---
+    # Two streamed implementations share the carry-fold design: the native
+    # union-find fold (the host OOM production path — data/oom analog) and
+    # the device chunked-reducer fold (the accelerator path).  Default:
+    # native on the cpu backend, device on accelerators; SHEEP_SCALE_STREAM
+    # overrides with "native"/"device"/"both".
     from sheep_tpu.io.edges import iter_dat_blocks
-    from sheep_tpu.ops import build_graph_streaming_hosted
-    t0 = time.time()
-    forest, rounds = build_graph_streaming_hosted(
-        iter_dat_blocks(path, _BLOCK), n, pos, _BLOCK)
-    map_s = time.time() - t0
+    which = _stream_impl() or ("native" if platform == "cpu" else "device")
+    if which in ("native", "both"):
+        from sheep_tpu.core.forest import build_forest_streaming
+        t0 = time.time()
+        forest = build_forest_streaming(
+            iter_dat_blocks(path, _BLOCK), seq, max_vid=max_vid)
+        map_s = time.time() - t0
+        rec["map_native_stream_s"] = round(map_s, 2)
+        rec["edges_per_sec_stream_native"] = round(records / map_s, 1)
+        rounds = 0
+    if which in ("device", "both"):
+        from sheep_tpu.ops import build_graph_streaming_hosted
+        t0 = time.time()
+        forest_d, rounds = build_graph_streaming_hosted(
+            iter_dat_blocks(path, _BLOCK), n, pos, _BLOCK)
+        map_s = time.time() - t0
+        rec["fixpoint_rounds"] = rounds
+        rec["edges_per_sec_stream_device"] = round(records / map_s, 1)
+        if which == "both":
+            m = len(seq)
+            np.testing.assert_array_equal(forest_d.parent[:m],
+                                          forest.parent[:m])
+        else:
+            forest = forest_d
     print(f"Mapped in: {map_s:f} seconds")
     print(f"Reduced in: 0.000000 seconds")  # fused into the block folds
     rec["map_s"] = round(map_s, 2)
-    rec["fixpoint_rounds"] = rounds
     rec["edges_per_sec_stream"] = round(records / map_s, 1)
 
     from sheep_tpu.core.facts import compute_facts

@@ -4,6 +4,7 @@ from .forest import (
     edges_to_positions,
     build_forest,
     build_forest_links,
+    build_forest_streaming,
     merge_forests,
 )
 from .facts import Facts, compute_facts
@@ -16,6 +17,7 @@ __all__ = [
     "Forest",
     "edges_to_positions",
     "build_forest",
+    "build_forest_streaming",
     "build_forest_links",
     "merge_forests",
     "Facts",

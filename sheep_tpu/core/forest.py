@@ -66,17 +66,24 @@ def edges_to_positions(tail: np.ndarray, head: np.ndarray, seq: np.ndarray,
     "pst-only" — no tree link.
     """
     pos = sequence_positions(seq, max_vid)
-    mx = int(max(tail.max(initial=0), head.max(initial=0))) if len(tail) else 0
-    if mx >= len(pos):  # vids beyond the position table are simply absent
-        pos = np.concatenate(
-            [pos, np.full(mx + 1 - len(pos), INVALID_JNID, np.uint32)])
-    pt = pos[tail].astype(np.int64)
-    ph = pos[head].astype(np.int64)
+    pos, pt, ph = _positions_through(pos, tail, head)
     keep = pt != ph  # drops self-loops and both-absent (INVALID == INVALID)
     pt, ph = pt[keep], ph[keep]
     lo = np.minimum(pt, ph)
     hi = np.maximum(pt, ph)
     return lo, hi
+
+
+def _positions_through(pos: np.ndarray, tail: np.ndarray, head: np.ndarray):
+    """Gather endpoint positions, extending the table over any vids beyond
+    it (they are simply absent — INVALID).  Returns (pos, pt, ph); the
+    possibly-extended table is returned so block-streaming callers can
+    keep it across blocks."""
+    mx = int(max(tail.max(initial=0), head.max(initial=0))) if len(tail) else 0
+    if mx >= len(pos):
+        pos = np.concatenate(
+            [pos, np.full(mx + 1 - len(pos), INVALID_JNID, np.uint32)])
+    return pos, pos[tail].astype(np.int64), pos[head].astype(np.int64)
 
 
 def native_or_none(impl: str):
@@ -188,6 +195,46 @@ def build_forest(tail: np.ndarray, head: np.ndarray, seq: np.ndarray,
         return Forest(p, w)
     lo, hi = edges_to_positions(tail, head, seq, max_vid)
     return build_forest_links(lo, hi, len(seq), impl=impl)
+
+
+def build_forest_streaming(blocks, seq: np.ndarray,
+                           max_vid: int | None = None,
+                           impl: str = "auto") -> Forest:
+    """Bounded-memory forest build from edge blocks (the host OOM path).
+
+    The reference's OOM regime streams edge slices through workers and
+    stitches them with the associative merge (jnode.cpp:174-201,
+    data/oom/); this is that fold on one host: per block, map records
+    through the position table, run the exact union-find on (carry links +
+    block links), and keep only the resulting forest's links as the carry.
+    O(n + block) resident for any edge count, bit-identical to the
+    whole-graph build.  pst accumulates per block (each link counts at its
+    present earlier endpoint, including links to absent vids —
+    jtree.cpp:47-49).
+    """
+    n = len(seq)
+    pos = sequence_positions(seq, max_vid)
+    pst = np.zeros(n, dtype=np.int64)
+    zero_pst = np.zeros(n, dtype=np.uint32)  # pst tracked here, not per fold
+    carry_lo = np.empty(0, dtype=np.int64)
+    carry_hi = np.empty(0, dtype=np.int64)
+    forest = Forest(np.full(n, INVALID_JNID, dtype=np.uint32),
+                    np.zeros(n, dtype=np.uint32))
+    for tail, head in blocks:
+        pos, pt, ph = _positions_through(pos, tail, head)
+        keep = pt != ph  # drops self-loops and both-absent
+        pt, ph = pt[keep], ph[keep]
+        lo = np.minimum(pt, ph)
+        hi = np.maximum(pt, ph)
+        # lo is the present endpoint even for pst-only links (hi INVALID)
+        pst += np.bincount(lo, minlength=n)[:n]
+        tree = hi < n
+        fold_lo = np.concatenate([carry_lo, lo[tree]])
+        fold_hi = np.concatenate([carry_hi, hi[tree]])
+        forest = build_forest_links(fold_lo, fold_hi, n, pst=zero_pst,
+                                    impl=impl)
+        carry_lo, carry_hi = forest_links(forest)
+    return Forest(forest.parent, pst.astype(np.uint32))
 
 
 def forest_links(forest: Forest) -> tuple[np.ndarray, np.ndarray]:

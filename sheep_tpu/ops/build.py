@@ -145,18 +145,7 @@ def build_graph_hybrid(tail: np.ndarray, head: np.ndarray,
     from ..core.forest import native_or_none
 
     if handoff_factor is None:
-        # Tuned per platform: on cpu the "transfer" is free, so hand off as
-        # early as possible (8n ~ after the first dedupe round; measured
-        # 3.3x faster than reducing to 2n).  On a real accelerator the
-        # handoff is a device->host copy over the link (0.5GB at 2^23 for
-        # 8n), so reduce further first.  The pure-python fallback pays per
-        # link: keep reducing to 2n without the native runtime.
-        from ..core.forest import native_or_none as _non
-        if _non("auto") is None:
-            default = "2"
-        else:
-            default = "8" if jax.devices()[0].platform == "cpu" else "3"
-        handoff_factor = int(os.environ.get("SHEEP_HANDOFF_FACTOR", default))
+        handoff_factor = default_handoff_factor()
     n = num_vertices
     if n is None:
         n = int(max(tail.max(initial=0), head.max(initial=0))) + 1 if len(tail) else 0
@@ -203,9 +192,56 @@ def build_graph_hybrid(tail: np.ndarray, head: np.ndarray,
         parent = parent_from_links(lo, hi, n)
         return _finish(fetched.get("seq", seq), fetched.get("m", m), parent,
                        fetched.get("pst", pst))
-    native = native_or_none("auto")
-    # fetch a 64K-granular prefix, not [:live] exactly: each distinct
-    # slice length is a fresh XLA program, and tunneled compiles are slow
+    def _pst_after_fetch():
+        # joined only after the big link fetch inside handoff_finish_native
+        # has completed, so the seq/pst prefetch keeps overlapping it
+        pre.join()
+        return np.asarray(fetched.get("pst", pst)).astype(np.uint32)
+
+    parent_h, pst_out = handoff_finish_native(lo, hi, live, n,
+                                              _pst_after_fetch)
+    m = int(fetched.get("m", m))
+    seq_np = np.asarray(fetched.get("seq", seq))[:m].astype(np.uint32)
+    return seq_np, Forest(parent_h[:m].copy(), pst_out[:m].copy())
+
+
+def default_handoff_factor() -> int:
+    """Platform-tuned handoff threshold (stop_live = factor * n).
+
+    On cpu the "transfer" is free, so hand off as early as possible (8n ~
+    after the first dedupe round; measured 3.3x faster than reducing to
+    2n).  On a real accelerator the handoff is a device->host copy over
+    the link (0.5GB at 2^23 for 8n), so reduce further first.  The
+    pure-python fallback pays per link: keep reducing to 2n without the
+    native runtime.  Env override: SHEEP_HANDOFF_FACTOR.
+    """
+    import os
+
+    from ..core.forest import native_or_none
+    if native_or_none("auto") is None:
+        default = "2"
+    else:
+        default = "8" if jax.devices()[0].platform == "cpu" else "3"
+    return int(os.environ.get("SHEEP_HANDOFF_FACTOR", default))
+
+
+def handoff_finish_native(lo, hi, live: int, n: int, pst_h):
+    """Fetch a reduced link set and finish with the exact sequential
+    union-find (the hybrid tail): returns (parent, pst) uint32 [n].
+
+    lo/hi: device int32 arrays whose first ``live`` slots contain the live
+    links (plus possibly a few dead sentinels — filtered here); pst_h: the
+    accumulated pst counts, host-side — an array, or a zero-arg callable
+    resolved only after the link fetch (lets a caller's prefetch thread
+    overlap that fetch).  The fetch is 64K-granular (each distinct slice
+    length is a fresh XLA program; tunneled compiles are slow) and
+    6-byte-packed where the link is byte-bound (SHEEP_PACK_HANDOFF
+    overrides; needs n < 2^24).
+    """
+    import os
+
+    from ..core.forest import native_or_none
+
     cut = min(int(lo.shape[0]), -(-live // (1 << 16)) * (1 << 16))
     pack = os.environ.get("SHEEP_PACK_HANDOFF", "")
     if pack == "":  # default: pack where the fetch is byte-bound (tunnel)
@@ -219,17 +255,14 @@ def build_graph_hybrid(tail: np.ndarray, head: np.ndarray,
         hi_h = np.asarray(hi[:cut])[:live]
     keep = lo_h < n  # a few scattered dead slots may remain in the prefix
     lo_h, hi_h = lo_h[keep], hi_h[keep]
-    pre.join()
-    pst_h = np.asarray(fetched.get("pst", pst)).astype(np.uint32)
+    if callable(pst_h):
+        pst_h = pst_h()
+    native = native_or_none("auto")
     if native is not None:
-        parent_h, pst_out = native.build_forest_links(
+        return native.build_forest_links(
             lo_h.astype(np.uint32), hi_h.astype(np.uint32), n, pst_h)
-    else:
-        from ..core.forest import build_forest_links
-        forest = build_forest_links(lo_h.astype(np.int64),
-                                    hi_h.astype(np.int64), n, pst=pst_h,
-                                    impl="python")
-        parent_h, pst_out = forest.parent, forest.pst_weight
-    m = int(fetched.get("m", m))
-    seq_np = np.asarray(fetched.get("seq", seq))[:m].astype(np.uint32)
-    return seq_np, Forest(parent_h[:m].copy(), pst_out[:m].copy())
+    from ..core.forest import build_forest_links
+    forest = build_forest_links(lo_h.astype(np.int64),
+                                hi_h.astype(np.int64), n, pst=pst_h,
+                                impl="python")
+    return forest.parent, forest.pst_weight

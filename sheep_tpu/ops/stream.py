@@ -166,15 +166,25 @@ def build_graph_streaming_hosted(blocks, n: int, pos: np.ndarray,
     if carry_lo is None:
         return Forest(np.full(n, INVALID_JNID, np.uint32),
                       np.zeros(n, np.uint32)), 0
-    carry_lo, carry_hi, _, rounds, _ = reduce_links_hosted(
-        carry_lo, carry_hi, n)
+    # Final fold ends like the hybrid: reduce to the platform-tuned
+    # handoff threshold and let the native union-find chase the residue —
+    # the device-convergence tail was measured at hundreds of rounds on
+    # the last few thousand links (SCALE_r03: 781 total rounds).
+    from .build import default_handoff_factor, handoff_finish_native
+    carry_lo, carry_hi, live, rounds, converged = reduce_links_hosted(
+        carry_lo, carry_hi, n, stop_live=default_handoff_factor() * n)
     total_rounds += rounds
-    parent = parent_from_links(carry_lo, carry_hi, n)
-    parent_np = np.asarray(parent).astype(np.int64)
-    out = np.full(n, INVALID_JNID, dtype=np.uint32)
-    live_mask = parent_np < n
-    out[live_mask] = parent_np[live_mask].astype(np.uint32)
-    return Forest(out, np.asarray(pst).astype(np.uint32)), total_rounds
+    pst_np = np.asarray(pst).astype(np.uint32)
+    if converged:
+        parent = parent_from_links(carry_lo, carry_hi, n)
+        parent_np = np.asarray(parent).astype(np.int64)
+        out = np.full(n, INVALID_JNID, dtype=np.uint32)
+        live_mask = parent_np < n
+        out[live_mask] = parent_np[live_mask].astype(np.uint32)
+        return Forest(out, pst_np), total_rounds
+    parent_h, pst_out = handoff_finish_native(
+        carry_lo, carry_hi, live, n, pst_np)
+    return Forest(parent_h.copy(), pst_out.copy()), total_rounds
 
 
 def streaming_degree_histogram(blocks, n: int) -> np.ndarray:

@@ -193,3 +193,37 @@ def test_streaming_sharded_hepth(hep_edges):
     m = len(seq)
     np.testing.assert_array_equal(forest.parent[:m], want.parent)
     np.testing.assert_array_equal(forest.pst_weight[:m], want.pst_weight)
+
+
+@pytest.mark.parametrize("impl", ["python", "auto"])
+@pytest.mark.parametrize("block", [7, 64, 10_000])
+def test_native_streaming_fold_matches_oracle(impl, block):
+    """core.build_forest_streaming: the host OOM carry-fold, both impls."""
+    from sheep_tpu.core.forest import build_forest_streaming
+
+    rng = np.random.default_rng(321)
+    tail, head = random_multigraph(rng, n_max=60, e_max=300)
+    seq = degree_sequence(tail, head)
+    n_vid = int(max(tail.max(), head.max())) + 1
+    want = build_forest(tail, head, seq, max_vid=n_vid - 1, impl="python")
+    forest = build_forest_streaming(
+        _blocks(tail, head, block), seq, max_vid=n_vid - 1, impl=impl)
+    np.testing.assert_array_equal(forest.parent, want.parent)
+    np.testing.assert_array_equal(forest.pst_weight, want.pst_weight)
+
+
+def test_native_streaming_fold_partial_sequence():
+    # links to vids absent from the sequence stay pst-only, exactly like
+    # the whole-graph build (jtree.cpp:47-49 contract)
+    from sheep_tpu.core.forest import build_forest_streaming
+
+    rng = np.random.default_rng(322)
+    tail, head = random_multigraph(rng, n_max=40, e_max=160)
+    full = degree_sequence(tail, head)
+    seq = full[: max(1, len(full) - 3)]
+    n_vid = int(max(tail.max(), head.max())) + 1
+    want = build_forest(tail, head, seq, max_vid=n_vid - 1, impl="python")
+    forest = build_forest_streaming(
+        _blocks(tail, head, 11), seq, max_vid=n_vid - 1)
+    np.testing.assert_array_equal(forest.parent, want.parent)
+    np.testing.assert_array_equal(forest.pst_weight, want.pst_weight)

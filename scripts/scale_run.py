@@ -11,7 +11,8 @@ horizontal-dist.sh OOM mode):
   5. native FFD partition + O(n)-memory streamed ECV evaluation
 
 Emits the reference's phase-line grammar plus one final JSON record, also
-written to SCALE_r03.json at the repo root.
+written to SCALE_r04.json at the repo root when the run is at artifact
+scale (>= 100M records; smaller validation runs only print).
 
 Usage: python scripts/scale_run.py [log_n] [edge_factor] [parts]
 Defaults: 2^23 vertices x 16 = 134M records, 8 parts.
@@ -30,6 +31,15 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import numpy as np
 
 _BLOCK = 1 << 24  # 16M records per streamed block
+
+
+def _stream_impl() -> str | None:
+    """SHEEP_SCALE_STREAM override: "native" / "device" / "both" / unset."""
+    which = os.environ.get("SHEEP_SCALE_STREAM", "") or None
+    if which not in (None, "native", "device", "both"):
+        raise SystemExit(f"SHEEP_SCALE_STREAM={which!r}: expected "
+                         "'native', 'device', or 'both'")
+    return which
 
 
 def main() -> None:
@@ -147,10 +157,13 @@ def main() -> None:
     rec["ecv_down"] = report.ecv_down
     rec["ecv_down_frac"] = round(report.ecv_down / records, 6)
 
-    out = os.path.join(os.path.dirname(os.path.dirname(
-        os.path.abspath(__file__))), "SCALE_r03.json")
-    with open(out, "w") as f:
-        f.write(json.dumps(rec) + "\n")
+    # Only a BASELINE-config-5-shaped run (>=100M records) replaces the
+    # round artifact — small validation invocations must not clobber it.
+    if records >= 100_000_000:
+        out = os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "SCALE_r04.json")
+        with open(out, "w") as f:
+            f.write(json.dumps(rec) + "\n")
     print(json.dumps(rec))
 
 

@@ -44,7 +44,9 @@ def stream_block_step_sharded(parent: jnp.ndarray, pst: jnp.ndarray,
                               pos: jnp.ndarray, n: int, mesh):
     """Fold one mesh-sharded edge block into the replicated carry forest.
 
-    parent/pst int32 [n] replicated; tail/head int32 [B] sharded over
+    parent int32, pst uint32 [n] replicated (uint32 so the running
+    accumulation honors the package-wide uint32 weight contract instead of
+    wrapping negative at 2^31); tail/head int32 [B] sharded over
     'workers' (pad with values >= len(pos)-1); pos the _full_vid_pos table.
     Returns (parent, pst, rounds) replicated.
     """
@@ -58,7 +60,12 @@ def stream_block_step_sharded(parent: jnp.ndarray, pst: jnp.ndarray,
                                      jnp.concatenate([chi, bhi]), n)
         # per-block associative merge of the partial forests (mpi_merge)
         new_parent, rounds = _gather_merge(p_local, n)
-        return new_parent, pst + lax.psum(pst_local, AXIS), rounds
+        # per-block delta is int32-safe (a block holds < 2^31 edges); the
+        # running carry is uint32 so cumulative counts follow the uint32
+        # weight contract rather than wrapping negative at 2^31
+        return (new_parent,
+                pst + lax.psum(pst_local, AXIS).astype(jnp.uint32),
+                rounds)
 
     fn = shard_map(body, mesh=mesh,
                    in_specs=(P(), P(), P(AXIS), P(AXIS), P()),
@@ -83,7 +90,7 @@ def build_graph_streaming_sharded(blocks, n: int, pos: np.ndarray,
     # staged replicated so the step is multi-process safe; the step's
     # replicated outputs feed back in as global arrays directly
     parent = _stage(np.full(n, n, dtype=np.int32), mesh, P())
-    pst = _stage(np.zeros(n, dtype=np.int32), mesh, P())
+    pst = _stage(np.zeros(n, dtype=np.uint32), mesh, P())
     round_counts = []
     for tail, head in blocks:
         b = len(tail)
@@ -101,4 +108,4 @@ def build_graph_streaming_sharded(blocks, n: int, pos: np.ndarray,
     out = np.full(n, INVALID_JNID, dtype=np.uint32)
     live = parent_np < n
     out[live] = parent_np[live].astype(np.uint32)
-    return Forest(out, _fetch(pst).astype(np.uint32)), total_rounds
+    return Forest(out, np.asarray(_fetch(pst), dtype=np.uint32)), total_rounds

@@ -1,0 +1,181 @@
+"""Search the BC-sequence convention space against the reference's raw log.
+
+The reference's hep.centrality.raw (the sheep-BC column's raw evaluator
+output) fingerprints its unshipped external ordering: at 2 parts the
+partition sizes are 2945/4665 with edges cut 2452 and ECV(down) 314.  The
+ordering tool/conventions are not recorded anywhere in the reference, so
+this script enumerates plausible centrality-ordering conventions (exact
+Brandes ascending/descending, endpoints counted or not, multigraph path
+counts, tie-breaks, closeness, PageRank, degree-weighted hybrids), builds
+the tree + 2/3/4-part partitions for each, and reports the fingerprint
+distance — the convention that reproduces the raw log becomes the
+shipped `--seq bc` ordering in scripts/bc_quality.py.
+
+Usage: python scripts/bc_search.py [graph.dat]
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+from scripts.bc_quality import brandes_betweenness
+
+# (parts -> (size0, size1, edges_cut, ecv_down)) from hep.centrality.raw
+RAW_FP = {
+    2: (2945, 4665, 2452, 314),
+    3: (1644, 2298, 3151, 585),
+    4: (1332, 1634, 3634, 766),
+}
+
+
+def closeness(tail, head, n):
+    """Unweighted closeness (within-component, Wasserman-Faust scaled)."""
+    und = tail != head
+    a = np.minimum(tail[und], head[und]).astype(np.int64)
+    b = np.maximum(tail[und], head[und]).astype(np.int64)
+    key = np.unique(a * n + b)
+    a, b = key // n, key % n
+    src = np.concatenate([a, b])
+    dst = np.concatenate([b, a])
+    order = np.argsort(src, kind="stable")
+    adj = dst[order]
+    deg = np.bincount(src, minlength=n)
+    offs = np.zeros(n + 1, dtype=np.int64)
+    np.cumsum(deg, out=offs[1:])
+    out = np.zeros(n, dtype=np.float64)
+    for s in range(n):
+        if offs[s] == offs[s + 1]:
+            continue
+        dist = np.full(n, -1, np.int64)
+        dist[s] = 0
+        frontier = np.array([s], np.int64)
+        d = 0
+        total = 0
+        reach = 0
+        while len(frontier):
+            nxt = []
+            for v in frontier:
+                nb = adj[offs[v]:offs[v + 1]]
+                new = nb[dist[nb] == -1]
+                if len(new):
+                    dist[new] = d + 1
+                    nxt.append(np.unique(new))
+            d += 1
+            frontier = np.unique(np.concatenate(nxt)) if nxt else \
+                np.empty(0, np.int64)
+            total += d * len(frontier)
+            reach += len(frontier)
+        if total:
+            out[s] = (reach / (n - 1)) * (reach / total)
+    return out
+
+
+def pagerank(tail, head, n, damping=0.85, iters=100):
+    und = tail != head
+    a = np.minimum(tail[und], head[und]).astype(np.int64)
+    b = np.maximum(tail[und], head[und]).astype(np.int64)
+    key = np.unique(a * n + b)
+    a, b = key // n, key % n
+    src = np.concatenate([a, b])
+    dst = np.concatenate([b, a])
+    deg = np.bincount(src, minlength=n).astype(np.float64)
+    pr = np.full(n, 1.0 / n)
+    safe_deg = np.where(deg > 0, deg, 1.0)
+    for _ in range(iters):
+        contrib = pr / safe_deg
+        nxt = np.zeros(n)
+        np.add.at(nxt, dst, contrib[src])
+        pr = (1 - damping) / n + damping * nxt
+    return pr
+
+
+def fingerprint(seq, el):
+    from sheep_tpu.core import build_forest
+    from sheep_tpu.partition import Partition, evaluate_partition
+
+    forest = build_forest(el.tail, el.head, seq)
+    fp = {}
+    for parts in RAW_FP:
+        p = Partition.from_forest(seq, forest, parts, max_vid=el.max_vid)
+        ev = evaluate_partition(p.parts, el.tail, el.head, seq, parts,
+                                max_vid=el.max_vid, file_edges=el.num_edges)
+        sizes = np.bincount(p.parts[p.parts >= 0], minlength=parts)
+        fp[parts] = (int(sizes[0]), int(sizes[1]), int(ev.edges_cut),
+                     int(ev.ecv_down))
+    return fp
+
+
+def score(fp):
+    """Relative fingerprint distance; 0 = exact reproduction."""
+    tot = 0.0
+    for parts, want in RAW_FP.items():
+        got = fp[parts]
+        tot += sum(abs(g - w) / max(1, w) for g, w in zip(got, want))
+    return tot
+
+
+def main() -> None:
+    path = sys.argv[1] if len(sys.argv) > 1 else "data/hep-th.dat"
+    from sheep_tpu.io import load_edges
+
+    el = load_edges(path)
+    n = el.max_vid + 1
+    t64 = el.tail.astype(np.int64)
+    h64 = el.head.astype(np.int64)
+
+    deg = np.bincount(t64, minlength=n) + np.bincount(h64, minlength=n)
+    active = np.nonzero(deg)[0]
+
+    def order_by(metric, descending=False, tie="vid"):
+        m = metric[active]
+        if descending:
+            m = -m
+        if tie == "vid":
+            idx = np.lexsort((active, m))
+        elif tie == "deg":
+            idx = np.lexsort((active, deg[active], m))
+        else:
+            idx = np.lexsort((-active, m))
+        return active[idx].astype(np.uint32)
+
+    print("computing centralities...", file=sys.stderr)
+    bc = brandes_betweenness(t64, h64, n)
+    cl = closeness(el.tail, el.head, n)
+    pr = pagerank(el.tail, el.head, n)
+
+    candidates = {
+        "bc_asc_vid": order_by(bc),
+        "bc_desc_vid": order_by(bc, descending=True),
+        "bc_asc_deg_tie": order_by(bc, tie="deg"),
+        "bc_asc_vid_desc_tie": order_by(bc, tie="vid_desc"),
+        "closeness_asc": order_by(cl),
+        "closeness_desc": order_by(cl, descending=True),
+        "pagerank_asc": order_by(pr),
+        "pagerank_desc": order_by(pr, descending=True),
+        # rounded BC (an external tool printing %.6f then sorting keeps
+        # ties in input order -> vid): quantized ascending
+        "bc_asc_round6": order_by(np.round(bc, 6)),
+        "bc_asc_round2": order_by(np.round(bc, 2)),
+    }
+
+    results = []
+    for name, seq in candidates.items():
+        fp = fingerprint(seq, el)
+        s = score(fp)
+        results.append((s, name, fp))
+        print(f"{name:24s} score={s:8.3f} 2-part={fp[2]}", flush=True)
+    results.sort(key=lambda r: r[0])
+    best = results[0]
+    print(json.dumps({"best": best[1], "score": round(best[0], 4),
+                      "fingerprint": {str(k): v for k, v in best[2].items()},
+                      "raw": {str(k): v for k, v in RAW_FP.items()}}))
+
+
+if __name__ == "__main__":
+    main()

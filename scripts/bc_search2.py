@@ -1,0 +1,176 @@
+"""Round 2 of the BC-convention search (see bc_search.py).
+
+Ascending exact undirected Brandes got partition sizes within 1% of the
+reference's raw log but 29% worse edges-cut — the convention family is
+right, the path-count details are not.  This round tries: directed path
+counts (a 2015-era tool fed the .dat arc list without symmetrizing),
+multigraph path counts (no dedup of parallel records), endpoint counting,
+and stable re-sorts of the degree sequence by BC.
+
+Usage: python scripts/bc_search2.py [graph.dat]
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+from scripts.bc_search import RAW_FP, fingerprint, score
+
+
+def brandes_general(tail, head, n, directed=False, dedup=True,
+                    endpoints=False):
+    """Brandes betweenness with convention switches.
+
+    directed: path counts follow stored arc direction only.
+    dedup: drop parallel edges (False counts them as parallel shortest
+    paths, the multigraph sigma convention).
+    endpoints: count path endpoints (igraph/networkx endpoints=True).
+    """
+    und = tail != head
+    a = tail[und].astype(np.int64)
+    b = head[und].astype(np.int64)
+    if not directed:
+        lo = np.minimum(a, b)
+        hi = np.maximum(a, b)
+        a, b = lo, hi
+    if dedup:
+        key = np.unique(a * n + b)
+        a, b = key // n, key % n
+    if directed:
+        src, dst = a, b
+    else:
+        src = np.concatenate([a, b])
+        dst = np.concatenate([b, a])
+    order = np.argsort(src, kind="stable")
+    adj = dst[order]
+    deg = np.bincount(src, minlength=n)
+    offs = np.zeros(n + 1, dtype=np.int64)
+    np.cumsum(deg, out=offs[1:])
+
+    def slices(frontier):
+        counts = deg[frontier]
+        total = int(counts.sum())
+        within = np.arange(total) - np.repeat(
+            np.cumsum(counts) - counts, counts)
+        idx = np.repeat(offs[frontier], counts) + within
+        return adj[idx], np.repeat(frontier, counts)
+
+    # reverse adjacency for the directed dependency pass
+    if directed:
+        rorder = np.argsort(dst, kind="stable")
+        radj = src[rorder]
+        rdeg = np.bincount(dst, minlength=n)
+        roffs = np.zeros(n + 1, dtype=np.int64)
+        np.cumsum(rdeg, out=roffs[1:])
+
+        def rslices(frontier):
+            counts = rdeg[frontier]
+            total = int(counts.sum())
+            within = np.arange(total) - np.repeat(
+                np.cumsum(counts) - counts, counts)
+            idx = np.repeat(roffs[frontier], counts) + within
+            return radj[idx], np.repeat(frontier, counts)
+    else:
+        rslices = slices
+
+    bc = np.zeros(n, dtype=np.float64)
+    start = np.nonzero((offs[1:] > offs[:-1]) |
+                       (directed and (np.bincount(dst, minlength=n) > 0)))[0] \
+        if directed else np.nonzero(offs[1:] > offs[:-1])[0]
+    for s in start:
+        dist = np.full(n, -1, dtype=np.int64)
+        sigma = np.zeros(n, dtype=np.float64)
+        dist[s] = 0
+        sigma[s] = 1.0
+        frontier = np.array([s], dtype=np.int64)
+        levels = [frontier]
+        d = 0
+        reach = 0
+        while len(frontier):
+            nbrs, srcs = slices(frontier)
+            new_mask = dist[nbrs] == -1
+            if new_mask.any():
+                dist[nbrs[new_mask]] = d + 1
+            onlevel = dist[nbrs] == d + 1
+            np.add.at(sigma, nbrs[onlevel], sigma[srcs[onlevel]])
+            frontier = np.unique(nbrs[new_mask])
+            d += 1
+            if len(frontier):
+                levels.append(frontier)
+                reach += len(frontier)
+        delta = np.zeros(n, dtype=np.float64)
+        for frontier in reversed(levels[1:]):
+            nbrs, srcs = rslices(frontier)
+            pred = dist[nbrs] == dist[srcs] - 1
+            contrib = (sigma[nbrs[pred]] / sigma[srcs[pred]]) * \
+                (1.0 + delta[srcs[pred]])
+            np.add.at(delta, nbrs[pred], contrib)
+        delta[s] = 0.0
+        if endpoints:
+            # every reached t adds 1 to both s and t for the s->t paths
+            bc[s] += reach
+            reached = dist >= 1
+            bc[reached] += 1.0
+        bc += delta
+    if not directed:
+        bc = bc / 2.0
+    return bc
+
+
+def main() -> None:
+    path = sys.argv[1] if len(sys.argv) > 1 else "data/hep-th.dat"
+    from sheep_tpu.io import load_edges
+    from sheep_tpu.core import degree_sequence
+
+    el = load_edges(path)
+    n = el.max_vid + 1
+    deg = np.bincount(el.tail.astype(np.int64), minlength=n) + \
+        np.bincount(el.head.astype(np.int64), minlength=n)
+    active = np.nonzero(deg)[0]
+    degseq = degree_sequence(el.tail, el.head)
+
+    def order_by(metric):
+        m = metric[active]
+        return active[np.lexsort((active, m))].astype(np.uint32)
+
+    variants = {
+        "bc_directed": dict(directed=True),
+        "bc_multigraph": dict(dedup=False),
+        "bc_endpoints": dict(endpoints=True),
+        "bc_directed_multi": dict(directed=True, dedup=False),
+    }
+    candidates = {}
+    for name, kw in variants.items():
+        print(f"computing {name}...", file=sys.stderr, flush=True)
+        bc = brandes_general(el.tail.astype(np.int64),
+                             el.head.astype(np.int64), n, **kw)
+        candidates[name] = order_by(bc)
+
+    # stable re-sort of the degree sequence by undirected BC: equal-BC
+    # runs keep DEGREE order instead of vid order
+    bc_u = brandes_general(el.tail.astype(np.int64),
+                           el.head.astype(np.int64), n)
+    stable = degseq[np.argsort(bc_u[degseq], kind="stable")]
+    candidates["bc_stable_over_degseq"] = stable.astype(np.uint32)
+
+    results = []
+    for name, seq in candidates.items():
+        fp = fingerprint(seq, el)
+        s = score(fp)
+        results.append((s, name, fp))
+        print(f"{name:24s} score={s:8.3f} 2-part={fp[2]}", flush=True)
+    results.sort(key=lambda r: r[0])
+    best = results[0]
+    print(json.dumps({"best": best[1], "score": round(best[0], 4),
+                      "fingerprint": {str(k): v for k, v in best[2].items()},
+                      "raw": {str(k): v for k, v in RAW_FP.items()}}))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,106 @@
+"""Workers-vs-throughput curve for the chunked mesh build (MESHBENCH).
+
+Measures the map (per-shard chunked reduction) and reduce (global-f
+chunked merge) phases of parallel/chunked.py per worker count on one
+R-MAT size, plus end-to-end edges/s.  The baseline being chased is
+itself an 18-rank aggregate (data/slurm-twitter/slurm-25.avg:13-17), so
+the aggregate-scaling story needs measured per-worker-count numbers, not
+arithmetic.
+
+On the CPU backend this runs the virtual 8-device mesh (set by this
+script; the 1-core bench host shares one core across virtual workers, so
+absolute speedup is not expected there — the curve demonstrates how round
+counts, collective costs, and phase splits scale with W, and becomes a
+true throughput curve the moment a multi-chip window exists).  On an
+accelerator backend it uses however many real devices exist.
+
+Usage: python scripts/mesh_bench.py [log_n] [edge_factor] [workers_csv]
+Defaults: 2^18, 8, "1,2,4,8".  Writes MESHBENCH_r04.json at the repo root
+when run at the default size or larger (smaller runs only print).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def main() -> None:
+    log_n = int(sys.argv[1]) if len(sys.argv) > 1 else 18
+    factor = int(sys.argv[2]) if len(sys.argv) > 2 else 8
+    workers = [int(w) for w in (sys.argv[3] if len(sys.argv) > 3
+                                else "1,2,4,8").split(",")]
+    reps = int(os.environ.get("SHEEP_MESHBENCH_REPS", "3"))
+
+    # a CPU backend gets the virtual 8-device mesh; must be set before jax
+    # touches backends, mirroring tests/conftest.py
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
+    from sheep_tpu.cli.common import ensure_jax_platform
+    ensure_jax_platform()
+    import jax
+
+    platform = jax.devices()[0].platform
+    ndev = len(jax.devices())
+    workers = [w for w in workers if w <= ndev]
+
+    from sheep_tpu.parallel.chunked import (build_links_chunked_sharded,
+                                            stage_edges_2d)
+    from sheep_tpu.parallel.mesh import make_mesh
+    from scripts.tpu_diag import edges  # cached R-MAT
+
+    n = 1 << log_n
+    e = factor << log_n
+    tail, head = edges(log_n, factor)
+    rec = {"log_n": log_n, "edges": e, "platform": platform,
+           "devices": ndev, "reps": reps, "curve": []}
+    print(f"mesh_bench: platform={platform} ndev={ndev} n=2^{log_n} "
+          f"edges={e}", file=sys.stderr)
+
+    for w in workers:
+        mesh = make_mesh(w)
+        t2d, h2d = stage_edges_2d(tail, head, n, mesh)
+        jax.block_until_ready((t2d, h2d))
+        best = None
+        for _ in range(reps + 1):  # +1 warmup/compile
+            tm = {}
+            t0 = time.perf_counter()
+            _, _, _, parent, _ = build_links_chunked_sharded(
+                t2d, h2d, n, mesh, timings=tm)
+            total = time.perf_counter() - t0
+            tm["total_s"] = total
+            if best is None or total < best["total_s"]:
+                best = tm
+        row = {"workers": w,
+               "map_s": round(best["map_s"], 4),
+               "reduce_s": round(best["reduce_s"], 4),
+               "prep_s": round(best["prep_s"], 4),
+               "total_s": round(best["total_s"], 4),
+               "map_rounds": best["map_rounds"],
+               "reduce_rounds": best["reduce_rounds"],
+               "edges_per_sec": round(e / best["total_s"], 1),
+               "map_edges_per_sec": round(e / best["map_s"], 1)}
+        rec["curve"].append(row)
+        print(f"mesh_bench: W={w} map {row['map_s']}s "
+              f"({row['map_rounds']} r) reduce {row['reduce_s']}s "
+              f"({row['reduce_rounds']} r) -> "
+              f"{row['edges_per_sec']:.0f} edges/s", file=sys.stderr)
+
+    if log_n >= 18:
+        out = os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "MESHBENCH_r04.json")
+        with open(out, "w") as f:
+            f.write(json.dumps(rec) + "\n")
+    print(json.dumps(rec))
+
+
+if __name__ == "__main__":
+    main()

@@ -156,7 +156,12 @@ class Step:
 
 
 def build_queue() -> list[Step]:
-    bench_env = {"SHEEP_BENCH_NO_PROBE": "1"}  # watcher just probed
+    # bench.py keeps its OWN hardware probe (no SHEEP_BENCH_NO_PROBE):
+    # if the tunnel dies between the watcher's probe and bench's start,
+    # bench must fall back with the _cpu_fallback tag rather than run
+    # natively on CPU untagged — an untagged CPU record would satisfy
+    # done() forever and the real benchmark would never be taken.
+    bench_env: dict = {}
     q = [
         # 0. window characterization — fast, sets context for everything
         Step("tunnel_probe", [PY, "scripts/tunnel_probe.py"],

@@ -3,8 +3,15 @@ from .mesh import (AXIS, make_mesh, edge_sharding, replicated,
 from .build import (distributed_build_step, build_graph_distributed,
                     map_graph_distributed)
 from .stream import build_graph_streaming_sharded
+from .chunked import (build_graph_chunked_distributed,
+                      build_graph_streaming_chunked,
+                      build_links_chunked_sharded, reduce_links_sharded)
 
 __all__ = [
+    "build_graph_chunked_distributed",
+    "build_graph_streaming_chunked",
+    "build_links_chunked_sharded",
+    "reduce_links_sharded",
     "AXIS",
     "make_mesh",
     "init_distributed",

@@ -1,0 +1,453 @@
+"""Mesh-sharded chunked build: the bounded-execution driver composed with
+the device mesh.
+
+Why this module exists (round-3 hardware evidence, PERF_NOTES.md): on real
+TPU hardware a data-dependent ``lax.while_loop`` faults once its wall time
+outgrows the backend's per-execution budget, so the production single-chip
+path is the host-orchestrated chunked driver (ops/forest.py
+``reduce_links_hosted``: J rounds per dispatch via ``fori_loop``, host sync
++ compaction between dispatches).  The first-generation mesh path
+(parallel/build.py) still ran the while_loop *inside* ``shard_map`` — the
+exact shape that faulted.  This module is the mesh analog of the chunked
+driver: every device dispatch is a bounded ``fori_loop`` under ``shard_map``,
+and the host loop reads one replicated stats vector per chunk.
+
+Two round flavors compose the reference's map/reduce split
+(SURVEY §2.6, lib/jnode.cpp:203-250):
+
+  local rounds  (map)   — each worker reduces its own edge shard's links
+                          with zero per-round communication: sort + star->
+                          chain rewrite + jump against the LOCAL min-up
+                          table.  Converged shards hold per-worker partial
+                          forests over the shared sequence — exactly the
+                          reference's per-rank JTree build.
+  global rounds (reduce)— same transform but the jump table is the GLOBAL
+                          min-up-neighbor: per-shard scatter-min tables
+                          combined with ``lax.pmin`` over the axis (one
+                          [n+1] all-reduce per round, the mpi_merge
+                          analog).  Soundness: the threshold-connectivity
+                          argument of ops/forest.py only needs each f-edge
+                          to exist SOMEWHERE in the global multiset, so
+                          jumping any shard's lo through the global f
+                          preserves global threshold connectivity; local
+                          sort/rewrite is a per-subset transform and was
+                          already sound.  At global fixpoint every live
+                          link (lo, hi) has f(lo) == hi, i.e. the union of
+                          shards is one functional forest — the answer.
+
+Termination is unchanged: every applied rewrite strictly increases some lo
+bounded by n, so both phases converge; chunking only bounds how much runs
+per dispatch.  Compaction slices the LOCAL axis of the [W, B] link arrays
+(per-row sort guarantees each row's live prefix), so shards shrink in
+lockstep to the pmax of per-row live counts.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax import shard_map
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..ops.forest import _rewrite_sorted, pst_weights
+from ..ops.sort import degree_order
+from .mesh import AXIS, make_mesh
+
+#: per-chunk round counts — mirror ops.forest._CHUNK_SCHEDULE: probe every
+#: round while live collapses (rounds 1-3 kill most edges), batch later.
+_SCHEDULE = (1, 1, 1, 2, 4)
+_JROUNDS = 8
+_LEVELS = 10
+_FIRST_LEVELS = 4
+
+
+def _row_round(lo, hi, n: int, levels: int, f_combine):
+    """One chunk round on a worker's local [B] link row.
+
+    ``f_combine``: identity for local (map) rounds, ``lax.pmin`` over the
+    workers axis for global (reduce) rounds.  Returns (lo, hi, moved, live).
+    """
+    sent = jnp.int32(n)
+    lo, hi = lax.sort((lo, hi), num_keys=2)
+    live = jnp.sum(lo != sent, dtype=jnp.int32)
+    lo, hi, rewrites = _rewrite_sorted(lo, hi, n)
+    # the jump with a (possibly globally combined) min-up table; mirrors
+    # ops.forest._jump but the table is built once and combined BEFORE
+    # lifting so every worker lifts the same global f
+    f = jnp.full(n + 1, sent, jnp.int32).at[lo].min(hi)
+    f = f_combine(f)
+    lo_in = lo
+    tables = [f]
+    for _ in range(levels - 1):
+        tables.append(tables[-1][tables[-1]])
+    for table in reversed(tables):
+        nlo = table[lo]
+        lo = jnp.where(nlo < hi, nlo, lo)
+    moved = rewrites + jnp.sum(lo != lo_in, dtype=jnp.int32)
+    return lo, hi, moved, live
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("n", "mesh", "levels", "jrounds",
+                                    "global_f"))
+def chunk_sharded(lo, hi, n: int, mesh, levels: int, jrounds: int,
+                  global_f: bool):
+    """``jrounds`` bounded rounds on [W, B] sharded links in ONE dispatch.
+
+    Returns (lo, hi, stats) with stats int32 [2] = (moved_total,
+    live_max_per_row) replicated — one host fetch per chunk, matching the
+    single-sync contract of ops.forest.fixpoint_chunk.
+    """
+    def body(lo, hi):
+        lo = lo[0]  # [1, B] local block -> [B]
+        hi = hi[0]
+        combine = (lambda f: lax.pmin(f, AXIS)) if global_f \
+            else (lambda f: f)
+
+        def one(_, st):
+            lo, hi, _, _ = st
+            return _row_round(lo, hi, n, levels, combine)
+
+        st = (lo.astype(jnp.int32), hi.astype(jnp.int32),
+              jnp.int32(0), jnp.int32(lo.shape[0]))
+        lo, hi, moved, live = lax.fori_loop(0, jrounds, one, st)
+        stats = jnp.stack([lax.psum(moved, AXIS), lax.pmax(live, AXIS)])
+        return lo[None, :], hi[None, :], stats
+
+    fn = shard_map(body, mesh=mesh,
+                   in_specs=(P(AXIS, None), P(AXIS, None)),
+                   out_specs=(P(AXIS, None), P(AXIS, None), P()),
+                   check_vma=False)
+    return fn(lo, hi)
+
+
+@functools.partial(jax.jit, static_argnames=("n", "mesh"))
+def parent_sharded(lo, hi, n: int, mesh):
+    """Global parent extraction from converged sharded links: per-shard
+    scatter-min pmin-combined (valid once the union forms a forest)."""
+    def body(lo, hi):
+        sent = jnp.int32(n)
+        p = jnp.full(n + 1, sent, jnp.int32).at[
+            lo[0].astype(jnp.int32)].min(hi[0].astype(jnp.int32))
+        return lax.pmin(p, AXIS)[:n]
+
+    fn = shard_map(body, mesh=mesh,
+                   in_specs=(P(AXIS, None), P(AXIS, None)),
+                   out_specs=P(), check_vma=False)
+    return fn(lo, hi)
+
+
+@functools.partial(jax.jit, static_argnames=("n", "mesh", "with_pos"))
+def prep_sharded(tail, head, n: int, mesh, pos=None, with_pos: bool = False):
+    """Degree sort + link mapping over the mesh (the `-i` phase).
+
+    tail/head int32 [W, B] sharded (pad with n).  Returns (seq, pos, m,
+    lo [W, B], hi [W, B], pst) with everything but lo/hi replicated.
+    Matches parallel.build._sharded_build's sequence/pst semantics.
+    """
+    def body(t, h, posr):
+        sent = jnp.int32(n)
+        t = t[0].astype(jnp.int32)
+        h = h[0].astype(jnp.int32)
+        if posr is None:
+            deg_local = jnp.zeros(n + 1, jnp.int32).at[t].add(1).at[h].add(1)
+            deg = lax.psum(deg_local, AXIS)[:n]
+            seq, pos_r, m = degree_order(deg)
+        else:
+            posi = posr.astype(jnp.int32)
+            absent = (posi < 0) | (posi >= n)
+            pos_r = jnp.where(absent, sent, posi)
+            seq = jnp.full(n, sent, jnp.int32)
+            vids = jnp.arange(n, dtype=jnp.int32)
+            seq = seq.at[jnp.where(absent, n, pos_r)].set(vids, mode="drop")
+            m = jnp.int32(n) - jnp.sum(absent, dtype=jnp.int32)
+        pos_ext = jnp.concatenate([pos_r, jnp.full((1,), sent, jnp.int32)])
+        pt = pos_ext[jnp.minimum(t, jnp.int32(n))]
+        ph = pos_ext[jnp.minimum(h, jnp.int32(n))]
+        lo = jnp.minimum(pt, ph)
+        hi = jnp.maximum(pt, ph)
+        # pst counts every edge at its present earlier endpoint, including
+        # edges to absent vids (jtree.cpp:47-49); self/pad (lo==hi) never
+        pst_local = pst_weights(jnp.where(lo == hi, sent, lo), n)
+        dead = (lo >= hi) | (hi >= sent)
+        lo = jnp.where(dead, sent, lo)
+        hi = jnp.where(dead, sent, hi)
+        return (seq, pos_r, m, lo[None, :], hi[None, :],
+                lax.psum(pst_local, AXIS))
+
+    if with_pos:
+        fn = shard_map(lambda t, h, p: body(t, h, p), mesh=mesh,
+                       in_specs=(P(AXIS, None), P(AXIS, None), P()),
+                       out_specs=(P(), P(), P(), P(AXIS, None),
+                                  P(AXIS, None), P()),
+                       check_vma=False)
+        return fn(tail, head, pos)
+    fn = shard_map(lambda t, h: body(t, h, None), mesh=mesh,
+                   in_specs=(P(AXIS, None), P(AXIS, None)),
+                   out_specs=(P(), P(), P(), P(AXIS, None),
+                              P(AXIS, None), P()),
+                   check_vma=False)
+    return fn(tail, head)
+
+
+def _pad_pow2_cols(x: int, lo_cap: int = 1 << 10) -> int:
+    p = lo_cap
+    while p < x:
+        p <<= 1
+    return p
+
+
+def reduce_links_sharded(lo, hi, n: int, mesh, global_f: bool,
+                         levels: int = _LEVELS, jrounds: int = _JROUNDS,
+                         first_levels: int = _FIRST_LEVELS,
+                         fetch=None):
+    """Host-orchestrated chunk loop on [W, B] sharded links.
+
+    ``global_f`` False = map phase (per-shard independent), True = reduce
+    phase (per-round pmin of the jump table).  Returns (lo, hi, rounds)
+    with per-row live prefixes.  ``fetch``: replicated-array -> numpy
+    (multi-process safe override; default np.asarray).
+    """
+    fetch = fetch or np.asarray
+    cols0 = int(lo.shape[1])
+    if cols0 == 0:
+        return lo, hi, 0
+    rounds = 0
+    chunk_i = 0
+    while True:
+        j = _SCHEDULE[chunk_i] if chunk_i < len(_SCHEDULE) else jrounds
+        # map phase: light lifting while arrays are full-size (early
+        # progress is dedupe/star-collapse; full-size gathers cost most).
+        # reduce phase: deep lifting immediately — merge input is already
+        # compact per-worker forests whose cost is chain DEPTH, not size.
+        lv = first_levels if (not global_f and int(lo.shape[1]) >= cols0
+                              and chunk_i < len(_SCHEDULE)) else levels
+        lo, hi, stats = chunk_sharded(lo, hi, n, mesh, lv, j, global_f)
+        rounds += j
+        chunk_i += 1
+        moved_i, live_i = (int(x) for x in fetch(stats))  # one sync
+        if moved_i == 0:
+            return lo, hi, rounds
+        target = _pad_pow2_cols(live_i)
+        if target <= int(lo.shape[1]) // 2:
+            lo, hi = lo[:, :target], hi[:, :target]
+
+
+def build_links_chunked_sharded(tail_2d, head_2d, n: int, mesh,
+                                pos=None, fetch=None, timings=None):
+    """Full chunked mesh build from staged [W, B] edge arrays.
+
+    Returns (seq, pos, m, parent, pst) — all replicated device arrays,
+    parent [n] int32 with n marking roots.  ``timings``: optional dict
+    that receives wall-clock seconds for the prep/map/reduce phases and
+    the per-phase round counts (the MESHBENCH instrumentation hook).
+    """
+    import time as _time
+    fetch = fetch or np.asarray
+    t0 = _time.perf_counter()
+    if pos is None:
+        seq, pos_r, m, lo, hi, pst = prep_sharded(tail_2d, head_2d, n, mesh)
+    else:
+        seq, pos_r, m, lo, hi, pst = prep_sharded(
+            tail_2d, head_2d, n, mesh, pos=pos, with_pos=True)
+    jax.block_until_ready(lo)
+    t1 = _time.perf_counter()
+    # map: shards reduce independently to per-worker partial forests
+    lo, hi, map_rounds = reduce_links_sharded(lo, hi, n, mesh,
+                                              global_f=False, fetch=fetch)
+    jax.block_until_ready(lo)
+    t2 = _time.perf_counter()
+    # reduce: global-f rounds stitch the partials into one forest
+    lo, hi, red_rounds = reduce_links_sharded(lo, hi, n, mesh,
+                                              global_f=True, fetch=fetch)
+    parent = parent_sharded(lo, hi, n, mesh)
+    jax.block_until_ready(parent)
+    t3 = _time.perf_counter()
+    if timings is not None:
+        timings.update(prep_s=t1 - t0, map_s=t2 - t1, reduce_s=t3 - t2,
+                       map_rounds=map_rounds, reduce_rounds=red_rounds)
+    return seq, pos_r, m, parent, pst
+
+
+def stage_edges_2d(tail, head, n: int, mesh, block: int | None = None):
+    """Host edges -> [W, B] sharded int32 device arrays (pad with n)."""
+    w = mesh.size
+    e = len(tail)
+    b = block if block is not None else (e + w - 1) // w
+    b = max(1, b)
+    t = np.full((w, b), n, dtype=np.int32)
+    h = np.full((w, b), n, dtype=np.int32)
+    flat_t = np.asarray(tail)
+    flat_h = np.asarray(head)
+    for i in range(w):
+        sl = slice(i * b, min((i + 1) * b, e))
+        k = max(0, sl.stop - sl.start)
+        if k:
+            t[i, :k] = flat_t[sl]
+            h[i, :k] = flat_h[sl]
+    sharding = NamedSharding(mesh, P(AXIS, None))
+    if jax.process_count() == 1:
+        return jax.device_put(t, sharding), jax.device_put(h, sharding)
+    mk = jax.make_array_from_callback
+    return (mk(t.shape, sharding, lambda idx: t[idx]),
+            mk(h.shape, sharding, lambda idx: h[idx]))
+
+
+@functools.partial(jax.jit, static_argnames=("n", "cn", "mesh"))
+def prep_stream_sharded(parent, tail, head, pos, n: int, cn: int, mesh):
+    """One streamed block's links + the carry forest's links, sharded.
+
+    parent int32 [n] replicated (n marks roots); tail/head int32 [W, B]
+    sharded vid records (pad with values >= len(pos)-1); pos the
+    vid->position table with a sentinel slot at the end.  The carry forest
+    re-enters as its (kid -> parent) links, SHARDED: worker i owns carry
+    rows [i*cn, (i+1)*cn) — any shard may host any link, so splitting the
+    carry over the axis keeps per-worker state O(n/W + B) for the link
+    arrays.  Returns (lo, hi [W, B+cn] sharded, pst_delta [n] replicated).
+    """
+    def body(parent, t, h, posr):
+        sent = jnp.int32(n)
+        vid_cap = jnp.int32(posr.shape[0] - 1)
+        pt = posr[jnp.minimum(t[0].astype(jnp.int32), vid_cap)]
+        ph = posr[jnp.minimum(h[0].astype(jnp.int32), vid_cap)]
+        lo = jnp.minimum(pt, ph)
+        hi = jnp.maximum(pt, ph)
+        pst_local = pst_weights(jnp.where(lo == hi, sent, lo), n)
+        dead = (lo >= hi) | (hi >= sent)
+        lo = jnp.where(dead, sent, lo)
+        hi = jnp.where(dead, sent, hi)
+        # carry shard: this worker's slice of the forest's links
+        i = lax.axis_index(AXIS)
+        base = i.astype(jnp.int32) * jnp.int32(cn)
+        kid = base + jnp.arange(cn, dtype=jnp.int32)
+        in_range = kid < jnp.int32(n)
+        cpar = lax.dynamic_slice(
+            jnp.concatenate([parent.astype(jnp.int32),
+                             jnp.full((cn,), sent, jnp.int32)]),
+            (base,), (cn,))
+        clive = in_range & (cpar < sent)
+        clo = jnp.where(clive, kid, sent)
+        chi = jnp.where(clive, cpar, sent)
+        lo = jnp.concatenate([clo, lo])
+        hi = jnp.concatenate([chi, hi])
+        return lo[None, :], hi[None, :], lax.psum(pst_local, AXIS)
+
+    fn = shard_map(body, mesh=mesh,
+                   in_specs=(P(), P(AXIS, None), P(AXIS, None), P()),
+                   out_specs=(P(AXIS, None), P(AXIS, None), P()),
+                   check_vma=False)
+    return fn(parent, tail, head, pos)
+
+
+def build_graph_streaming_chunked(blocks, n: int, pos: np.ndarray,
+                                  block_edges: int,
+                                  num_workers: int | None = None):
+    """OOM streaming over the mesh with bounded dispatches only.
+
+    Same contract as parallel.stream.build_graph_streaming_sharded —
+    (Forest over n positions, total_rounds) — but each block folds through
+    the chunked sharded reducer (local rounds then global-f rounds)
+    instead of an in-jit while_loop fixpoint.  The carry forest re-enters
+    sharded, so worker-resident link state stays O(n/W + B/W) per block.
+    """
+    from .. import INVALID_JNID
+    from ..core.forest import Forest
+    from ..ops.stream import _full_vid_pos
+    from .build import _fetch
+
+    mesh = make_mesh(num_workers)
+    w = mesh.size
+    block_pad = max(w, ((block_edges + w - 1) // w) * w)
+    b = block_pad // w
+    cn = (n + w - 1) // w
+    repl = NamedSharding(mesh, P())
+    shard2d = NamedSharding(mesh, P(AXIS, None))
+
+    def put(x, sharding):
+        if jax.process_count() == 1:
+            return jax.device_put(x, sharding)
+        return jax.make_array_from_callback(
+            x.shape, sharding, lambda idx: x[idx])
+
+    pos_d = put(_full_vid_pos(pos, n).astype(np.int32), repl)
+    vid_pad = len(pos)  # pad records map to the table's sentinel slot
+    parent = put(np.full(n, n, dtype=np.int32), repl)
+    pst = np.zeros(n, dtype=np.int64)
+    total_rounds = 0
+    for tail, head in blocks:
+        k = len(tail)
+        if k > w * b:
+            raise ValueError(
+                f"streamed block of {k} edges exceeds block_edges="
+                f"{block_edges} (padded capacity {w * b})")
+        t = np.full((w, b), vid_pad, dtype=np.int32)
+        h = np.full((w, b), vid_pad, dtype=np.int32)
+        for i in range(w):
+            sl = slice(i * b, min((i + 1) * b, k))
+            cnt = max(0, sl.stop - sl.start)
+            if cnt:
+                t[i, :cnt] = tail[sl]
+                h[i, :cnt] = head[sl]
+        lo, hi, pst_delta = prep_stream_sharded(
+            parent, put(t, shard2d), put(h, shard2d), pos_d, n, cn, mesh)
+        lo, hi, r1 = reduce_links_sharded(lo, hi, n, mesh, global_f=False,
+                                          fetch=_fetch)
+        lo, hi, r2 = reduce_links_sharded(lo, hi, n, mesh, global_f=True,
+                                          fetch=_fetch)
+        parent = parent_sharded(lo, hi, n, mesh)
+        # int64 host accumulation: per-block deltas are int32-safe, the
+        # running sum follows the uint32 weight contract via the final cast
+        pst += _fetch(pst_delta).astype(np.int64)
+        total_rounds += r1 + r2
+    parent_np = _fetch(parent).astype(np.int64)
+    out = np.full(n, INVALID_JNID, dtype=np.uint32)
+    live = parent_np < n
+    out[live] = parent_np[live].astype(np.uint32)
+    return Forest(out, (pst & 0xFFFFFFFF).astype(np.uint32)), total_rounds
+
+
+def build_graph_chunked_distributed(tail, head, num_vertices=None,
+                                    num_workers=None, seq=None,
+                                    timings=None):
+    """Host-facing chunked mesh build: (seq uint32 [m], Forest over m).
+
+    Same contract as parallel.build.build_graph_distributed, but every
+    device dispatch is bounded — the execution shape real hardware needs.
+    """
+    from .. import INVALID_JNID
+    from ..core.forest import Forest
+    from .build import _fetch, _to_forest
+
+    mesh = make_mesh(num_workers)
+    n = num_vertices
+    if n is None:
+        n = int(max(tail.max(initial=0), head.max(initial=0))) + 1 \
+            if len(tail) else 0
+    if seq is not None and len(seq):
+        n = max(n, int(seq.max()) + 1)
+    if n == 0:
+        return (np.empty(0, np.uint32),
+                Forest(np.empty(0, np.uint32), np.empty(0, np.uint32)))
+    t2d, h2d = stage_edges_2d(tail, head, n, mesh)
+    if seq is None:
+        dseq, _, m, parent, pst = build_links_chunked_sharded(
+            t2d, h2d, n, mesh, fetch=_fetch, timings=timings)
+        m = int(_fetch(m))
+        out_seq = _fetch(dseq)[:m].astype(np.uint32)
+    else:
+        from ..core.sequence import sequence_positions
+        pos_np = sequence_positions(seq, n - 1).astype(np.int64)
+        sharding = NamedSharding(mesh, P())
+        pos_d = jax.device_put(pos_np.astype(np.int32), sharding) \
+            if jax.process_count() == 1 else jax.make_array_from_callback(
+                pos_np.shape, sharding,
+                lambda idx: pos_np.astype(np.int32)[idx])
+        dseq, _, m, parent, pst = build_links_chunked_sharded(
+            t2d, h2d, n, mesh, pos=pos_d, fetch=_fetch, timings=timings)
+        m = len(seq)
+        out_seq = np.asarray(seq, dtype=np.uint32)
+    return out_seq, _to_forest(_fetch(parent), _fetch(pst), n, m)

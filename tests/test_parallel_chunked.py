@@ -1,0 +1,116 @@
+"""Chunked mesh build (bounded dispatches) == sequential oracle.
+
+The round-3 hardware finding (PERF_NOTES.md) is that data-dependent
+while_loops fault on real TPU hardware past a wall-time budget, so the
+production mesh path must be the host-orchestrated chunked driver.  These
+tests pin the chunked sharded build (parallel/chunked.py) to the oracle on
+the virtual 8-device CPU mesh — same multi-node simulation strategy as
+test_parallel.py (SURVEY §4.4), same exactness bar: bit-identical parents
+and pst for any worker count, multigraphs, self-loops, given sequences.
+"""
+
+import numpy as np
+import pytest
+
+from conftest import random_multigraph
+
+from sheep_tpu.core import build_forest, degree_sequence
+from sheep_tpu.parallel import build_graph_chunked_distributed
+
+
+@pytest.mark.parametrize("workers", [1, 2, 3, 8])
+def test_chunked_equals_oracle(workers):
+    rng = np.random.default_rng(700 + workers)
+    tail, head = random_multigraph(rng, n_max=60, e_max=300)
+    seq, forest = build_graph_chunked_distributed(
+        tail, head, num_workers=workers)
+    want_seq = degree_sequence(tail, head)
+    np.testing.assert_array_equal(seq, want_seq)
+    want = build_forest(tail, head, want_seq)
+    np.testing.assert_array_equal(forest.parent, want.parent)
+    np.testing.assert_array_equal(forest.pst_weight, want.pst_weight)
+
+
+@pytest.mark.parametrize("trial", range(6))
+def test_chunked_random_full_mesh(trial):
+    rng = np.random.default_rng(8200 + trial)
+    tail, head = random_multigraph(rng)
+    seq, forest = build_graph_chunked_distributed(tail, head)
+    want_seq = degree_sequence(tail, head)
+    np.testing.assert_array_equal(seq, want_seq)
+    want = build_forest(tail, head, want_seq)
+    np.testing.assert_array_equal(forest.parent, want.parent)
+    np.testing.assert_array_equal(forest.pst_weight, want.pst_weight)
+
+
+@pytest.mark.parametrize("workers", [2, 8])
+def test_chunked_given_sequence(workers):
+    """The `-r`-without-`-i` case: a file-given sequence over a SUBSET of
+    vids (absent vids count toward pst but never insert)."""
+    rng = np.random.default_rng(9100 + workers)
+    tail, head = random_multigraph(rng, n_max=50, e_max=200)
+    full_seq = degree_sequence(tail, head)
+    seq = full_seq[: max(2, len(full_seq) * 2 // 3)]
+    max_vid = int(max(tail.max(), head.max()))
+    want = build_forest(tail, head, seq, max_vid=max_vid)
+    out_seq, forest = build_graph_chunked_distributed(
+        tail, head, num_workers=workers, seq=seq)
+    np.testing.assert_array_equal(out_seq, seq)
+    np.testing.assert_array_equal(forest.parent, want.parent)
+    np.testing.assert_array_equal(forest.pst_weight, want.pst_weight)
+
+
+def test_chunked_edges_fewer_than_workers():
+    tail = np.array([0], dtype=np.uint32)
+    head = np.array([1], dtype=np.uint32)
+    seq, forest = build_graph_chunked_distributed(tail, head, num_workers=8)
+    assert list(seq) == [0, 1]
+    assert list(forest.parent) == [1, 0xFFFFFFFF]
+    assert list(forest.pst_weight) == [1, 0]
+
+
+def test_chunked_empty_graph():
+    seq, forest = build_graph_chunked_distributed(
+        np.empty(0, np.uint32), np.empty(0, np.uint32), num_workers=4)
+    assert len(seq) == 0
+    assert forest.n == 0
+
+
+@pytest.mark.parametrize("workers,block", [(8, 64), (3, 100), (1, 64)])
+def test_chunked_streaming_equals_oracle(workers, block):
+    """OOM streaming with bounded dispatches: per-block carry fold must
+    reproduce the whole-graph oracle for any worker count / block size."""
+    from sheep_tpu.core.sequence import sequence_positions
+    from sheep_tpu.parallel import build_graph_streaming_chunked
+
+    rng = np.random.default_rng(3100 + workers)
+    tail, head = random_multigraph(rng, n_max=80, e_max=400)
+    seq = degree_sequence(tail, head)
+    max_vid = int(max(tail.max(), head.max()))
+    want = build_forest(tail, head, seq, max_vid=max_vid)
+    pos = sequence_positions(seq, max_vid)
+    n = len(seq)
+    blocks = ((tail[a:a + block], head[a:a + block])
+              for a in range(0, len(tail), block))
+    forest, rounds = build_graph_streaming_chunked(
+        blocks, n, pos, block_edges=block, num_workers=workers)
+    assert rounds >= 1
+    np.testing.assert_array_equal(forest.parent, want.parent)
+    np.testing.assert_array_equal(forest.pst_weight, want.pst_weight)
+
+
+def test_chunked_hepth(hep_edges):
+    """Golden graph: chunked mesh build must equal the oracle exactly and
+    report phase timings through the instrumentation hook."""
+    tail, head = hep_edges.tail, hep_edges.head
+    want_seq = degree_sequence(tail, head)
+    want = build_forest(tail, head, want_seq,
+                        max_vid=int(max(tail.max(), head.max())))
+    tm = {}
+    seq, forest = build_graph_chunked_distributed(
+        tail, head, num_workers=8, timings=tm)
+    np.testing.assert_array_equal(seq, want_seq)
+    np.testing.assert_array_equal(forest.parent, want.parent)
+    np.testing.assert_array_equal(forest.pst_weight, want.pst_weight)
+    assert tm["map_rounds"] >= 1 and tm["reduce_rounds"] >= 1
+    assert tm["map_s"] > 0 and tm["reduce_s"] > 0

@@ -4,16 +4,20 @@ hep-th.dat's xs1 records carry a float weight in (0,1) (near-uniform).
 A 2015-era centrality tool fed the 3-column edge list (igraph is the
 canonical example) uses the weight column as shortest-path distances BY
 DEFAULT — a convention no unweighted search round could reproduce.  With
-continuous random weights shortest paths are almost surely unique, which
-changes betweenness dramatically.  Tries weight-as-distance and
-1/weight-as-distance (strength-to-distance inversion), ascending order.
+continuous random weights shortest paths are almost surely unique, so the
+shortest-path DAG from each source is a TREE and Brandes' dependency
+delta_s(v) reduces to (subtree size of v) - 1.  That turns the whole
+computation into: scipy C Dijkstra for predecessors, hop-depths by
+pointer doubling, then one vectorized np.add.at cascade per depth level.
+
+Tries weight-as-distance and 1/weight-as-distance (strength-to-distance
+inversion), ascending order.
 
 Usage: python scripts/bc_search3.py [graph.dat]
 """
 
 from __future__ import annotations
 
-import heapq
 import json
 import os
 import sys
@@ -21,69 +25,79 @@ import sys
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import numpy as np
+from scipy.sparse import csr_matrix
+from scipy.sparse.csgraph import dijkstra
 
 from scripts.bc_search import RAW_FP, fingerprint, score
 
 
-def weighted_betweenness(tail, head, weight, n, invert=False):
-    """Exact weighted Brandes (Dijkstra variant).  Undirected; parallel
-    edges keep the SMALLEST distance; self-loops dropped."""
+def weighted_betweenness(tail, head, weight, n, invert=False,
+                         batch=512):
+    """Weighted betweenness assuming unique shortest paths (continuous
+    weights).  Undirected; parallel edges keep the smallest distance;
+    self-loops dropped.  Endpoints not counted."""
     und = tail != head
     a = np.minimum(tail[und], head[und]).astype(np.int64)
     b = np.maximum(tail[und], head[und]).astype(np.int64)
     w = weight[und].astype(np.float64)
     if invert:
         w = 1.0 / np.maximum(w, 1e-12)
-    # dedup parallel edges keeping min distance
     key = a * n + b
     order = np.lexsort((w, key))
     key, a, b, w = key[order], a[order], b[order], w[order]
     first = np.concatenate([[True], key[1:] != key[:-1]])
     a, b, w = a[first], b[first], w[first]
+    g = csr_matrix((np.concatenate([w, w]),
+                    (np.concatenate([a, b]), np.concatenate([b, a]))),
+                   shape=(n, n))
 
-    src = np.concatenate([a, b])
-    dst = np.concatenate([b, a])
-    ww = np.concatenate([w, w])
-    order = np.argsort(src, kind="stable")
-    adj, wadj = dst[order], ww[order]
-    deg = np.bincount(src, minlength=n)
-    offs = np.zeros(n + 1, dtype=np.int64)
-    np.cumsum(deg, out=offs[1:])
-
+    deg = np.bincount(a, minlength=n) + np.bincount(b, minlength=n)
+    sources = np.nonzero(deg)[0]
     bc = np.zeros(n, dtype=np.float64)
-    eps = 1e-12
-    for s in np.nonzero(deg)[0]:
-        dist = np.full(n, np.inf)
-        sigma = np.zeros(n)
-        dist[s] = 0.0
-        sigma[s] = 1.0
-        done = np.zeros(n, dtype=bool)
-        heap = [(0.0, s)]
-        stack = []
-        while heap:
-            d, v = heapq.heappop(heap)
-            if done[v]:
+    for i in range(0, len(sources), batch):
+        srcs = sources[i:i + batch]
+        dist, pred = dijkstra(g, indices=srcs, return_predecessors=True)
+        k = len(srcs)
+        # -9999 marks unreachable/source; point them at themselves
+        self_col = np.broadcast_to(np.arange(n), (k, n))
+        p = np.where(pred < 0, self_col, pred).astype(np.int64)
+        rows = np.arange(k)[:, None]
+        # exact hop depth: follow ONE original-parent hop per iteration
+        # until the walk stabilizes at a fixed point (the source's
+        # self-pointer).  depth[v] = hops(v -> source) - 1, a uniform
+        # shift that preserves the child-before-parent level order the
+        # cascade needs; the shifted depth-0 nodes are the source's
+        # direct children, whose push targets only the source — whose
+        # delta is discarded anyway.
+        depth = np.zeros((k, n), dtype=np.int32)
+        cur = p.copy()
+        for _ in range(n):
+            nxt = p[rows, cur]
+            moved = nxt != cur
+            if not moved.any():
+                break
+            depth[moved] += 1
+            cur = np.where(moved, nxt, cur)
+        # counts cascade: deepest level first, each node adds its count
+        # (1 + descendants) to its parent
+        counts = np.ones((k, n), dtype=np.float64)
+        reachable = pred >= 0  # excludes source and unreachable
+        counts[~reachable & (depth == 0)] = 0.0
+        counts[np.arange(k), srcs] = 0.0  # source contributes no pair
+        maxd = int(depth.max()) if depth.size else 0
+        rows = np.arange(k)[:, None]
+        for d in range(maxd, 0, -1):
+            sel = depth == d
+            if not sel.any():
                 continue
-            done[v] = True
-            stack.append(v)
-            for i in range(offs[v], offs[v + 1]):
-                u = adj[i]
-                nd = d + wadj[i]
-                if nd < dist[u] - eps:
-                    dist[u] = nd
-                    sigma[u] = sigma[v]
-                    heapq.heappush(heap, (nd, u))
-                elif abs(nd - dist[u]) <= eps and not done[u]:
-                    sigma[u] += sigma[v]
-        delta = np.zeros(n)
-        for v in reversed(stack):
-            d = dist[v]
-            for i in range(offs[v], offs[v + 1]):
-                u = adj[i]
-                if abs(dist[u] + wadj[i] - d) <= eps:
-                    delta[u] += (sigma[u] / sigma[v]) * (1.0 + delta[v])
-        delta[s] = 0.0
-        bc += delta
+            ridx, cidx = np.nonzero(sel)
+            np.add.at(counts, (ridx, p[ridx, cidx]), counts[ridx, cidx])
+        # delta_s(v) = descendants of v = counts[v] - 1 (itself), for
+        # reachable non-source v; sources already zeroed
+        delta = counts - 1.0
+        delta[~reachable] = 0.0
+        delta[np.arange(k), srcs] = 0.0
+        bc += delta.sum(axis=0)
     return bc / 2.0
 
 
@@ -118,7 +132,7 @@ def main() -> None:
         print(f"{name:24s} score={s:8.3f} 2-part={fp[2]}", flush=True)
     results.sort(key=lambda r: r[0])
     best = results[0]
-    if best[0] < 0.2:
+    if best[0] < 0.5:
         np.save("/tmp/best_bc.npy", best[3])
     print(json.dumps({"best": best[1], "score": round(best[0], 4),
                       "fingerprint": {str(k): v for k, v in best[2].items()},

@@ -1,0 +1,80 @@
+"""Race the fused Pallas jump kernel against the jnp descent on-chip.
+
+Runs the full hosted reduce (the production chunk loop) twice at one size
+— SHEEP_PALLAS=1 (compiled fused kernel) vs unset (jnp descent) — in this
+process by re-tracing with distinct env, checks bit-identical parents, and
+reports wall times.  Only meaningful on the real accelerator (on CPU the
+fused kernel runs interpreted and is always slower).
+
+Usage: python scripts/pallas_race.py [LOG_N]   (default 18)
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def main() -> None:
+    log_n = int(sys.argv[1]) if len(sys.argv) > 1 else 18
+    n = 1 << log_n
+    from sheep_tpu.cli.common import ensure_jax_platform
+    ensure_jax_platform()
+    import jax
+    import jax.numpy as jnp
+    from scripts.tpu_diag import edges
+    from sheep_tpu.ops.build import prepare_links
+    from sheep_tpu.ops.pallas_jump import levels_per_call
+
+    platform = jax.devices()[0].platform
+    rec = {"platform": platform, "log_n": log_n,
+           "levels_per_call": levels_per_call(n)}
+    print(f"pallas_race: platform={platform} n=2^{log_n}", file=sys.stderr)
+    tail, head = edges(log_n)
+    t = jax.device_put(jnp.asarray(tail, jnp.int32))
+    h = jax.device_put(jnp.asarray(head, jnp.int32))
+    jax.block_until_ready((t, h))
+    _, _, _, lo, hi, _ = prepare_links(t, h, n)
+    lo, hi = jax.block_until_ready((lo, hi))
+
+    # compiled Pallas is TPU-only; on CPU run interpreted (mechanics +
+    # correctness only — always slower, and labeled as such)
+    pallas_mode = "1" if platform != "cpu" else "interpret"
+    rec["pallas_mode"] = pallas_mode
+    parents = {}
+    for mode in ("", pallas_mode):
+        if mode:
+            os.environ["SHEEP_PALLAS"] = mode
+        else:
+            os.environ.pop("SHEEP_PALLAS", None)
+        # fresh traces per mode: the env gate is read at trace time
+        import importlib
+        import sheep_tpu.ops.forest as fmod
+        importlib.reload(fmod)
+        times = []
+        out = None
+        for rep in range(3):
+            t0 = time.perf_counter()
+            parent, rounds = fmod.forest_fixpoint_hosted(lo, hi, n)
+            m = int(jnp.max(parent))  # force completion
+            times.append(time.perf_counter() - t0)
+            out = parent
+        key = "pallas" if mode else "jnp"
+        parents[key] = np.asarray(out)
+        rec[key] = {"best_s": round(min(times[1:]) if len(times) > 1
+                                    else times[0], 4),
+                    "times": [round(x, 4) for x in times],
+                    "rounds": int(rounds)}
+    rec["bit_identical"] = bool(
+        np.array_equal(parents["jnp"], parents["pallas"]))
+    print(json.dumps(rec))
+
+
+if __name__ == "__main__":
+    main()

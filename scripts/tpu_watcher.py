@@ -177,6 +177,10 @@ def build_queue() -> list[Step]:
         # 3. pallas fast-path probe (stage 1 gate, then kernel race)
         Step("pallas_probe", [PY, "scripts/pallas_probe.py", "20"],
              f"TPU_PALLAS_{ROUND}.json", 1800),
+        # 3b. production fused-kernel race (only if stage-1 probe passes;
+        # the race script is cheap and self-reports pallas failures)
+        Step("pallas_race_18", [PY, "scripts/pallas_race.py", "18"],
+             f"TPU_PALLASRACE_{ROUND}.json", 1800),
         # 4. shipped-but-unmeasured transfer A/Bs (handoff factor, packing)
         Step("ab_handoff_1", [PY, "scripts/hybrid_profile.py", "20", "1"],
              f"TPU_AB_{ROUND}.jsonl", 1800, append=True),

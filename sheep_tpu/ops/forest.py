@@ -95,13 +95,38 @@ def _rewrite_sorted(lo: jnp.ndarray, hi: jnp.ndarray, n: int):
     return lo, hi, jnp.sum(applied, dtype=jnp.int32)
 
 
-def _jump(lo: jnp.ndarray, hi: jnp.ndarray, n: int, levels: int):
-    """Binary-lifted pointer jump: advance each lo to its maximal
-    f-ancestor strictly below hi, where f = min up-neighbor over the live
-    links (slot n absorbs sentinels).  Returns (lo, moved_count)."""
-    sent = jnp.int32(n)
+def _use_pallas(n: int) -> bool:
+    """Trace-time gate for the fused Pallas jump (ops/pallas_jump.py).
+
+    SHEEP_PALLAS=1 enables the compiled kernel, =interpret runs it in
+    interpreter mode (CPU-testable); unset/0 keeps the jnp descent.  Read
+    at trace time — set the env before the first compile of a shape.
+    """
+    import os
+    mode = os.environ.get("SHEEP_PALLAS", "")
+    if mode not in ("1", "interpret"):
+        return False
+    from .pallas_jump import levels_per_call
+    return levels_per_call(n) > 0
+
+
+def _lift_descend(lo: jnp.ndarray, hi: jnp.ndarray, n: int, levels: int,
+                  f: jnp.ndarray):
+    """Binary-lifting descent through a GIVEN one-step table f [n+1]:
+    square f into ancestor tables and greedily advance each lo to its
+    maximal f-ancestor strictly below hi.  Returns (lo, moved_count).
+
+    Taking f as a parameter lets the mesh path combine per-shard tables
+    (lax.pmin) before lifting — and every caller shares the Pallas-fused
+    kernel gate (ops/pallas_jump.py, SHEEP_PALLAS=1).
+    """
+    if _use_pallas(n):
+        import os
+        from .pallas_jump import fused_descend
+        return fused_descend(lo, hi, n, levels, f,
+                             interpret=os.environ.get("SHEEP_PALLAS")
+                             == "interpret")
     lo_in = lo
-    f = jnp.full(n + 1, sent, jnp.int32).at[lo].min(hi)
     tables = [f]
     for _ in range(levels - 1):
         tables.append(tables[-1][tables[-1]])
@@ -109,6 +134,15 @@ def _jump(lo: jnp.ndarray, hi: jnp.ndarray, n: int, levels: int):
         nlo = table[lo]
         lo = jnp.where(nlo < hi, nlo, lo)
     return lo, jnp.sum(lo != lo_in, dtype=jnp.int32)
+
+
+def _jump(lo: jnp.ndarray, hi: jnp.ndarray, n: int, levels: int):
+    """Binary-lifted pointer jump: advance each lo to its maximal
+    f-ancestor strictly below hi, where f = min up-neighbor over the live
+    links (slot n absorbs sentinels).  Returns (lo, moved_count)."""
+    sent = jnp.int32(n)
+    f = jnp.full(n + 1, sent, jnp.int32).at[lo].min(hi)
+    return _lift_descend(lo, hi, n, levels, f)
 
 
 def _sort_step(lo: jnp.ndarray, hi: jnp.ndarray, n: int):

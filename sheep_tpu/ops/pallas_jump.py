@@ -1,0 +1,122 @@
+"""Pallas TPU kernel: fused multi-level pointer jump for the reduce round.
+
+Why: one reduce round lifts every live link's ``lo`` through L binary-lifted
+ancestor tables (ops/forest.py ``_jump``).  As jnp, each level materializes
+an E-sized gather result and an E-sized select in HBM — ~2L E-passes per
+round, and the per-op rate on the measured backend is flat (~85-150M
+elem/s, PERF_NOTES.md), so passes are the whole cost.  This kernel fuses a
+GROUP of levels into one pass: the lo/hi block and the loop-carried lo stay
+in VMEM across levels, so g levels cost ~one E-read + one E-write instead
+of ~2g E-passes.
+
+VMEM is the constraint: every level's table ([n+1] int32) must be resident,
+so the group size is chosen from a ~12MB budget — all 10 levels fit at
+n <= 2^18, pairs at 2^20, singles at 2^21; above that the jnp path stands
+(one table alone outgrows VMEM).  ``fused_jump`` composes groups greedily
+and is a drop-in replacement for the descent loop in ``_jump``.
+
+Gated off by default (SHEEP_PALLAS=1 to enable in ops.forest): the axon
+backend's Pallas support is probed by scripts/pallas_probe.py stage 1, and
+until a real window validates compiled execution, only interpret-mode
+correctness is claimed (tests/test_pallas_jump.py runs the kernel
+interpreted on CPU against the jnp oracle).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+#: VMEM budget for resident tables (bytes); conservative vs the ~16MB arena
+#: to leave room for the lo/hi/out blocks and compiler scratch.
+_TABLE_BUDGET = 12 << 20
+
+#: edge-block length per grid step (int32 x 3 blocks = 1.5MB of VMEM)
+_BLOCK_E = 1 << 17
+
+
+def _jump_group_kernel(tables_ref, lo_ref, hi_ref, out_ref):
+    """Greedy descent through the resident table group (largest stride
+    first — tables arrive already ordered deepest-first)."""
+    lo = lo_ref[...]
+    hi = hi_ref[...]
+    g = tables_ref.shape[0]
+    for i in range(g):  # static unroll: g is a compile-time block dim
+        nlo = tables_ref[i, lo]
+        lo = jnp.where(nlo < hi, nlo, lo)
+    out_ref[...] = lo
+
+
+def levels_per_call(n: int) -> int:
+    """How many ancestor tables fit in the VMEM budget for vertex count n."""
+    per_table = 4 * (n + 1)
+    return max(0, _TABLE_BUDGET // per_table)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def jump_group(tables: jnp.ndarray, lo: jnp.ndarray, hi: jnp.ndarray,
+               interpret: bool = False) -> jnp.ndarray:
+    """One fused pass: descend ``lo`` through tables [g, n+1] (deepest
+    first), keeping lo < hi invariant.  lo/hi int32 [E], E % _BLOCK_E == 0
+    is NOT required (the tail block is masked by padding semantics: callers
+    pass sentinel-padded arrays whose sentinel never moves)."""
+    e = lo.shape[0]
+    block = min(_BLOCK_E, e)
+    grid = (e + block - 1) // block
+    g, width = tables.shape
+    return pl.pallas_call(
+        _jump_group_kernel,
+        grid=(grid,),
+        in_specs=[
+            pl.BlockSpec((g, width), lambda i: (0, 0)),  # resident tables
+            pl.BlockSpec((block,), lambda i: (i,)),
+            pl.BlockSpec((block,), lambda i: (i,)),
+        ],
+        out_specs=pl.BlockSpec((block,), lambda i: (i,)),
+        out_shape=jax.ShapeDtypeStruct(lo.shape, lo.dtype),
+        interpret=interpret,
+    )(tables, lo, hi)
+
+
+def fused_jump(lo: jnp.ndarray, hi: jnp.ndarray, n: int, levels: int,
+               interpret: bool = False):
+    """Self-contained fused jump (builds its own one-step table); the
+    production entry point is :func:`fused_descend`, which takes the table
+    from the caller so mesh rounds can pmin-combine it first."""
+    sent = jnp.int32(n)
+    lo = lo.astype(jnp.int32)
+    hi = hi.astype(jnp.int32)
+    f = jnp.full(n + 1, sent, jnp.int32).at[lo].min(hi)
+    return fused_descend(lo, hi, n, levels, f, interpret=interpret)
+
+
+def fused_descend(lo: jnp.ndarray, hi: jnp.ndarray, n: int, levels: int,
+                  f: jnp.ndarray, interpret: bool = False):
+    """Descent through a given one-step table f: build the binary-lifted
+    tables (n-sized work, cheap next to E), then descend in VMEM-sized
+    groups.  Returns (lo, moved_count) like ops.forest._jump.
+
+    Falls back to the jnp descent when even one table exceeds the VMEM
+    budget (n > ~2^21) — callers should consult :func:`levels_per_call`
+    first and skip Pallas entirely in that regime.
+    """
+    lo = lo.astype(jnp.int32)
+    hi = hi.astype(jnp.int32)
+    lo_in = lo
+    tables = [f]
+    for _ in range(levels - 1):
+        tables.append(tables[-1][tables[-1]])
+    g = levels_per_call(n)
+    if g == 0:
+        for table in reversed(tables):
+            nlo = table[lo]
+            lo = jnp.where(nlo < hi, nlo, lo)
+        return lo, jnp.sum(lo != lo_in, dtype=jnp.int32)
+    deepest_first = list(reversed(tables))
+    for start in range(0, levels, g):
+        group = jnp.stack(deepest_first[start:start + g])
+        lo = jump_group(group, lo, hi, interpret=interpret)
+    return lo, jnp.sum(lo != lo_in, dtype=jnp.int32)

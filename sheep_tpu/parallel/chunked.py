@@ -53,13 +53,11 @@ from jax import lax
 from jax import shard_map
 from jax.sharding import NamedSharding, PartitionSpec as P
 
-from ..ops.forest import _rewrite_sorted, pst_weights
+from ..ops.forest import (_CHUNK_SCHEDULE as _SCHEDULE, _lift_descend,
+                          _rewrite_sorted, pst_weights)
 from ..ops.sort import degree_order
 from .mesh import AXIS, make_mesh
 
-#: per-chunk round counts — mirror ops.forest._CHUNK_SCHEDULE: probe every
-#: round while live collapses (rounds 1-3 kill most edges), batch later.
-_SCHEDULE = (1, 1, 1, 2, 4)
 _JROUNDS = 8
 _LEVELS = 10
 _FIRST_LEVELS = 4
@@ -75,20 +73,13 @@ def _row_round(lo, hi, n: int, levels: int, f_combine):
     lo, hi = lax.sort((lo, hi), num_keys=2)
     live = jnp.sum(lo != sent, dtype=jnp.int32)
     lo, hi, rewrites = _rewrite_sorted(lo, hi, n)
-    # the jump with a (possibly globally combined) min-up table; mirrors
-    # ops.forest._jump but the table is built once and combined BEFORE
-    # lifting so every worker lifts the same global f
+    # one-step min-up table, combined across the mesh BEFORE lifting so
+    # every worker lifts the same (global, for reduce rounds) f; the
+    # shared descent carries the Pallas fast-path gate
     f = jnp.full(n + 1, sent, jnp.int32).at[lo].min(hi)
     f = f_combine(f)
-    lo_in = lo
-    tables = [f]
-    for _ in range(levels - 1):
-        tables.append(tables[-1][tables[-1]])
-    for table in reversed(tables):
-        nlo = table[lo]
-        lo = jnp.where(nlo < hi, nlo, lo)
-    moved = rewrites + jnp.sum(lo != lo_in, dtype=jnp.int32)
-    return lo, hi, moved, live
+    lo, jumped = _lift_descend(lo, hi, n, levels, f)
+    return lo, hi, rewrites + jumped, live
 
 
 @functools.partial(jax.jit,

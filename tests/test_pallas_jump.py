@@ -1,0 +1,59 @@
+"""Fused Pallas jump kernel == the jnp descent, in interpreter mode.
+
+The kernel's compiled-TPU viability is probed on hardware by
+scripts/pallas_probe.py; these tests pin its SEMANTICS on CPU via
+interpret mode so a future window only has to measure, not debug.
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from conftest import random_multigraph
+
+from sheep_tpu.ops.pallas_jump import fused_jump, levels_per_call
+from sheep_tpu.ops.forest import _jump
+
+
+@pytest.mark.parametrize("trial", range(6))
+def test_fused_jump_equals_jnp(trial):
+    rng = np.random.default_rng(600 + trial)
+    n = int(rng.integers(50, 4000))
+    e = int(rng.integers(10, 20000))
+    lo_np = rng.integers(0, n, e)
+    hi_np = np.minimum(lo_np + rng.integers(1, n, e), n)
+    # sprinkle sentinels (dead links park at n, n)
+    dead = rng.random(e) < 0.2
+    lo_np[dead] = n
+    hi_np[dead] = n
+    lo = jnp.asarray(lo_np, jnp.int32)
+    hi = jnp.asarray(hi_np, jnp.int32)
+    levels = int(rng.integers(1, 11))
+    want_lo, want_moved = _jump(lo, hi, n, levels)
+    got_lo, got_moved = fused_jump(lo, hi, n, levels, interpret=True)
+    np.testing.assert_array_equal(np.asarray(got_lo), np.asarray(want_lo))
+    assert int(got_moved) == int(want_moved)
+
+
+def test_fused_jump_inside_fixpoint(monkeypatch):
+    """SHEEP_PALLAS=interpret routes the whole fixpoint through the kernel
+    and must still reproduce the oracle forest exactly."""
+    from sheep_tpu.core import build_forest, degree_sequence
+    from sheep_tpu.ops import build_graph_device
+
+    monkeypatch.setenv("SHEEP_PALLAS", "interpret")
+    rng = np.random.default_rng(42)
+    tail, head = random_multigraph(rng, n_max=60, e_max=250)
+    seq, forest = build_graph_device(tail, head)
+    want_seq = degree_sequence(tail, head)
+    want = build_forest(tail, head, want_seq)
+    np.testing.assert_array_equal(seq, want_seq)
+    np.testing.assert_array_equal(forest.parent, want.parent)
+    np.testing.assert_array_equal(forest.pst_weight, want.pst_weight)
+
+
+def test_levels_per_call_regimes():
+    assert levels_per_call(1 << 16) >= 10   # all tables resident
+    assert levels_per_call(1 << 20) >= 1    # at least singles
+    assert levels_per_call(1 << 24) == 0    # out of VMEM: jnp path

@@ -84,32 +84,40 @@ def build_graph_device(tail: np.ndarray, head: np.ndarray,
     return _finish(seq, m, parent, pst)
 
 
-def _host_seq_pst(tail_np: np.ndarray, head_np: np.ndarray, n: int):
+def _host_seq_pst(tail_np: np.ndarray, head_np: np.ndarray, n: int,
+                  seq: np.ndarray | None = None):
     """Host-side (seq, pst) identical to the device's prepare_links outputs.
 
     Same order (degree asc, vid asc — tested equal across all four build
     implementations) and same pst semantics (one count per non-self-loop
-    record at the position of its earlier-in-sequence endpoint).  Chunked
+    record at the position of its earlier-in-sequence endpoint, absent
+    heads included).  A given ``seq`` replaces the degree sort.  Chunked
     gathers keep the peak at ~3 int32 arrays of one block, not of E.
     """
     from ..core.sequence import degree_sequence, sequence_positions
 
-    seq_h = degree_sequence(tail_np, head_np, n)
+    seq_h = degree_sequence(tail_np, head_np, n) if seq is None \
+        else np.asarray(seq, dtype=np.uint32)
     pos = sequence_positions(seq_h, n - 1)
     pst = np.zeros(n, np.int64)
     block = 1 << 24
     for s in range(0, len(tail_np), block):
+        # absent vids carry INVALID (0xFFFFFFFF), which as int64 is >= n
+        # for every supported n, so min() picks the present endpoint and
+        # the lo < n filter drops both-absent pairs
         pt = pos[tail_np[s:s + block]].astype(np.int64)
         ph = pos[head_np[s:s + block]].astype(np.int64)
         lo = np.minimum(pt, ph)
-        pst += np.bincount(lo[pt != ph], minlength=n)[:n]
+        live = (pt != ph) & (lo < n)
+        pst += np.bincount(lo[live], minlength=n)[:n]
     return seq_h, pst.astype(np.uint32)
 
 
 def build_graph_hybrid(tail: np.ndarray, head: np.ndarray,
                        num_vertices: int | None = None,
                        handoff_factor: int | None = None,
-                       host_edges: tuple[np.ndarray, np.ndarray] | None = None):
+                       host_edges: tuple[np.ndarray, np.ndarray] | None = None,
+                       seq: np.ndarray | None = None):
     """Flagship heterogeneous build: TPU reduction + native union-find tail.
 
     The device runs the bandwidth-parallel phases (histogram, degree sort,
@@ -138,6 +146,13 @@ def build_graph_hybrid(tail: np.ndarray, head: np.ndarray,
     way, but 2n*4B less d2h traffic, which on a tunneled backend
     (~10MB/s, scripts/tunnel_probe.py) is seconds at 2^22+.  Numpy
     tail/head inputs serve as their own host copy automatically.
+
+    ``seq`` — an externally given elimination order (the `-s`/`-r` case):
+    skips the device degree histogram + sort entirely (two fewer full-E
+    passes plus the E-sized sort), maps links straight through the
+    position table, and honors the absent-vid pst contract (edges to
+    vids outside the sequence count toward pst, never the tree —
+    jtree.cpp:47-49).
     """
     import os
 
@@ -149,6 +164,8 @@ def build_graph_hybrid(tail: np.ndarray, head: np.ndarray,
     n = num_vertices
     if n is None:
         n = int(max(tail.max(initial=0), head.max(initial=0))) + 1 if len(tail) else 0
+    if seq is not None and len(seq):
+        n = max(n, int(np.asarray(seq).max()) + 1)
     if n == 0:
         return np.empty(0, np.uint32), Forest(
             np.empty(0, np.uint32), np.empty(0, np.uint32))
@@ -158,8 +175,22 @@ def build_graph_hybrid(tail: np.ndarray, head: np.ndarray,
         # backend the device "fetch" is a near-free copy and the host
         # recompute would compete with the reduce loop for the same cores
         host_edges = (tail, head)
-    seq, _, m, lo, hi, pst = prepare_links(
-        jnp.asarray(tail), jnp.asarray(head), n)
+    given_seq = None
+    if seq is not None:
+        # `-s` fast path: no histogram, no device sort — links map through
+        # the given position table (absent-vid contract lives in
+        # ops.sort.given_seq_links, shared with the mesh builders)
+        from .sort import given_seq_links
+        given_seq = np.asarray(seq, dtype=np.uint32)
+        lo, hi, pst = given_seq_links(tail, head, given_seq, n)
+        m = len(given_seq)
+        dev_seq = None
+    else:
+        dev_seq, _, m, lo, hi, pst = prepare_links(
+            jnp.asarray(tail), jnp.asarray(head), n)
+    # every downstream consumer (prefetch fallback, _finish) reads `seq`:
+    # the given host order when supplied, else the device-computed one
+    seq = given_seq if given_seq is not None else dev_seq
     # overlap seq/pst with the reduction rounds: with a host edge copy,
     # recompute them on the host (no d2h at all); otherwise stream them
     # down on a second thread — on the tunneled backend d2h runs ~10MB/s
@@ -173,7 +204,8 @@ def build_graph_hybrid(tail: np.ndarray, head: np.ndarray,
         try:
             if host_edges is not None:
                 t_np, h_np = host_edges
-                fetched["seq"], fetched["pst"] = _host_seq_pst(t_np, h_np, n)
+                fetched["seq"], fetched["pst"] = _host_seq_pst(
+                    t_np, h_np, n, seq=given_seq)
                 # host seq is already trimmed to the m active slots, so its
                 # length replaces the device scalar fetch (~70ms tunneled)
                 fetched["m"] = len(fetched["seq"])

@@ -65,6 +65,30 @@ def edge_links(tail: jnp.ndarray, head: jnp.ndarray, pos: jnp.ndarray, n: int):
     return jnp.where(dead, sent, lo), jnp.where(dead, sent, hi)
 
 
+def given_seq_links(tail, head, seq, n: int):
+    """Links + pst for an externally-given (possibly subset) sequence —
+    THE one encoding of the absent-vid contract (jtree.cpp:47-49): an
+    edge whose earlier endpoint is present counts toward pst even when
+    the other endpoint is absent from the sequence; only fully-present
+    links enter the tree; self-loops/padding never count.
+
+    Returns (lo, hi, pst) device arrays, lo/hi sentinel-masked for the
+    fixpoint.  Shared by the hybrid's `-s` fast path and the mesh-of-one
+    builder so the contract lives in exactly one place.
+    """
+    from ..core.sequence import sequence_positions
+    from .forest import pst_weights
+
+    pos_np = sequence_positions(seq, n - 1).astype(np.int64)
+    pos_np = np.where((pos_np < 0) | (pos_np >= n), n, pos_np)
+    pos_d = jnp.asarray(pos_np, jnp.int32)
+    lo, hi = edge_links(jnp.asarray(tail), jnp.asarray(head), pos_d, n)
+    pst = pst_weights(jnp.where(lo == hi, jnp.int32(n), lo), n)
+    dead = hi >= jnp.int32(n)
+    sent = jnp.int32(n)
+    return jnp.where(dead, sent, lo), jnp.where(dead, sent, hi), pst
+
+
 def degree_sequence_device(tail: np.ndarray, head: np.ndarray,
                            num_vertices: int | None = None) -> np.ndarray:
     """Host-facing: the reference's degreeSequence on device (active only)."""

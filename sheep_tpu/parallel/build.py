@@ -200,14 +200,17 @@ def _to_forest(parent, pst, n, m):
     return ops_to_forest(np.asarray(parent)[:m], np.asarray(pst)[:m], m)
 
 
-def _run_distributed(tail, head, num_vertices, num_workers, seq, do_merge):
+def _run_distributed(tail, head, num_vertices, num_workers, seq, do_merge,
+                     mesh=None):
     """Shared prologue + dispatch for the host-facing wrappers.
 
     Returns (out_seq, parent, pst, n, m, mesh_size) with parent/pst either
     merged [n] or stacked [W, n] depending on ``do_merge``; n == 0 signals
-    the empty graph.
+    the empty graph.  ``mesh``: pass an already-built mesh to avoid
+    constructing it twice.
     """
-    mesh = make_mesh(num_workers)
+    if mesh is None:
+        mesh = make_mesh(num_workers)
     n = num_vertices
     if n is None:
         n = int(max(tail.max(initial=0), head.max(initial=0))) + 1 if len(tail) else 0
@@ -220,6 +223,8 @@ def _run_distributed(tail, head, num_vertices, num_workers, seq, do_merge):
         # partial).  Use the chunked hosted kernel: identical results, and
         # it is the execution shape real hardware needs — the in-jit
         # while_loop below faults on long runs there (ops/forest.py).
+        # (The merged case normally never reaches here: the public
+        # wrapper routes it through the flagship hybrid first.)
         return _single_worker_build(tail, head, n, seq, do_merge)
     t_np, h_np = _pad_edges(tail, head, n, mesh.size)
     t = _stage(t_np, mesh, P(AXIS))
@@ -242,7 +247,6 @@ def _run_distributed(tail, head, num_vertices, num_workers, seq, do_merge):
 
 def _single_worker_build(tail, head, n, seq, do_merge):
     """The mesh-of-one case via the hosted kernel (same output contract)."""
-    from ..core.sequence import sequence_positions
     from ..ops.build import prepare_links
     from ..ops.forest import forest_fixpoint_hosted
 
@@ -255,17 +259,8 @@ def _single_worker_build(tail, head, n, seq, do_merge):
         m = int(m)
         out_seq = np.asarray(dseq)[:m].astype(np.uint32)
     else:
-        from ..ops.forest import pst_weights as pst_w
-        from ..ops.sort import edge_links
-        pos_np = sequence_positions(seq, n - 1).astype(np.int64)
-        pos_np = np.where((pos_np < 0) | (pos_np >= n), n, pos_np)
-        pos_d = jnp.asarray(pos_np, jnp.int32)
-        lo, hi = edge_links(t, h, pos_d, n)
-        # links to absent vids count toward pst but not the fixpoint
-        pst = pst_w(jnp.where(lo == hi, jnp.int32(n), lo), n)
-        dead = hi >= jnp.int32(n)
-        lo = jnp.where(dead, jnp.int32(n), lo)
-        hi = jnp.where(dead, jnp.int32(n), hi)
+        from ..ops.sort import given_seq_links
+        lo, hi, pst = given_seq_links(t, h, seq, n)
         m = len(seq)
         out_seq = np.asarray(seq, dtype=np.uint32)
     parent, _ = forest_fixpoint_hosted(lo, hi, n)
@@ -282,10 +277,18 @@ def build_graph_distributed(tail: np.ndarray, head: np.ndarray,
     """Host-facing distributed build: (seq uint32 [m], Forest over m).
 
     ``seq``: an externally-given elimination order (the `-r`-without-`-i`
-    case); None runs the device degree sort.
+    case); None runs the device degree sort.  A mesh of one worker routes
+    through the flagship hybrid (device reduction + native union-find
+    tail — measured ~4x the pure-device path on-chip), which with a given
+    ``seq`` also skips the device degree sort entirely.
     """
+    mesh = make_mesh(num_workers)
+    if mesh.size == 1 and len(tail):
+        from ..ops.build import build_graph_hybrid
+        return build_graph_hybrid(tail, head, num_vertices=num_vertices,
+                                  seq=seq)
     out_seq, parent, pst, n, m, _ = _run_distributed(
-        tail, head, num_vertices, num_workers, seq, do_merge=True)
+        tail, head, num_vertices, num_workers, seq, do_merge=True, mesh=mesh)
     if n == 0:
         return out_seq, Forest(np.empty(0, np.uint32), np.empty(0, np.uint32))
     return out_seq, _to_forest(parent, pst, n, m)

@@ -232,6 +232,28 @@ def test_build_graph_hybrid_explicit_host_edges(handoff):
     np.testing.assert_array_equal(forest.pst_weight, want.pst_weight)
 
 
+@pytest.mark.parametrize("with_host_edges", [False, True])
+@pytest.mark.parametrize("handoff", [2, 1000])
+def test_build_graph_hybrid_given_seq(with_host_edges, handoff):
+    # the `-s` fast path: no device histogram/sort, links map through the
+    # given position table; a SUBSET sequence exercises the absent-vid pst
+    # contract (edges to absent vids count toward pst, never the tree)
+    from sheep_tpu.ops import build_graph_hybrid
+
+    rng = np.random.default_rng(957)
+    tail, head = random_multigraph(rng, 200, 1200)
+    full = degree_sequence(tail, head)
+    seq = full[: max(2, len(full) * 2 // 3)]
+    want = build_forest(tail, head, seq,
+                        max_vid=int(max(tail.max(), head.max())))
+    he = (tail, head) if with_host_edges else None
+    out_seq, forest = build_graph_hybrid(tail, head, handoff_factor=handoff,
+                                         host_edges=he, seq=seq)
+    np.testing.assert_array_equal(out_seq, seq)
+    np.testing.assert_array_equal(forest.parent, want.parent)
+    np.testing.assert_array_equal(forest.pst_weight, want.pst_weight)
+
+
 def test_build_graph_hybrid_device_inputs_no_host_copy():
     # device-array inputs without host_edges exercise the d2h prefetch
     # branch (numpy inputs auto-use the host recompute path)

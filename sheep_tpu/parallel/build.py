@@ -111,9 +111,10 @@ def _sharded_build(tail, head, given_pos, n: int, do_merge: bool = True):
     # NOTE: this in-jit while_loop fixpoint is fine for the merge's input
     # (<= W*n tree links, most of which are already final) but on the
     # tunneled TPU backend very long data-dependent loops fault (see
-    # ops/forest.py); at multi-chip scale the merge should move to the
-    # chunked hosted driver between shard_map sections.  Single-chip
-    # hardware runs use ops.build / the hosted driver and never enter here.
+    # ops/forest.py).  The bounded-dispatch production twin is
+    # parallel.chunked (map = local chunk rounds, reduce = pmin-combined
+    # jump table); this in-jit path remains the single-dispatch
+    # correctness twin and the shape the dryrun compiles.
     parent, rounds = _gather_merge(parent_local, n)
     pst = lax.psum(pst_local, AXIS)
     return seq, pos, m, parent, pst, rounds

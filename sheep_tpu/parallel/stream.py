@@ -12,11 +12,13 @@ as links, the per-worker partial forests all_gather + rebuild associatively
 lib/jnode.cpp:203-250), and pst accumulates by psum.  Device-resident state
 stays O(n + block/W) per worker for any edge count.
 
-Like the in-jit merge in parallel.build, the while_loop fixpoint per block is
-the right shape for the virtual-mesh correctness proof and for real
-multi-chip slices with ordinary per-execution budgets; on the tunneled
-single-chip backend the hosted chunked driver (ops.stream
-build_graph_streaming_hosted) remains the production path.
+Like the in-jit merge in parallel.build, the while_loop fixpoint per block
+is a correctness twin: the PRODUCTION mesh streaming path is
+parallel.chunked.build_graph_streaming_chunked (bounded dispatches only —
+the while_loop shape faults on real hardware past a wall-time budget), and
+on the tunneled single-chip backend the hosted chunked driver (ops.stream
+build_graph_streaming_hosted) is the single-device production path.  Both
+twins are pinned equal by tests on random multigraphs and 2-process meshes.
 """
 
 from __future__ import annotations

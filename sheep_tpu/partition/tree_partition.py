@@ -108,7 +108,11 @@ def forward_partition(forest: Forest, max_component: int,
             # descending component weight, stable (ascending jnid ties) —
             # matches the native runtime; the reference's unstable
             # std::sort leaves ties toolchain-defined (see the note in
-            # sheep_native.cpp and scripts/quality_sweep.py)
+            # sheep_native.cpp and scripts/quality_sweep.py).  Observed
+            # magnitude of that toolchain freedom: hep-th ECV(down) at
+            # parts=24 is 2723 here vs the reference log's 2720 — the
+            # only row of the published 2..32 sweep that differs at all
+            # (QUALITY_r03.json; SURVEY §7 predicted exactly this)
             ks = ks[np.argsort(-component_below[ks], kind="stable")]
             while component_below[i] > max_component:
                 for kid in ks:

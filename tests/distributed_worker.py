@@ -19,7 +19,7 @@ def main() -> None:
                                 int(sys.argv[3]), sys.argv[4])
     mode = sys.argv[5] if len(sys.argv) > 5 else "degree"
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
-    if mode in ("build", "stream"):
+    if mode in ("build", "stream", "chunked", "chunked_stream"):
         return main_build(coord, num, pid, out_dir, mode)
 
     import numpy as np
@@ -112,6 +112,29 @@ def main_build(coord: str, num: int, pid: int, out_dir: str,
         np.testing.assert_array_equal(seq, want_seq)
         np.testing.assert_array_equal(forest.parent, want.parent)
         np.testing.assert_array_equal(forest.pst_weight, want.pst_weight)
+    elif mode == "chunked":
+        # the bounded-dispatch production shape across a 2-process mesh:
+        # host chunk loop + stats fetches must be multi-process safe
+        from sheep_tpu.parallel import build_graph_chunked_distributed
+        seq, forest = build_graph_chunked_distributed(tail, head)
+        np.testing.assert_array_equal(seq, want_seq)
+        np.testing.assert_array_equal(forest.parent, want.parent)
+        np.testing.assert_array_equal(forest.pst_weight, want.pst_weight)
+    elif mode == "chunked_stream":
+        from sheep_tpu.core.sequence import sequence_positions
+        from sheep_tpu.parallel import build_graph_streaming_chunked
+        n = int(max(tail.max(), head.max())) + 1
+        n = max(n, len(want_seq))
+        pos = sequence_positions(want_seq, n - 1)
+        block = len(tail) // 3 + 1
+        forest, _ = build_graph_streaming_chunked(
+            ((tail[a:a + block], head[a:a + block])
+             for a in range(0, len(tail), block)),
+            n, pos, block_edges=block)
+        m = len(want_seq)
+        np.testing.assert_array_equal(forest.parent[:m], want.parent)
+        np.testing.assert_array_equal(forest.pst_weight[:m],
+                                      want.pst_weight)
     else:
         from sheep_tpu.core.sequence import sequence_positions
         from sheep_tpu.parallel import build_graph_streaming_sharded

@@ -113,7 +113,8 @@ def _two_process_env(repo):
     return coord, env
 
 
-@pytest.mark.parametrize("mode", ["degree", "build", "stream"])
+@pytest.mark.parametrize("mode", ["degree", "build", "stream",
+                                  "chunked", "chunked_stream"])
 def test_init_distributed_two_process_cpu(tmp_path, mode):
     """init_distributed (parallel/mesh.py) joins a real 2-process
     coordination service on CPU — the DCN/multi-host analog of the

@@ -343,6 +343,25 @@ def _pad_pow2(x: int, lo_cap: int = 1 << 12) -> int:
 _CHUNK_SCHEDULE = (1, 1, 1, 2, 4)
 
 
+def _depth_tier(live: int, pad: int, in_schedule: bool, levels: int,
+                first_levels: int, cap: int) -> int:
+    """Three-tier lifting depth shared by the hosted and mesh chunk loops
+    (round-4 A/B, PERF_NOTES): light ``first_levels`` while the live set
+    is still at full size (full-size gathers cost most and early progress
+    is dedupe/star-collapse); ``levels+2`` mid-phase; ``levels+6`` once
+    live is below an eighth of the original padded size (late-phase
+    gathers are cheap and the remaining cost is chain DEPTH, which deep
+    tables cut exponentially).  Measured on the pure-device path:
+    24.7->18.0s at 2^20, 181.8->98.5s (1.85x) at 2^22, parents
+    bit-identical; 14/18 tiers measured slightly worse.
+    """
+    if in_schedule and live >= pad:
+        return first_levels
+    if live > pad // 8:
+        return min(levels + 2, cap)
+    return min(levels + 6, cap)
+
+
 def reduce_links_hosted(lo, hi, n: int, stop_live: int = 0,
                         levels: int = 10, jrounds: int = 8,
                         first_levels: int = 4):
@@ -355,11 +374,10 @@ def reduce_links_hosted(lo, hi, n: int, stop_live: int = 0,
     few dead ones — callers must still mask lo < n).
 
     A sort-free jump-only opener round runs first, then chunks follow
-    ``_CHUNK_SCHEDULE`` and repeat ``jrounds``; light ``first_levels``
-    lifting is used while the arrays are still at their original size
-    (early progress comes from dedupe/star-collapse, and full-size
-    gathers are the expensive ones), deep ``levels`` lifting once
-    compaction has halved them.
+    ``_CHUNK_SCHEDULE`` and repeat ``jrounds``; lifting depth escalates
+    per :func:`_depth_tier` as the live set collapses (``levels`` is the
+    mid-phase base: effective depth is levels+2 mid, levels+6 late,
+    capped at log2(n)).
     """
     lo = jnp.asarray(lo, jnp.int32)
     hi = jnp.asarray(hi, jnp.int32)
@@ -373,6 +391,8 @@ def reduce_links_hosted(lo, hi, n: int, stop_live: int = 0,
         hi = jnp.concatenate([hi, fill])
     rounds = 0
     chunk_i = 0
+    cap = int(np.ceil(np.log2(n + 2)))
+    cur_live = int(lo.shape[0])  # refined to the true live count per fetch
     # Jump-only opener: on the full-size arrays the sort is the most
     # expensive op and round 1's sort retires almost nothing (~6%) — the
     # collisions this jump creates are what round 2's sort dedupes.  26%
@@ -385,21 +405,16 @@ def reduce_links_hosted(lo, hi, n: int, stop_live: int = 0,
     while True:
         j = _CHUNK_SCHEDULE[chunk_i] if chunk_i < len(_CHUNK_SCHEDULE) \
             else jrounds
-        # light lifting while the arrays are still full-size (extra gathers
-        # are at their most expensive there, and early progress comes from
-        # dedupe/star-collapse, not deep chains); deep lifting once
-        # compaction has halved them — or once the fixed schedule runs out,
-        # so inputs that never compact (near-unique link sets) still get
-        # deep jumps instead of crawling chains 2^3 ancestors at a time.
-        # A/B on the real chip at 2^20: this rule reaches the same stop
-        # round as deep-from-chunk-2 while spending 2.15s vs 3.68s in the
-        # reduce phase.
-        lv = first_levels if (lo.shape[0] >= pad
-                              and chunk_i < len(_CHUNK_SCHEDULE)) else levels
+        # tier on the TRUE live count (refined per fetch), not the array
+        # shape — compaction floors at 4096 slots, which would otherwise
+        # keep small/mid inputs out of the deep tier forever
+        lv = _depth_tier(cur_live, pad, chunk_i < len(_CHUNK_SCHEDULE),
+                         levels, first_levels, cap)
         lo, hi, stats = fixpoint_chunk(lo, hi, n, lv, j)
         rounds += j
         chunk_i += 1
         moved_i, live_i = (int(x) for x in np.asarray(stats))  # one sync
+        cur_live = live_i
         if moved_i == 0:
             return lo, hi, live_i, rounds, True
         if stop_live and live_i <= stop_live:

@@ -53,8 +53,8 @@ from jax import lax
 from jax import shard_map
 from jax.sharding import NamedSharding, PartitionSpec as P
 
-from ..ops.forest import (_CHUNK_SCHEDULE as _SCHEDULE, _lift_descend,
-                          _rewrite_sorted, pst_weights)
+from ..ops.forest import (_CHUNK_SCHEDULE as _SCHEDULE, _depth_tier,
+                          _lift_descend, _rewrite_sorted, pst_weights)
 from ..ops.sort import degree_order
 from .mesh import AXIS, make_mesh
 
@@ -209,18 +209,24 @@ def reduce_links_sharded(lo, hi, n: int, mesh, global_f: bool,
         return lo, hi, 0
     rounds = 0
     chunk_i = 0
+    cap = int(np.ceil(np.log2(n + 2)))
+    cur_live = cols0  # refined to pmax of per-row live counts per fetch
     while True:
         j = _SCHEDULE[chunk_i] if chunk_i < len(_SCHEDULE) else jrounds
-        # map phase: light lifting while arrays are full-size (early
-        # progress is dedupe/star-collapse; full-size gathers cost most).
-        # reduce phase: deep lifting immediately — merge input is already
-        # compact per-worker forests whose cost is chain DEPTH, not size.
-        lv = first_levels if (not global_f and int(lo.shape[1]) >= cols0
-                              and chunk_i < len(_SCHEDULE)) else levels
+        if global_f:
+            # reduce rounds: input is already-compact per-worker forests
+            # whose cost is chain depth — deep tier immediately
+            lv = min(levels + 6, cap)
+        else:
+            # map rounds: same escalation as the hosted twin (PERF_NOTES
+            # round-4 A/B: 1.85x at 2^22), tiered on the true live count
+            lv = _depth_tier(cur_live, cols0, chunk_i < len(_SCHEDULE),
+                             levels, first_levels, cap)
         lo, hi, stats = chunk_sharded(lo, hi, n, mesh, lv, j, global_f)
         rounds += j
         chunk_i += 1
         moved_i, live_i = (int(x) for x in fetch(stats))  # one sync
+        cur_live = live_i
         if moved_i == 0:
             return lo, hi, rounds
         target = _pad_pow2_cols(live_i)

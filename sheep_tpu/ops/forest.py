@@ -343,21 +343,29 @@ def _pad_pow2(x: int, lo_cap: int = 1 << 12) -> int:
 _CHUNK_SCHEDULE = (1, 1, 1, 2, 4)
 
 
-def _depth_tier(live: int, pad: int, in_schedule: bool, levels: int,
+def _depth_tier(size: int, pad: int, in_schedule: bool, levels: int,
                 first_levels: int, cap: int) -> int:
     """Three-tier lifting depth shared by the hosted and mesh chunk loops
-    (round-4 A/B, PERF_NOTES): light ``first_levels`` while the live set
-    is still at full size (full-size gathers cost most and early progress
-    is dedupe/star-collapse); ``levels+2`` mid-phase; ``levels+6`` once
-    live is below an eighth of the original padded size (late-phase
-    gathers are cheap and the remaining cost is chain DEPTH, which deep
-    tables cut exponentially).  Measured on the pure-device path:
-    24.7->18.0s at 2^20, 181.8->98.5s (1.85x) at 2^22, parents
-    bit-identical; 14/18 tiers measured slightly worse.
+    (round-4 A/B, PERF_NOTES): light ``first_levels`` while the ARRAYS
+    are still at full size (full-width gathers cost most and early
+    progress is dedupe/star-collapse); ``levels+2`` mid-phase;
+    ``levels+6`` once compaction is below an eighth of the original
+    padded size (late-phase gathers are cheap and the remaining cost is
+    chain DEPTH, which deep tables cut exponentially).
+
+    ``size`` is the current ARRAY length — the gather width actually
+    paid, which is what the tier trades against depth.  Tiering on the
+    live count instead was A/B'd and lost (2^22: 109.7-114.6s vs 98.5s;
+    deep tiers engaged a fetch earlier, on still-wide arrays).  Measured
+    vs flat levels=10 on the pure-device path: 24.7->18.0s at 2^20,
+    181.8->98.5s (1.85x) at 2^22, parents bit-identical; 14/18 tiers
+    slightly worse.  Caveat: compaction floors at 4096 slots, so inputs
+    with pad <= 16384 never reach the deep tier — at those sizes the
+    whole build is milliseconds and depth is irrelevant.
     """
-    if in_schedule and live >= pad:
+    if in_schedule and size >= pad:
         return first_levels
-    if live > pad // 8:
+    if size > pad // 8:
         return min(levels + 2, cap)
     return min(levels + 6, cap)
 
@@ -392,7 +400,6 @@ def reduce_links_hosted(lo, hi, n: int, stop_live: int = 0,
     rounds = 0
     chunk_i = 0
     cap = int(np.ceil(np.log2(n + 2)))
-    cur_live = int(lo.shape[0])  # refined to the true live count per fetch
     # Jump-only opener: on the full-size arrays the sort is the most
     # expensive op and round 1's sort retires almost nothing (~6%) — the
     # collisions this jump creates are what round 2's sort dedupes.  26%
@@ -405,16 +412,13 @@ def reduce_links_hosted(lo, hi, n: int, stop_live: int = 0,
     while True:
         j = _CHUNK_SCHEDULE[chunk_i] if chunk_i < len(_CHUNK_SCHEDULE) \
             else jrounds
-        # tier on the TRUE live count (refined per fetch), not the array
-        # shape — compaction floors at 4096 slots, which would otherwise
-        # keep small/mid inputs out of the deep tier forever
-        lv = _depth_tier(cur_live, pad, chunk_i < len(_CHUNK_SCHEDULE),
+        lv = _depth_tier(int(lo.shape[0]), pad,
+                         chunk_i < len(_CHUNK_SCHEDULE),
                          levels, first_levels, cap)
         lo, hi, stats = fixpoint_chunk(lo, hi, n, lv, j)
         rounds += j
         chunk_i += 1
         moved_i, live_i = (int(x) for x in np.asarray(stats))  # one sync
-        cur_live = live_i
         if moved_i == 0:
             return lo, hi, live_i, rounds, True
         if stop_live and live_i <= stop_live:

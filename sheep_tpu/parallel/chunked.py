@@ -210,23 +210,24 @@ def reduce_links_sharded(lo, hi, n: int, mesh, global_f: bool,
     rounds = 0
     chunk_i = 0
     cap = int(np.ceil(np.log2(n + 2)))
-    cur_live = cols0  # refined to pmax of per-row live counts per fetch
     while True:
         j = _SCHEDULE[chunk_i] if chunk_i < len(_SCHEDULE) else jrounds
         if global_f:
-            # reduce rounds: input is already-compact per-worker forests
-            # whose cost is chain depth — deep tier immediately
-            lv = min(levels + 6, cap)
+            # reduce rounds: flat base depth — the MESHBENCH rerun
+            # measured the deep tier consistently 8-10% WORSE here with
+            # unchanged round counts (deeper tables add gather cost but
+            # merge chains are short enough that rounds don't drop)
+            lv = min(levels, cap)
         else:
             # map rounds: same escalation as the hosted twin (PERF_NOTES
-            # round-4 A/B: 1.85x at 2^22), tiered on the true live count
-            lv = _depth_tier(cur_live, cols0, chunk_i < len(_SCHEDULE),
+            # round-4 A/B: 1.85x at 2^22), tiered on the array width
+            lv = _depth_tier(int(lo.shape[1]), cols0,
+                             chunk_i < len(_SCHEDULE),
                              levels, first_levels, cap)
         lo, hi, stats = chunk_sharded(lo, hi, n, mesh, lv, j, global_f)
         rounds += j
         chunk_i += 1
         moved_i, live_i = (int(x) for x in fetch(stats))  # one sync
-        cur_live = live_i
         if moved_i == 0:
             return lo, hi, rounds
         target = _pad_pow2_cols(live_i)

@@ -328,3 +328,21 @@ def test_build_graph_device_rmat_oracle():
     np.testing.assert_array_equal(seq, want_seq)
     np.testing.assert_array_equal(forest.parent, want.parent)
     np.testing.assert_array_equal(forest.pst_weight, want.pst_weight)
+
+
+def test_depth_tier_rule():
+    """Pin the three-tier lifting-depth boundaries (PERF_NOTES round-4
+    A/B): light at full width inside the schedule, +2 mid, +6 below an
+    eighth, capped at log2(n)."""
+    from sheep_tpu.ops.forest import _depth_tier
+
+    pad, levels, first, cap = 1 << 20, 10, 4, 22
+    assert _depth_tier(pad, pad, True, levels, first, cap) == first
+    # outside the schedule, full width no longer gets the light tier
+    assert _depth_tier(pad, pad, False, levels, first, cap) == levels + 2
+    assert _depth_tier(pad // 2, pad, True, levels, first, cap) == levels + 2
+    assert _depth_tier(pad // 8 + 1, pad, True, levels, first, cap) \
+        == levels + 2
+    assert _depth_tier(pad // 8, pad, True, levels, first, cap) == levels + 6
+    # small-n cap beats the escalation
+    assert _depth_tier(100, 4096, False, levels, first, 9) == 9

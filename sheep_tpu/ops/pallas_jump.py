@@ -38,14 +38,21 @@ _TABLE_BUDGET = 12 << 20
 _BLOCK_E = 1 << 17
 
 
-def _jump_group_kernel(tables_ref, lo_ref, hi_ref, out_ref):
+def _jump_group_kernel(*refs):
     """Greedy descent through the resident table group (largest stride
-    first — tables arrive already ordered deepest-first)."""
+    first — tables arrive already ordered deepest-first).
+
+    refs = (table_ref_0, ..., table_ref_{g-1}, lo_ref, hi_ref, out_ref);
+    each table is its own 1D ref so every gather is the exact 1D
+    ``f_ref[l]`` shape scripts/pallas_probe.py stage 2 validates on the
+    backend — a 2D ``tables_ref[i, lo]`` gather is a different lowering
+    path Mosaic may not support even where the 1D one works.
+    """
+    *table_refs, lo_ref, hi_ref, out_ref = refs
     lo = lo_ref[...]
     hi = hi_ref[...]
-    g = tables_ref.shape[0]
-    for i in range(g):  # static unroll: g is a compile-time block dim
-        nlo = tables_ref[i, lo]
+    for tref in table_refs:  # static unroll: g is compile-time
+        nlo = tref[lo]
         lo = jnp.where(nlo < hi, nlo, lo)
     out_ref[...] = lo
 
@@ -57,28 +64,28 @@ def levels_per_call(n: int) -> int:
 
 
 @functools.partial(jax.jit, static_argnames=("interpret",))
-def jump_group(tables: jnp.ndarray, lo: jnp.ndarray, hi: jnp.ndarray,
+def jump_group(tables: tuple, lo: jnp.ndarray, hi: jnp.ndarray,
                interpret: bool = False) -> jnp.ndarray:
-    """One fused pass: descend ``lo`` through tables [g, n+1] (deepest
+    """One fused pass: descend ``lo`` through the table tuple (deepest
     first), keeping lo < hi invariant.  lo/hi int32 [E], E % _BLOCK_E == 0
     is NOT required (the tail block is masked by padding semantics: callers
     pass sentinel-padded arrays whose sentinel never moves)."""
     e = lo.shape[0]
     block = min(_BLOCK_E, e)
     grid = (e + block - 1) // block
-    g, width = tables.shape
+    width = tables[0].shape[0]
     return pl.pallas_call(
         _jump_group_kernel,
         grid=(grid,),
-        in_specs=[
-            pl.BlockSpec((g, width), lambda i: (0, 0)),  # resident tables
+        in_specs=[pl.BlockSpec((width,), lambda i: (0,))  # resident tables
+                  for _ in tables] + [
             pl.BlockSpec((block,), lambda i: (i,)),
             pl.BlockSpec((block,), lambda i: (i,)),
         ],
         out_specs=pl.BlockSpec((block,), lambda i: (i,)),
         out_shape=jax.ShapeDtypeStruct(lo.shape, lo.dtype),
         interpret=interpret,
-    )(tables, lo, hi)
+    )(*tables, lo, hi)
 
 
 def fused_jump(lo: jnp.ndarray, hi: jnp.ndarray, n: int, levels: int,
@@ -117,6 +124,6 @@ def fused_descend(lo: jnp.ndarray, hi: jnp.ndarray, n: int, levels: int,
         return lo, jnp.sum(lo != lo_in, dtype=jnp.int32)
     deepest_first = list(reversed(tables))
     for start in range(0, levels, g):
-        group = jnp.stack(deepest_first[start:start + g])
+        group = tuple(deepest_first[start:start + g])
         lo = jump_group(group, lo, hi, interpret=interpret)
     return lo, jnp.sum(lo != lo_in, dtype=jnp.int32)

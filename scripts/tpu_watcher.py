@@ -121,6 +121,16 @@ class Step:
 
     def run(self) -> bool:
         env = dict(os.environ)
+        # persistent compile cache for every step (bench.py sets its own;
+        # profiles/diags recompile the same programs otherwise) — windows
+        # are short and tunneled compiles run 30-130s each
+        cache_dir = os.path.join(os.path.expanduser("~"), ".cache",
+                                 "sheep_jax")
+        try:
+            os.makedirs(cache_dir, exist_ok=True)
+            env.setdefault("JAX_COMPILATION_CACHE_DIR", cache_dir)
+        except OSError:
+            pass
         env.update(self.env)
         log(f"step {self.name}: {' '.join(self.cmd)} (timeout {self.timeout}s)")
         t0 = time.time()

@@ -1,0 +1,71 @@
+"""Unit tests for the TPU-window watcher's gating logic.
+
+The watcher runs unattended for whole rounds; a wrong done()/_on_accel
+decision silently costs the next hardware window (round-3 lesson: every
+planned on-chip measurement queue died with the tunnel).  No jax needed.
+"""
+
+import importlib.util
+import json
+import os
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(scope="module")
+def watcher():
+    spec = importlib.util.spec_from_file_location(
+        "tpu_watcher", os.path.join(REPO, "scripts", "tpu_watcher.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_on_accel_rejects_partials_and_cpu(watcher):
+    assert not watcher._on_accel(None)
+    assert not watcher._on_accel({"platform": "cpu"})
+    assert not watcher._on_accel({"platform": "tpu", "_partial": True})
+    assert not watcher._on_accel(
+        {"metric": "device_build_edges_per_sec_cpu_fallback", "value": 1})
+    assert watcher._on_accel({"platform": "tpu"})
+    assert watcher._on_accel({"platform": "axon"})
+    assert watcher._on_accel({"metric": "device_build_edges_per_sec_rmat"})
+
+
+def test_step_done_semantics(watcher, tmp_path, monkeypatch):
+    monkeypatch.setattr(watcher, "REPO", str(tmp_path))
+    plain = watcher.Step("s", ["true"], "OUT.json", 10)
+    assert not plain.done()  # no artifact yet
+    with open(plain.out_path, "w") as f:
+        json.dump({"platform": "cpu", "_step": "s"}, f)
+    assert not plain.done()  # cpu record never satisfies
+    with open(plain.out_path, "w") as f:
+        json.dump({"platform": "tpu", "_step": "s", "_partial": True}, f)
+    assert not plain.done()  # timeout salvage never satisfies
+    with open(plain.out_path, "w") as f:
+        json.dump({"platform": "tpu", "_step": "s"}, f)
+    assert plain.done()
+
+    # append-mode steps match on their own _step tag only
+    a = watcher.Step("a", ["true"], "LOG.jsonl", 10, append=True)
+    b = watcher.Step("b", ["true"], "LOG.jsonl", 10, append=True)
+    with open(a.out_path, "w") as f:
+        f.write(json.dumps({"platform": "tpu", "_step": "a"}) + "\n")
+    assert a.done() and not b.done()
+
+
+def test_queue_is_consistent(watcher):
+    q = watcher.build_queue()
+    names = [s.name for s in q]
+    assert len(names) == len(set(names)), "duplicate step names"
+    # the benchmark of record must be first (windows close mid-queue)
+    assert names[0] == "bench_sweep"
+    assert q[0].sidecar == "bench_progress.json"
+    # non-append steps must not share an output file (they overwrite)
+    plain_outs = [s.out for s in q if not s.append]
+    assert len(plain_outs) == len(set(plain_outs))
+    for s in q:
+        assert s.timeout > 0
+        assert os.path.exists(os.path.join(REPO, s.cmd[1])), s.cmd

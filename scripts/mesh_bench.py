@@ -1,11 +1,13 @@
 """Workers-vs-throughput curve for the chunked mesh build (MESHBENCH).
 
-Measures the map (per-shard chunked reduction) and reduce (global-f
-chunked merge) phases of parallel/chunked.py per worker count on one
-R-MAT size, plus end-to-end edges/s.  The baseline being chased is
-itself an 18-rank aggregate (data/slurm-twitter/slurm-25.avg:13-17), so
-the aggregate-scaling story needs measured per-worker-count numbers, not
-arithmetic.
+Per worker count, A/Bs the two chunked mesh drivers on one R-MAT size:
+``unified`` (global-f rounds from round 1, the production default — its
+edges_per_sec is each row's headline) vs ``split`` (map-then-reduce, the
+reference's transportable-partials shape), each with prep/map/reduce
+phase seconds and round counts nested per variant.  The baseline being
+chased is itself an 18-rank aggregate
+(data/slurm-twitter/slurm-25.avg:13-17), so the aggregate-scaling story
+needs measured per-worker-count numbers, not arithmetic.
 
 On the CPU backend this runs the virtual 8-device mesh (set by this
 script; the 1-core bench host shares one core across virtual workers, so
@@ -69,29 +71,34 @@ def main() -> None:
         mesh = make_mesh(w)
         t2d, h2d = stage_edges_2d(tail, head, n, mesh)
         jax.block_until_ready((t2d, h2d))
-        best = None
-        for _ in range(reps + 1):  # +1 warmup/compile
-            tm = {}
-            t0 = time.perf_counter()
-            _, _, _, parent, _ = build_links_chunked_sharded(
-                t2d, h2d, n, mesh, timings=tm)
-            total = time.perf_counter() - t0
-            tm["total_s"] = total
-            if best is None or total < best["total_s"]:
-                best = tm
-        row = {"workers": w,
-               "map_s": round(best["map_s"], 4),
-               "reduce_s": round(best["reduce_s"], 4),
-               "prep_s": round(best["prep_s"], 4),
-               "total_s": round(best["total_s"], 4),
-               "map_rounds": best["map_rounds"],
-               "reduce_rounds": best["reduce_rounds"],
-               "edges_per_sec": round(e / best["total_s"], 1),
-               "map_edges_per_sec": round(e / best["map_s"], 1)}
+        row = {"workers": w}
+        for label, unified in (("unified", True), ("split", False)):
+            best = None
+            for _ in range(reps + 1):  # +1 warmup/compile
+                tm = {}
+                t0 = time.perf_counter()
+                _, _, _, parent, _ = build_links_chunked_sharded(
+                    t2d, h2d, n, mesh, timings=tm, unified=unified)
+                total = time.perf_counter() - t0
+                tm["total_s"] = total
+                if best is None or total < best["total_s"]:
+                    best = tm
+            row[label] = {
+                "map_s": round(best["map_s"], 4),
+                "reduce_s": round(best["reduce_s"], 4),
+                "prep_s": round(best["prep_s"], 4),
+                "total_s": round(best["total_s"], 4),
+                "map_rounds": best["map_rounds"],
+                "reduce_rounds": best["reduce_rounds"],
+                "edges_per_sec": round(e / best["total_s"], 1)}
+        row["edges_per_sec"] = row["unified"]["edges_per_sec"]
         rec["curve"].append(row)
-        print(f"mesh_bench: W={w} map {row['map_s']}s "
-              f"({row['map_rounds']} r) reduce {row['reduce_s']}s "
-              f"({row['reduce_rounds']} r) -> "
+        print(f"mesh_bench: W={w} unified "
+              f"{row['unified']['total_s']}s "
+              f"({row['unified']['reduce_rounds']} r) vs split "
+              f"{row['split']['total_s']}s "
+              f"({row['split']['map_rounds']}+"
+              f"{row['split']['reduce_rounds']} r) -> "
               f"{row['edges_per_sec']:.0f} edges/s", file=sys.stderr)
 
     if log_n >= 18:

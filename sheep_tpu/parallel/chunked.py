@@ -236,13 +236,26 @@ def reduce_links_sharded(lo, hi, n: int, mesh, global_f: bool,
 
 
 def build_links_chunked_sharded(tail_2d, head_2d, n: int, mesh,
-                                pos=None, fetch=None, timings=None):
+                                pos=None, fetch=None, timings=None,
+                                unified: bool = True):
     """Full chunked mesh build from staged [W, B] edge arrays.
 
     Returns (seq, pos, m, parent, pst) — all replicated device arrays,
     parent [n] int32 with n marking roots.  ``timings``: optional dict
     that receives wall-clock seconds for the prep/map/reduce phases and
     the per-phase round counts (the MESHBENCH instrumentation hook).
+
+    ``unified`` (default): run global-f rounds from the FIRST round —
+    measured 1.77x (W=2) to 2.07x (W=8) faster than the map-then-reduce
+    split at 2^18 on the virtual mesh (MESHBENCH_r04.json, the committed
+    run of record), bit-identical parents, because
+    the unified fixpoint converges in the same round count as the
+    split's reduce phase alone: with the globally combined jump table
+    available every round, the per-shard local map phase is redundant
+    work.  The split form (unified=False) remains for measurement and
+    because it IS the reference's transportable-partials contract — the
+    map-only path (per-worker partial trees for the file-path
+    tournament) still uses local rounds by construction.
     """
     import time as _time
     fetch = fetch or np.asarray
@@ -254,20 +267,27 @@ def build_links_chunked_sharded(tail_2d, head_2d, n: int, mesh,
             tail_2d, head_2d, n, mesh, pos=pos, with_pos=True)
     jax.block_until_ready(lo)
     t1 = _time.perf_counter()
-    # map: shards reduce independently to per-worker partial forests
-    lo, hi, map_rounds = reduce_links_sharded(lo, hi, n, mesh,
-                                              global_f=False, fetch=fetch)
-    jax.block_until_ready(lo)
-    t2 = _time.perf_counter()
-    # reduce: global-f rounds stitch the partials into one forest
-    lo, hi, red_rounds = reduce_links_sharded(lo, hi, n, mesh,
-                                              global_f=True, fetch=fetch)
+    if unified:
+        lo, hi, red_rounds = reduce_links_sharded(lo, hi, n, mesh,
+                                                  global_f=True, fetch=fetch)
+        map_rounds = 0
+        t2 = t1
+    else:
+        # map: shards reduce independently to per-worker partial forests
+        lo, hi, map_rounds = reduce_links_sharded(lo, hi, n, mesh,
+                                                  global_f=False, fetch=fetch)
+        jax.block_until_ready(lo)
+        t2 = _time.perf_counter()
+        # reduce: global-f rounds stitch the partials into one forest
+        lo, hi, red_rounds = reduce_links_sharded(lo, hi, n, mesh,
+                                                  global_f=True, fetch=fetch)
     parent = parent_sharded(lo, hi, n, mesh)
     jax.block_until_ready(parent)
     t3 = _time.perf_counter()
     if timings is not None:
         timings.update(prep_s=t1 - t0, map_s=t2 - t1, reduce_s=t3 - t2,
-                       map_rounds=map_rounds, reduce_rounds=red_rounds)
+                       map_rounds=map_rounds, reduce_rounds=red_rounds,
+                       unified=unified)
     return seq, pos_r, m, parent, pst
 
 
@@ -348,9 +368,11 @@ def build_graph_streaming_chunked(blocks, n: int, pos: np.ndarray,
 
     Same contract as parallel.stream.build_graph_streaming_sharded —
     (Forest over n positions, total_rounds) — but each block folds through
-    the chunked sharded reducer (local rounds then global-f rounds)
-    instead of an in-jit while_loop fixpoint.  The carry forest re-enters
-    sharded, so worker-resident link state stays O(n/W + B/W) per block.
+    the chunked sharded reducer (unified global-f rounds; see
+    build_links_chunked_sharded for why the local map phase is redundant
+    work) instead of an in-jit while_loop fixpoint.  The carry forest
+    re-enters sharded, so worker-resident link state stays O(n/W + B/W)
+    per block.
     """
     from .. import INVALID_JNID
     from ..core.forest import Forest
@@ -392,15 +414,16 @@ def build_graph_streaming_chunked(blocks, n: int, pos: np.ndarray,
                 h[i, :cnt] = head[sl]
         lo, hi, pst_delta = prep_stream_sharded(
             parent, put(t, shard2d), put(h, shard2d), pos_d, n, cn, mesh)
-        lo, hi, r1 = reduce_links_sharded(lo, hi, n, mesh, global_f=False,
-                                          fetch=_fetch)
-        lo, hi, r2 = reduce_links_sharded(lo, hi, n, mesh, global_f=True,
-                                          fetch=_fetch)
+        # unified global-f rounds from the start (see
+        # build_links_chunked_sharded: the split's local map phase is
+        # redundant when the combined jump table is available per round)
+        lo, hi, r = reduce_links_sharded(lo, hi, n, mesh, global_f=True,
+                                         fetch=_fetch)
         parent = parent_sharded(lo, hi, n, mesh)
         # int64 host accumulation: per-block deltas are int32-safe, the
         # running sum follows the uint32 weight contract via the final cast
         pst += _fetch(pst_delta).astype(np.int64)
-        total_rounds += r1 + r2
+        total_rounds += r
     parent_np = _fetch(parent).astype(np.int64)
     out = np.full(n, INVALID_JNID, dtype=np.uint32)
     live = parent_np < n

@@ -76,6 +76,30 @@ def test_chunked_empty_graph():
     assert forest.n == 0
 
 
+@pytest.mark.parametrize("workers", [2, 8])
+def test_unified_equals_split(workers):
+    """The unified (global-f-from-round-1) and split (map-then-reduce)
+    chunk drivers must produce identical parents — the split form is the
+    reference's transportable-partials contract, the unified form the
+    faster fused program."""
+    from sheep_tpu.parallel.chunked import (build_links_chunked_sharded,
+                                            stage_edges_2d)
+    from sheep_tpu.parallel.mesh import make_mesh
+
+    rng = np.random.default_rng(7700 + workers)
+    tail, head = random_multigraph(rng, n_max=80, e_max=400)
+    n = int(max(tail.max(), head.max())) + 1
+    mesh = make_mesh(workers)
+    t2d, h2d = stage_edges_2d(tail, head, n, mesh)
+    outs = {}
+    for unified in (True, False):
+        _, _, _, parent, pst = build_links_chunked_sharded(
+            t2d, h2d, n, mesh, unified=unified)
+        outs[unified] = (np.asarray(parent), np.asarray(pst))
+    np.testing.assert_array_equal(outs[True][0], outs[False][0])
+    np.testing.assert_array_equal(outs[True][1], outs[False][1])
+
+
 @pytest.mark.parametrize("workers,block", [(8, 64), (3, 100), (1, 64)])
 def test_chunked_streaming_equals_oracle(workers, block):
     """OOM streaming with bounded dispatches: per-block carry fold must
@@ -112,5 +136,6 @@ def test_chunked_hepth(hep_edges):
     np.testing.assert_array_equal(seq, want_seq)
     np.testing.assert_array_equal(forest.parent, want.parent)
     np.testing.assert_array_equal(forest.pst_weight, want.pst_weight)
-    assert tm["map_rounds"] >= 1 and tm["reduce_rounds"] >= 1
-    assert tm["map_s"] > 0 and tm["reduce_s"] > 0
+    # unified default: all rounds are global-f, no separate map phase
+    assert tm["unified"] and tm["map_rounds"] == 0
+    assert tm["reduce_rounds"] >= 1 and tm["reduce_s"] > 0

@@ -22,6 +22,9 @@ hanging the first compile), the whole sweep reruns on CPU with the
 
 Env: SHEEP_BENCH_SIZES (csv of log2 sizes; default "16,18,20,22,23" on
 accelerators, "16,18,20,22" on cpu), SHEEP_BENCH_LOG_N (single size override),
+SHEEP_BENCH_PATHS (csv subset of "hybrid,device,host", default all three;
+window-constrained sweeps drop "device", whose one-compile-per-slice-shape
+cost can eat a tunneled per-size budget),
 SHEEP_BENCH_EDGE_FACTOR (default 8), SHEEP_BENCH_REPS (default 3),
 SHEEP_BENCH_TIMEOUT (seconds per size, default 1500 — tunneled-backend
 compiles run 30-130s per program and each size is a fresh process, so a
@@ -62,6 +65,27 @@ def _probe_hardware(timeout_s: int = 180) -> str | None:
         return None
     lines = proc.stdout.strip().splitlines()
     return lines[-1] if lines else None
+
+
+def _wanted_paths() -> list[str]:
+    """Validated SHEEP_BENCH_PATHS (csv subset of hybrid,device,host).
+
+    The pure-device path compiles one program per power-of-two slice shape
+    — on a tunneled backend (30-130s per compile) that can eat a whole
+    per-size budget for a secondary number, so window-constrained sweeps
+    run without it.  Called in main() BEFORE any backend/probe work so a
+    config typo fails in under a second, not after a full sweep of
+    per-size children each paying backend init + data gen + upload.
+    """
+    wanted = [p.strip() for p in os.environ.get(
+        "SHEEP_BENCH_PATHS", "hybrid,device,host").split(",") if p.strip()]
+    known = {"hybrid", "device", "host"}
+    if set(wanted) - known or not set(wanted) & {"hybrid", "device"}:
+        print(f"bench: SHEEP_BENCH_PATHS={','.join(wanted)!r} must be a "
+              f"subset of {sorted(known)} and include hybrid or device",
+              file=sys.stderr)
+        sys.exit(2)
+    return wanted
 
 
 def _run_one(log_n: int) -> dict:
@@ -151,37 +175,12 @@ def _run_one(log_n: int) -> dict:
     rec = {"log_n": log_n, "edges": e, "platform": platform,
            "h2d_s": round(h2d_s, 4)}
 
-    # transparency: the pure host-native path (graph2tree's serial build),
-    # recorded but never the headline — the headline must exercise the
-    # accelerator
-    from sheep_tpu.core.forest import build_forest, native_or_none
-    from sheep_tpu.core.sequence import degree_sequence
-    if native_or_none("auto") is not None:
-        def host_build():  # same scope as device/hybrid: sort + links + UF
-            seq_host = degree_sequence(tail, head)
-            build_forest(tail, head, seq_host, max_vid=n - 1)
-
-        host_build()  # warmup (page in edge arrays, build the .so)
-        host_times = []
-        for _ in range(reps):
-            t0 = time.perf_counter()
-            host_build()
-            host_times.append(time.perf_counter() - t0)
-        host_s = min(host_times)
-        rec["host_native"] = {"best_s": round(host_s, 4),
-                              "edges_per_sec": round(e / host_s, 1)}
+    wanted = _wanted_paths()
 
     # hybrid first: it is the faster path, so if the per-size timeout cuts
     # the slower pure-device measurement short, the partial record printed
     # below still carries the headline-capable number (the parent parses
     # the LAST stdout line).
-    # SHEEP_BENCH_PATHS restricts which accelerator paths are measured
-    # (csv of hybrid,device; default both).  The pure-device path compiles
-    # one program per power-of-two slice shape — on a tunneled backend
-    # (30-130s per compile) that can eat the whole per-size budget for a
-    # secondary number, so window-constrained sweeps run hybrid-only.
-    wanted = [p.strip() for p in os.environ.get(
-        "SHEEP_BENCH_PATHS", "hybrid,device").split(",") if p.strip()]
     for name, fn in (("hybrid", hybrid_build), ("device", device_build)):
         if name not in wanted:
             continue
@@ -202,7 +201,33 @@ def _run_one(log_n: int) -> dict:
         partial = dict(rec)
         _headline(partial)
         print(json.dumps(partial), flush=True)
+
+    # transparency: the pure host-native path (graph2tree's serial build),
+    # recorded but never the headline — the headline must exercise the
+    # accelerator.  Measured AFTER the accelerator paths so a slow host
+    # build can never consume the per-size budget before the headline
+    # number has streamed (the round-4 window-1 failure shape).
+    from sheep_tpu.core.forest import build_forest, native_or_none
+    from sheep_tpu.core.sequence import degree_sequence
+    if "host" in wanted and native_or_none("auto") is not None:
+        def host_build():  # same scope as device/hybrid: sort + links + UF
+            seq_host = degree_sequence(tail, head)
+            build_forest(tail, head, seq_host, max_vid=n - 1)
+
+        host_build()  # warmup (page in edge arrays, build the .so)
+        host_times = []
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            host_build()
+            host_times.append(time.perf_counter() - t0)
+        host_s = min(host_times)
+        rec["host_native"] = {"best_s": round(host_s, 4),
+                              "edges_per_sec": round(e / host_s, 1)}
+
     _headline(rec)
+    # final stream line: the record including host_native (the parent and
+    # the watcher salvage parse the LAST stdout line)
+    print(json.dumps(rec), flush=True)
     return rec
 
 
@@ -219,6 +244,7 @@ def _headline(rec: dict) -> None:
 
 
 def main() -> None:
+    _wanted_paths()  # fail fast on a config typo, before any backend work
     if len(sys.argv) > 2 and sys.argv[1] == "--one":
         # the per-path stream inside _run_one already printed the final
         # record; printing it again would just duplicate the line

@@ -189,13 +189,23 @@ def build_queue() -> list[Step]:
     # bench must fall back with the _cpu_fallback tag rather than run
     # natively on CPU untagged — an untagged CPU record would satisfy
     # done() forever and the real benchmark would never be taken.
-    bench_env: dict = {}
+    # No device path in the record sweep: the first r04 window died at
+    # 2^16 because the pure-device path's per-slice compiles outlived the
+    # per-size budget AFTER the hybrid (headline) number was already in.
+    # The sweep measures the flagship hybrid plus the host-transparency
+    # number (bench runs host AFTER the headline streams, so it can't
+    # cost the record); the pure-device path gets its own late-queue step.
+    bench_env: dict = {"SHEEP_BENCH_PATHS": "hybrid,host",
+                       "SHEEP_BENCH_TIMEOUT": "2400"}
     q = [
         # 1. the benchmark of record FIRST — windows have closed mid-queue
         # three times; the gating artifact gets the freshest minutes, and
-        # a timeout still salvages bench_progress.json per-size records
+        # a timeout still salvages bench_progress.json per-size records.
+        # Step timeout covers the worst case: 5 sizes x (300s startup +
+        # 2400s budget) = 13500s, so a slow-but-passing sweep is never
+        # killed before its final record prints.
         Step("bench_sweep", [PY, "bench.py"],
-             f"TPU_BENCH_{ROUND}.json", 8000, env=bench_env,
+             f"TPU_BENCH_{ROUND}.json", 14000, env=bench_env,
              sidecar="bench_progress.json"),
         # 2. window characterization (transfer rates, dispatch floor)
         Step("tunnel_probe", [PY, "scripts/tunnel_probe.py"],
@@ -230,6 +240,19 @@ def build_queue() -> list[Step]:
         Step("diag_scatter_22", [PY, "scripts/tpu_diag.py", "scatter_min",
                                  "22"],
              f"TPU_DIAG22_{ROUND}.jsonl", 1500, append=True),
+        # 6. pure-device path (depth-escalation evidence) — measured last
+        # and alone so its per-slice compiles can't cost the record sweep.
+        # Step timeout covers probe (180s) + startup (300s) + per-size
+        # budget (2400s) + a CPU-fallback rerun of the chunked fixpoint at
+        # 2^20 on the 1-core host (~25s/build x4 plus init, generously
+        # 1500s); the shared sidecar (mtime-gated in Step.run) salvages
+        # bench's per-size checkpoint if the step is killed anyway.
+        Step("devbench_20", [PY, "bench.py"],
+             f"TPU_DEVBENCH_{ROUND}.json", 4500,
+             env={"SHEEP_BENCH_PATHS": "device",
+                  "SHEEP_BENCH_SIZES": "20",
+                  "SHEEP_BENCH_TIMEOUT": "2400"},
+             sidecar="bench_progress.json"),
     ]
     return q
 

@@ -37,19 +37,24 @@ def build_step(tail: jnp.ndarray, head: jnp.ndarray, n: int):
     return seq, pos, m, parent, pst, rounds
 
 
-@functools.partial(jax.jit, static_argnames=("n",))
-def prepare_links(tail: jnp.ndarray, head: jnp.ndarray, n: int):
+@functools.partial(jax.jit, static_argnames=("n", "with_pst"))
+def prepare_links(tail: jnp.ndarray, head: jnp.ndarray, n: int,
+                  with_pst: bool = True):
     """Phases before the fixpoint, in one dispatch: degree histogram,
     (degree, vid) sort, edge->link mapping, pst segment-sum.
 
     Returns (seq, pos, num_active, lo, hi, pst) — pst is computed here
     because the fixpoint rewrites lo in place and pst must count the
-    *original* links (jtree.cpp:47-49).
+    *original* links (jtree.cpp:47-49).  ``with_pst=False`` drops that
+    full-E scatter pass (pst is None) for callers that recompute pst on
+    the host from their own edge copy (build_graph_hybrid's prefetch) —
+    on a backend where every op is priced per element, one pass of E is
+    ~1/6 of the whole prep program.
     """
     deg = degree_histogram(tail, head, n)
     seq, pos, m = degree_order(deg)
     lo, hi = edge_links(tail, head, pos, n)
-    pst = pst_weights(lo, n)
+    pst = pst_weights(lo, n) if with_pst else None
     return seq, pos, m, lo, hi, pst
 
 
@@ -176,18 +181,38 @@ def build_graph_hybrid(tail: np.ndarray, head: np.ndarray,
         # recompute would compete with the reduce loop for the same cores
         host_edges = (tail, head)
     given_seq = None
+    _lazy_pst = None
     if seq is not None:
         # `-s` fast path: no histogram, no device sort — links map through
         # the given position table (absent-vid contract lives in
         # ops.sort.given_seq_links, shared with the mesh builders)
         from .sort import given_seq_links
         given_seq = np.asarray(seq, dtype=np.uint32)
-        lo, hi, pst = given_seq_links(tail, head, given_seq, n)
+        lo, hi, pst = given_seq_links(tail, head, given_seq, n,
+                                      with_pst=host_edges is None)
         m = len(given_seq)
         dev_seq = None
+        if pst is None:
+            # pst counts the pre-dead-mask lo, so it can't be recovered
+            # from the masked arrays — the rare prefetch-failure fallback
+            # just reruns the mapping with the scatter included
+            def _lazy_pst():
+                return given_seq_links(tail, head, given_seq, n)[2]
     else:
+        # with a host edge copy the prefetch thread recomputes pst
+        # host-side — skip the device's full-E pst scatter; keep the
+        # original lo handle so the rare prefetch-failure fallback can
+        # still materialize pst on device afterwards
         dev_seq, _, m, lo, hi, pst = prepare_links(
-            jnp.asarray(tail), jnp.asarray(head), n)
+            jnp.asarray(tail), jnp.asarray(head), n,
+            with_pst=host_edges is None)
+        if pst is None:
+            orig_lo = lo
+
+            def _lazy_pst():
+                # module-level pst_weights, eager: one scatter op through
+                # jax's global op cache, no throwaway per-closure jit
+                return pst_weights(orig_lo, n)
     # every downstream consumer (prefetch fallback, _finish) reads `seq`:
     # the given host order when supplied, else the device-computed one
     seq = given_seq if given_seq is not None else dev_seq
@@ -219,16 +244,24 @@ def build_graph_hybrid(tail: np.ndarray, head: np.ndarray,
     pre.start()
     lo, hi, live, rounds, converged = reduce_links_hosted(
         lo, hi, n, stop_live=handoff_factor * n)
+    def _pst_resolved():
+        # host-prefetched pst when the thread landed it; else the device
+        # pst — materialized lazily when prepare_links skipped the scatter
+        # (prefetch failure is the only path that reaches the lazy case)
+        if "pst" in fetched:
+            return fetched["pst"]
+        return pst if pst is not None else _lazy_pst()
+
     if converged:
         pre.join()
         parent = parent_from_links(lo, hi, n)
         return _finish(fetched.get("seq", seq), fetched.get("m", m), parent,
-                       fetched.get("pst", pst))
+                       _pst_resolved())
     def _pst_after_fetch():
         # joined only after the big link fetch inside handoff_finish_native
         # has completed, so the seq/pst prefetch keeps overlapping it
         pre.join()
-        return np.asarray(fetched.get("pst", pst)).astype(np.uint32)
+        return np.asarray(_pst_resolved()).astype(np.uint32)
 
     parent_h, pst_out = handoff_finish_native(lo, hi, live, n,
                                               _pst_after_fetch)

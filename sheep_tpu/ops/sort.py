@@ -65,7 +65,7 @@ def edge_links(tail: jnp.ndarray, head: jnp.ndarray, pos: jnp.ndarray, n: int):
     return jnp.where(dead, sent, lo), jnp.where(dead, sent, hi)
 
 
-def given_seq_links(tail, head, seq, n: int):
+def given_seq_links(tail, head, seq, n: int, with_pst: bool = True):
     """Links + pst for an externally-given (possibly subset) sequence —
     THE one encoding of the absent-vid contract (jtree.cpp:47-49): an
     edge whose earlier endpoint is present counts toward pst even when
@@ -75,6 +75,12 @@ def given_seq_links(tail, head, seq, n: int):
     Returns (lo, hi, pst) device arrays, lo/hi sentinel-masked for the
     fixpoint.  Shared by the hybrid's `-s` fast path and the mesh-of-one
     builder so the contract lives in exactly one place.
+
+    ``with_pst=False`` skips the full-E pst scatter (pst is None) for
+    callers that recompute pst host-side from their own edge copy; note
+    pst counts the PRE-dead-mask lo (present lo, absent hi still counts),
+    so it cannot be recovered from the returned masked arrays — rerun
+    with with_pst=True if it turns out to be needed after all.
     """
     from ..core.sequence import sequence_positions
     from .forest import pst_weights
@@ -83,7 +89,8 @@ def given_seq_links(tail, head, seq, n: int):
     pos_np = np.where((pos_np < 0) | (pos_np >= n), n, pos_np)
     pos_d = jnp.asarray(pos_np, jnp.int32)
     lo, hi = edge_links(jnp.asarray(tail), jnp.asarray(head), pos_d, n)
-    pst = pst_weights(jnp.where(lo == hi, jnp.int32(n), lo), n)
+    pst = pst_weights(jnp.where(lo == hi, jnp.int32(n), lo), n) \
+        if with_pst else None
     dead = hi >= jnp.int32(n)
     sent = jnp.int32(n)
     return jnp.where(dead, sent, lo), jnp.where(dead, sent, hi), pst

@@ -254,6 +254,37 @@ def test_build_graph_hybrid_given_seq(with_host_edges, handoff):
     np.testing.assert_array_equal(forest.pst_weight, want.pst_weight)
 
 
+@pytest.mark.parametrize("given", [False, True])
+@pytest.mark.parametrize("handoff", [2, 1000])
+def test_build_graph_hybrid_prefetch_failure_lazy_pst(monkeypatch, handoff,
+                                                      given):
+    # with host_edges the device skips its pst scatter (with_pst=False) in
+    # both the degree-sort and given-seq branches; if the host prefetch
+    # then dies, the fallback must materialize pst lazily on device and
+    # still be bit-identical to the oracle
+    import sheep_tpu.ops.build as build_mod
+
+    def boom(*a, **k):
+        raise RuntimeError("prefetch failure injected by test")
+
+    monkeypatch.setattr(build_mod, "_host_seq_pst", boom)
+    rng = np.random.default_rng(962)
+    tail, head = random_multigraph(rng, 200, 1200)
+    full = degree_sequence(tail, head)
+    # given-seq uses a SUBSET order so the absent-vid pst contract is in
+    # play on the lazy path too
+    seq_in = full[: max(2, len(full) * 2 // 3)] if given else None
+    want_seq = seq_in if given else full
+    want = build_forest(tail, head, want_seq,
+                        max_vid=int(max(tail.max(), head.max())))
+    seq, forest = build_mod.build_graph_hybrid(
+        tail, head, handoff_factor=handoff, host_edges=(tail, head),
+        seq=seq_in)
+    np.testing.assert_array_equal(seq, want_seq)
+    np.testing.assert_array_equal(forest.parent, want.parent)
+    np.testing.assert_array_equal(forest.pst_weight, want.pst_weight)
+
+
 def test_build_graph_hybrid_device_inputs_no_host_copy():
     # device-array inputs without host_edges exercise the d2h prefetch
     # branch (numpy inputs auto-use the host recompute path)

@@ -91,17 +91,30 @@ class Step:
 
     def __init__(self, name: str, cmd: list[str], out: str, timeout: int,
                  env: dict | None = None, append: bool = False,
-                 sidecar: str | None = None):
+                 sidecar: str | None = None, done_check=None):
         self.name, self.cmd, self.out = name, cmd, out
         self.timeout, self.env, self.append = timeout, env or {}, append
         #: progress file the COMMAND ITSELF checkpoints during the run;
         #: salvaged on timeout.  Only set for steps that own one — a
         #: generic salvage could adopt a concurrent manual run's data.
         self.sidecar = sidecar
+        #: extra predicate(record) a record must ALSO satisfy to count as
+        #: done — e.g. the bench sweep must actually reach its large
+        #: sizes, not just be accelerator-tagged (a window that dies
+        #: after 2^16/2^18 leaves an accel record that would otherwise
+        #: retire the step with the sizes that matter never measured)
+        self.done_check = done_check
 
     @property
     def out_path(self) -> str:
         return os.path.join(REPO, self.out)
+
+    def _satisfies(self, rec: dict | None) -> bool:
+        """One predicate for done() AND _save(): accelerator-tagged and
+        passing the step's extra done_check — run() must never report ok
+        for a record the next done() poll would reject."""
+        return _on_accel(rec) and \
+            (self.done_check is None or self.done_check(rec))
 
     def done(self) -> bool:
         """Done when the artifact holds an accelerator-tagged record
@@ -114,10 +127,11 @@ class Step:
         if self.append:
             for line in text.splitlines():
                 rec = _last_json(line)
-                if rec and rec.get("_step") == self.name and _on_accel(rec):
+                if rec and rec.get("_step") == self.name \
+                        and self._satisfies(rec):
                     return True
             return False
-        return _on_accel(_last_json(text))
+        return self._satisfies(_last_json(text))
 
     def run(self) -> bool:
         env = dict(os.environ)
@@ -177,9 +191,9 @@ class Step:
         mode = "a" if self.append else "w"
         with open(self.out_path, mode) as f:
             f.write(line + "\n")
-        ok = _on_accel(rec)
+        ok = self._satisfies(rec)
         commit([self.out], f"tpu window: {self.name} "
-                           f"({'accel' if ok else 'cpu/partial'})")
+                           f"({'accel' if ok else 'cpu/partial/incomplete'})")
         return ok
 
 
@@ -206,7 +220,13 @@ def build_queue() -> list[Step]:
         # killed before its final record prints.
         Step("bench_sweep", [PY, "bench.py"],
              f"TPU_BENCH_{ROUND}.json", 14000, env=bench_env,
-             sidecar="bench_progress.json"),
+             sidecar="bench_progress.json",
+             # an accel-tagged record only retires the step once the
+             # sweep reaches the sizes the round is gated on (>= 2^22);
+             # earlier sizes rerun cheaply from the persistent compile
+             # cache when a window dies mid-sweep
+             done_check=lambda rec: any(
+                 s.get("log_n", 0) >= 22 for s in rec.get("sweep", []))),
         # 2. window characterization (transfer rates, dispatch floor)
         Step("tunnel_probe", [PY, "scripts/tunnel_probe.py"],
              f"TPU_TUNNEL_{ROUND}.json", 900),

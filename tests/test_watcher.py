@@ -56,6 +56,24 @@ def test_step_done_semantics(watcher, tmp_path, monkeypatch):
     assert a.done() and not b.done()
 
 
+def test_bench_sweep_done_requires_large_sizes(watcher, tmp_path,
+                                               monkeypatch):
+    # a window that dies after the small sizes leaves an accel-tagged
+    # record; it must NOT retire the record sweep until >= 2^22 is in
+    monkeypatch.setattr(watcher, "REPO", str(tmp_path))
+    step = next(s for s in watcher.build_queue() if s.name == "bench_sweep")
+    small = {"metric": "device_build_edges_per_sec_rmat_n2^18_e8x",
+             "value": 1.0, "_step": "bench_sweep",
+             "sweep": [{"log_n": 16}, {"log_n": 18}]}
+    with open(step.out_path, "w") as f:
+        json.dump(small, f)
+    assert not step.done()
+    full = dict(small, sweep=small["sweep"] + [{"log_n": 22}])
+    with open(step.out_path, "w") as f:
+        json.dump(full, f)
+    assert step.done()
+
+
 def test_queue_is_consistent(watcher):
     q = watcher.build_queue()
     names = [s.name for s in q]

@@ -67,6 +67,89 @@ def _probe_hardware(timeout_s: int = 180) -> str | None:
     return lines[-1] if lines else None
 
 
+def last_record(stdout) -> dict | None:
+    """Newest parseable JSON line — children stream partial records
+    after each measured path, so a timeout/crash mid-size still
+    yields whatever completed."""
+    if isinstance(stdout, bytes):
+        stdout = stdout.decode(errors="replace")
+    for line in reversed((stdout or "").strip().splitlines()):
+        try:
+            rec = json.loads(line)
+        except ValueError:
+            continue
+        if isinstance(rec, dict) and "edges_per_sec" in rec:
+            return rec
+    return None
+
+
+def run_sweep(sizes, run_child, timeout_s: int, startup_s: int,
+              checkpoint=lambda sweep: None):
+    """Walk the sizes through ``run_child`` and collect per-size records.
+
+    ``run_child(log_n) -> (stdout, stderr, returncode, fault_kind|None)``
+    is injected (subprocess in production, fakes in tests — this loop
+    runs unattended inside the watcher's one hardware window per round,
+    so its fault semantics are unit-tested).  Returns (sweep,
+    first_fault); the sweep ends at the first fault EXCEPT a timeout
+    whose child already streamed a headline record — that size is kept
+    (marked partial) and the sweep continues (round-4 lesson: the first
+    TPU window's whole sweep died at 2^16 because the pure-device path's
+    per-slice compiles outlived the budget after the hybrid number was
+    already in).  ``checkpoint(sweep)`` is called after every appended
+    record so a killed parent still leaves the sizes that finished.
+    """
+    sweep: list[dict] = []
+    first_fault: dict | None = None
+    for log_n in sizes:
+        rec = None
+        stdout, stderr, rc_child, fault_kind = run_child(log_n)
+        if fault_kind is not None:
+            if stderr:
+                sys.stderr.write(stderr)
+            budget = startup_s if fault_kind == "backend_hang" \
+                else timeout_s
+            print(f"bench: n=2^{log_n} {fault_kind.upper()} "
+                  f"after {budget}s", file=sys.stderr)
+            rec = last_record(stdout)
+            if fault_kind == "timeout" and rec is not None:
+                rec["partial"] = True
+                sweep.append(rec)
+                checkpoint(sweep)
+                print(f"bench: n=2^{log_n} -> "
+                      f"{rec['edges_per_sec']:.0f} edges/s "
+                      f"(headline path done; secondary cut)",
+                      file=sys.stderr)
+                continue
+            first_fault = {"log_n": log_n, "error": fault_kind}
+        else:
+            sys.stderr.write(stderr)
+            rec = last_record(stdout)
+            if rc_child != 0:
+                err = (stderr or "").strip().splitlines()
+                first_fault = {"log_n": log_n,
+                               "error": err[-1][:300] if err else "crash"}
+                print(f"bench: n=2^{log_n} FAULT rc={rc_child}",
+                      file=sys.stderr)
+            elif rec is None:
+                first_fault = {"log_n": log_n,
+                               "error": "unparseable child output"}
+                print(f"bench: n=2^{log_n} produced no record",
+                      file=sys.stderr)
+        if rec is not None:
+            if first_fault is not None:
+                rec["partial"] = True  # some paths of this size were lost
+            sweep.append(rec)
+            print(f"bench: n=2^{log_n} -> "
+                  f"{rec['edges_per_sec']:.0f} edges/s "
+                  f"({rec['rounds']} rounds, best {rec['best_s']}s)",
+                  file=sys.stderr)
+            checkpoint(sweep)
+        if first_fault is not None:
+            break
+    return sweep, first_fault
+
+
 def _wanted_paths() -> list[str]:
     """Validated SHEEP_BENCH_PATHS (csv subset of hybrid,device,host).
 
@@ -298,21 +381,6 @@ def main() -> None:
     except OSError:
         pass
 
-    def last_record(stdout) -> dict | None:
-        """Newest parseable JSON line — children stream partial records
-        after each measured path, so a timeout/crash mid-size still
-        yields whatever completed."""
-        if isinstance(stdout, bytes):
-            stdout = stdout.decode(errors="replace")
-        for line in reversed((stdout or "").strip().splitlines()):
-            try:
-                rec = json.loads(line)
-            except ValueError:
-                continue
-            if isinstance(rec, dict) and "edges_per_sec" in rec:
-                return rec
-        return None
-
     progress_path = os.path.join(
         os.path.dirname(os.path.abspath(__file__)), "bench_progress.json")
     try:
@@ -393,66 +461,9 @@ def main() -> None:
         except OSError:
             pass
 
-    def run_sweep(sizes) -> tuple[list[dict], dict | None]:
-        sweep: list[dict] = []
-        first_fault: dict | None = None
-        for log_n in sizes:
-            rec = None
-            stdout, stderr, rc_child, fault_kind = run_child(log_n)
-            if fault_kind is not None:
-                if stderr:
-                    sys.stderr.write(stderr)
-                budget = startup_s if fault_kind == "backend_hang" \
-                    else timeout_s
-                print(f"bench: n=2^{log_n} {fault_kind.upper()} "
-                      f"after {budget}s", file=sys.stderr)
-                rec = last_record(stdout)
-                if fault_kind == "timeout" and rec is not None:
-                    # The headline path finished and streamed its record;
-                    # only a slower secondary path was cut.  That is lost
-                    # coverage for THIS size, not evidence larger sizes
-                    # fault — keep sweeping (round-4 lesson: the first
-                    # TPU window's whole sweep died at 2^16 because the
-                    # pure-device path's per-slice compiles outlived the
-                    # budget after the hybrid number was already in).
-                    rec["partial"] = True
-                    sweep.append(rec)
-                    _checkpoint(sweep)
-                    print(f"bench: n=2^{log_n} -> "
-                          f"{rec['edges_per_sec']:.0f} edges/s "
-                          f"(headline path done; secondary cut)",
-                          file=sys.stderr)
-                    continue
-                first_fault = {"log_n": log_n, "error": fault_kind}
-            else:
-                sys.stderr.write(stderr)
-                rec = last_record(stdout)
-                if rc_child != 0:
-                    err = (stderr or "").strip().splitlines()
-                    first_fault = {"log_n": log_n,
-                                   "error": err[-1][:300] if err else "crash"}
-                    print(f"bench: n=2^{log_n} FAULT rc={rc_child}",
-                          file=sys.stderr)
-                elif rec is None:
-                    first_fault = {"log_n": log_n,
-                                   "error": "unparseable child output"}
-                    print(f"bench: n=2^{log_n} produced no record",
-                          file=sys.stderr)
-            if rec is not None:
-                if first_fault is not None:
-                    rec["partial"] = True  # some paths of this size were lost
-                sweep.append(rec)
-                print(f"bench: n=2^{log_n} -> "
-                      f"{rec['edges_per_sec']:.0f} edges/s "
-                      f"({rec['rounds']} rounds, best {rec['best_s']}s)",
-                      file=sys.stderr)
-                _checkpoint(sweep)
-            if first_fault is not None:
-                break
-        return sweep, first_fault
-
     accel_fault: dict | None = None
-    sweep, first_fault = run_sweep(sizes)
+    sweep, first_fault = run_sweep(sizes, run_child, timeout_s, startup_s,
+                                   _checkpoint)
     if not sweep and on_accel:
         # The probe can pass and the tunnel still degrade minutes later
         # (observed: backend init OK, first compile hangs).  An empty
@@ -466,7 +477,8 @@ def main() -> None:
         if not os.environ.get("SHEEP_BENCH_LOG_N") \
                 and not os.environ.get("SHEEP_BENCH_SIZES"):
             sizes = [s for s in sizes if s <= 22]
-        sweep, first_fault = run_sweep(sizes)
+        sweep, first_fault = run_sweep(sizes, run_child, timeout_s,
+                                       startup_s, _checkpoint)
 
     tag = "_cpu_fallback" if fell_back else ""
     if not sweep:

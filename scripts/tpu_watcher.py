@@ -209,8 +209,14 @@ def build_queue() -> list[Step]:
     # The sweep measures the flagship hybrid plus the host-transparency
     # number (bench runs host AFTER the headline streams, so it can't
     # cost the record); the pure-device path gets its own late-queue step.
+    # sizes pinned explicitly: the done_check below gates on >= 2^22, so
+    # the sizes the child sweeps and the done predicate must never
+    # diverge (an inherited SHEEP_BENCH_SIZES quick-test leftover would
+    # otherwise make the gate unsatisfiable and the step retry forever)
     bench_env: dict = {"SHEEP_BENCH_PATHS": "hybrid,host",
-                       "SHEEP_BENCH_TIMEOUT": "2400"}
+                       "SHEEP_BENCH_TIMEOUT": "2400",
+                       "SHEEP_BENCH_SIZES": "16,18,20,22,23",
+                       "SHEEP_BENCH_LOG_N": ""}
     q = [
         # 1. the benchmark of record FIRST — windows have closed mid-queue
         # three times; the gating artifact gets the freshest minutes, and

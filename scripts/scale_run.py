@@ -16,7 +16,10 @@ scale (>= 100M records; smaller validation runs only print).
 
 Usage: python scripts/scale_run.py [log_n] [edge_factor] [parts]
 Defaults: 2^23 vertices x 16 = 134M records, 8 parts.
-Env: SHEEP_SCALE_SKIP_ORACLE=1 skips step 4's full-graph rebuild.
+Env: SHEEP_SCALE_SKIP_ORACLE=1 skips step 4's full-graph rebuild;
+SHEEP_SCALE_BLOCK overrides the 16M-record streamed block size (lets a
+window-budgeted on-chip run exercise MANY carry folds + a partial final
+block without a multi-GB tunnel transfer).
 """
 
 from __future__ import annotations
@@ -30,7 +33,8 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import numpy as np
 
-_BLOCK = 1 << 24  # 16M records per streamed block
+#: records per streamed block (default 16M; SHEEP_SCALE_BLOCK overrides)
+_BLOCK = int(os.environ.get("SHEEP_SCALE_BLOCK", str(1 << 24)))
 
 
 def _stream_impl() -> str | None:

@@ -279,6 +279,24 @@ def build_queue() -> list[Step]:
                   "SHEEP_BENCH_SIZES": "20",
                   "SHEEP_BENCH_TIMEOUT": "2400"},
              sidecar="bench_progress.json"),
+        # 7. streamed (OOM) build ON the chip, oracle-validated: 2^18 x 17
+        # = 4.46M records over 1M-record blocks = 4 full blocks + a
+        # partial fifth, so the carry fold, repeated between-block
+        # compaction, AND the short-final-block path all run on real
+        # hardware — with only ~35MB of tunnel transfer.  Budget: 300s
+        # startup + ~10 min upload at the slowest observed tunnel rate +
+        # a handful of 30-130s compiles + oracle seconds, well under
+        # 2700s (no sidecar: scale_run prints one final JSON, and at
+        # this size a restart from zero is cheap).  Oracle comparison is
+        # pinned ON and gates done() — an unvalidated record must never
+        # retire the step.  Below the 100M artifact bar, so it can't
+        # clobber the committed CPU SCALE_r04.json.
+        Step("scale_stream_18", [PY, "scripts/scale_run.py", "18", "17"],
+             f"TPU_SCALE_{ROUND}.json", 2700,
+             env={"SHEEP_SCALE_STREAM": "device",
+                  "SHEEP_SCALE_BLOCK": str(1 << 20),
+                  "SHEEP_SCALE_SKIP_ORACLE": ""},
+             done_check=lambda rec: rec.get("oracle_equal") is True),
     ]
     return q
 

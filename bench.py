@@ -22,9 +22,10 @@ hanging the first compile), the whole sweep reruns on CPU with the
 
 Env: SHEEP_BENCH_SIZES (csv of log2 sizes; default "16,18,20,22,23" on
 accelerators, "16,18,20,22" on cpu), SHEEP_BENCH_LOG_N (single size override),
-SHEEP_BENCH_PATHS (csv subset of "hybrid,device,host", default all three;
-window-constrained sweeps drop "device", whose one-compile-per-slice-shape
-cost can eat a tunneled per-size budget),
+SHEEP_BENCH_PATHS (csv subset of "hybrid,device,host"; default is all
+three on cpu but hybrid+host on accelerators — the pure-device path's
+one-compile-per-slice-shape cost can eat a tunneled per-size budget, so
+it is measured by its own watcher step instead),
 SHEEP_BENCH_EDGE_FACTOR (default 8), SHEEP_BENCH_REPS (default 3),
 SHEEP_BENCH_TIMEOUT (seconds per size, default 1500 — tunneled-backend
 compiles run 30-130s per program and each size is a fresh process, so a
@@ -150,18 +151,26 @@ def run_sweep(sizes, run_child, timeout_s: int, startup_s: int,
     return sweep, first_fault
 
 
-def _wanted_paths() -> list[str]:
+def _wanted_paths(platform: str | None = None) -> list[str] | None:
     """Validated SHEEP_BENCH_PATHS (csv subset of hybrid,device,host).
 
-    The pure-device path compiles one program per power-of-two slice shape
-    — on a tunneled backend (30-130s per compile) that can eat a whole
-    per-size budget for a secondary number, so window-constrained sweeps
-    run without it.  Called in main() BEFORE any backend/probe work so a
-    config typo fails in under a second, not after a full sweep of
-    per-size children each paying backend init + data gen + upload.
+    Unset defaults by platform: everything on cpu (where the secondary
+    paths are cheap), hybrid+host on accelerators — the pure-device path
+    compiles one program per power-of-two slice shape, which on a
+    tunneled backend (30-130s per compile) can eat a whole per-size
+    budget for a secondary number (it gets its own watcher step
+    instead).  Called in main() with platform=None BEFORE any backend
+    work so an explicit-value typo fails in under a second, not after a
+    full sweep of per-size children each paying backend init + data gen
+    + upload; returns None there when the choice is platform-deferred.
     """
-    wanted = [p.strip() for p in os.environ.get(
-        "SHEEP_BENCH_PATHS", "hybrid,device,host").split(",") if p.strip()]
+    raw = os.environ.get("SHEEP_BENCH_PATHS", "")
+    if not raw.strip():
+        if platform is None:
+            return None  # resolved per child once the platform is known
+        return ["hybrid", "device", "host"] if platform == "cpu" \
+            else ["hybrid", "host"]
+    wanted = [p.strip() for p in raw.split(",") if p.strip()]
     known = {"hybrid", "device", "host"}
     if set(wanted) - known or not set(wanted) & {"hybrid", "device"}:
         print(f"bench: SHEEP_BENCH_PATHS={','.join(wanted)!r} must be a "
@@ -258,7 +267,7 @@ def _run_one(log_n: int) -> dict:
     rec = {"log_n": log_n, "edges": e, "platform": platform,
            "h2d_s": round(h2d_s, 4)}
 
-    wanted = _wanted_paths()
+    wanted = _wanted_paths(platform)
 
     # hybrid first: it is the faster path, so if the per-size timeout cuts
     # the slower pure-device measurement short, the partial record printed

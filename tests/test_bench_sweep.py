@@ -98,6 +98,22 @@ def test_checkpoint_called_per_record(bench):
     assert seen == [1, 2]
 
 
+def test_wanted_paths_defaults_and_validation(bench, monkeypatch):
+    monkeypatch.delenv("SHEEP_BENCH_PATHS", raising=False)
+    assert bench._wanted_paths() is None  # deferred until platform known
+    assert bench._wanted_paths("cpu") == ["hybrid", "device", "host"]
+    assert bench._wanted_paths("tpu") == ["hybrid", "host"]
+    monkeypatch.setenv("SHEEP_BENCH_PATHS", "device")
+    assert bench._wanted_paths() == ["device"]
+    assert bench._wanted_paths("tpu") == ["device"]  # explicit wins
+    monkeypatch.setenv("SHEEP_BENCH_PATHS", "host")  # no headline path
+    with pytest.raises(SystemExit):
+        bench._wanted_paths()
+    monkeypatch.setenv("SHEEP_BENCH_PATHS", "Hybrid")  # case typo
+    with pytest.raises(SystemExit):
+        bench._wanted_paths("cpu")
+
+
 def test_last_record_picks_newest_record_line(bench):
     out = "\n".join(["garbage", _rec(16, 1.0), "noise", _rec(16, 2.0),
                      json.dumps({"no_eps": True})])

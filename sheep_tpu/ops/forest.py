@@ -335,11 +335,71 @@ def _pad_pow2(x: int, lo_cap: int = 1 << 12) -> int:
     return p
 
 
+@functools.partial(jax.jit, static_argnames=("n", "nc"))
+def vremap_compact(lo: jnp.ndarray, hi: jnp.ndarray, n: int, nc: int):
+    """Relabel the vertices of the live links into a dense space [0, nc).
+
+    Why: one chunk round costs O(n * levels) in jump-table work (the
+    ``jnp.full(n + 1)`` fill plus ``levels - 1`` table squarings in
+    :func:`_lift_descend`) no matter how few links remain — measured
+    ~70ms/round at n=2^22 on the cpu backend with only 8k live links,
+    and the tunneled chip's per-op rate is ~10x worse.  Once compaction
+    has shrunk the link arrays, relabeling the surviving vertices into a
+    dense [0, nc) space makes every subsequent round O(links * levels).
+
+    Soundness: the map (ascending rank of the vertex among the distinct
+    live-link endpoints) is strictly monotone, so lo < hi ordering, the
+    min-up-neighbor function, and threshold connectivity over the
+    relabeled vertices are all preserved; the elimination forest is a
+    function of threshold connectivity only (module docstring).  Every
+    vertex that still needs a parent appears in some live link: rewrites
+    never drop a vertex's last link (a non-root vertex's min-up link
+    survives to the functional-forest fixpoint), so vertices absent from
+    the live links are already settled (roots/isolated) and back-map to
+    parent-less slots.
+
+    Requires nc >= number of distinct live endpoints (callers pass
+    nc = 2 * len(lo), a safe bound).  Returns (lo_c, hi_c, back) where
+    back is int32 [nc + 1]: compact id -> original position, back[nc]
+    (the compact sentinel) and unused slots hold n.
+    """
+    sent = jnp.int32(n)
+    csent = jnp.int32(nc)
+    lo = lo.astype(jnp.int32)
+    hi = hi.astype(jnp.int32)
+    verts = lax.sort(jnp.concatenate([lo, hi]))  # sentinels sort last
+    is_live = verts < sent
+    is_new = is_live & jnp.concatenate(
+        [jnp.ones((1,), jnp.bool_), verts[1:] != verts[:-1]])
+    # every occurrence of a vertex gets the same rank (cumsum counts the
+    # first occurrence only), so duplicate scatter writes agree
+    rank = jnp.cumsum(is_new.astype(jnp.int32)) - 1
+    fwd = jnp.full(n + 1, csent, jnp.int32).at[
+        jnp.where(is_live, verts, jnp.int32(n + 1))].set(rank, mode="drop")
+    back = jnp.full(nc + 1, sent, jnp.int32).at[
+        jnp.where(is_live, rank, jnp.int32(nc + 1))].set(verts, mode="drop")
+    return fwd[lo], fwd[hi], back
+
+
+@jax.jit
+def vremap_back(lo_c: jnp.ndarray, hi_c: jnp.ndarray, back: jnp.ndarray):
+    """Inverse of :func:`vremap_compact` on link arrays (compact sentinel
+    maps through back's last slot to the original n)."""
+    return back[lo_c], back[hi_c]
+
+
+def _vremap_enabled() -> bool:
+    import os
+    return os.environ.get("SHEEP_VREMAP", "1") != "0"
+
+
 #: per-chunk round counts — probe every round while live is collapsing
 #: (rounds 1-3 kill 85-93% of edges, and an early stop at the knee saves
 #: both compute and handoff transfer), then batch rounds once the arrays
 #: are compact so the ~70ms-per-chunk tunnel sync amortizes.  The fixed
-#: tuple also bounds the set of (shape, jrounds) programs XLA compiles.
+#: tuple bounds the (shape, jrounds) axes of what XLA compiles; the
+#: vertex remap adds an n_cur axis (one fresh fixpoint_chunk compile per
+#: remap, <= log4(n/4096) per run, amortized by the persistent cache).
 _CHUNK_SCHEDULE = (1, 1, 1, 2, 4)
 
 
@@ -385,7 +445,12 @@ def reduce_links_hosted(lo, hi, n: int, stop_live: int = 0,
     ``_CHUNK_SCHEDULE`` and repeat ``jrounds``; lifting depth escalates
     per :func:`_depth_tier` as the live set collapses (``levels`` is the
     mid-phase base: effective depth is levels+2 mid, levels+6 late,
-    capped at log2(n)).
+    capped at log2(n)).  Once the arrays have compacted far enough
+    (2 * cols <= n/4), the VERTEX space compacts too
+    (:func:`vremap_compact`, SHEEP_VREMAP=0 disables): later rounds'
+    O(n * levels) jump-table work becomes O(cols * levels), which on the
+    measured backends is the whole cost of the late phase.  The returned
+    links are always back in the original vertex space.
     """
     lo = jnp.asarray(lo, jnp.int32)
     hi = jnp.asarray(hi, jnp.int32)
@@ -399,7 +464,12 @@ def reduce_links_hosted(lo, hi, n: int, stop_live: int = 0,
         hi = jnp.concatenate([hi, fill])
     rounds = 0
     chunk_i = 0
-    cap = int(np.ceil(np.log2(n + 2)))
+    n_cur = n  # current vertex-space size (shrinks at each remap)
+    back = None  # compact id -> ORIGINAL position, composed across remaps
+    remap_on = _vremap_enabled()
+
+    def _restore(lo, hi):
+        return (lo, hi) if back is None else vremap_back(lo, hi, back)
     # Jump-only opener: on the full-size arrays the sort is the most
     # expensive op and round 1's sort retires almost nothing (~6%) — the
     # collisions this jump creates are what round 2's sort dedupes.  26%
@@ -412,20 +482,30 @@ def reduce_links_hosted(lo, hi, n: int, stop_live: int = 0,
     while True:
         j = _CHUNK_SCHEDULE[chunk_i] if chunk_i < len(_CHUNK_SCHEDULE) \
             else jrounds
+        cap = int(np.ceil(np.log2(n_cur + 2)))
         lv = _depth_tier(int(lo.shape[0]), pad,
                          chunk_i < len(_CHUNK_SCHEDULE),
                          levels, first_levels, cap)
-        lo, hi, stats = fixpoint_chunk(lo, hi, n, lv, j)
+        lo, hi, stats = fixpoint_chunk(lo, hi, n_cur, lv, j)
         rounds += j
         chunk_i += 1
         moved_i, live_i = (int(x) for x in np.asarray(stats))  # one sync
         if moved_i == 0:
+            lo, hi = _restore(lo, hi)
             return lo, hi, live_i, rounds, True
         if stop_live and live_i <= stop_live:
+            lo, hi = _restore(lo, hi)
             return lo, hi, live_i, rounds, False
         target = _pad_pow2(live_i)
         if target <= lo.shape[0] // 2:
             lo, hi = lo[:target], hi[:target]
+        cols = int(lo.shape[0])
+        if remap_on and 2 * cols <= n_cur // 4 and n_cur > (1 << 16):
+            # each remap shrinks table work >= 4x; the O(n_cur) forward
+            # table build amortizes over every remaining round
+            lo, hi, back_step = vremap_compact(lo, hi, n_cur, 2 * cols)
+            back = back_step if back is None else back[back_step]
+            n_cur = 2 * cols
     # unreachable
 
 

@@ -54,7 +54,8 @@ from jax import shard_map
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..ops.forest import (_CHUNK_SCHEDULE as _SCHEDULE, _depth_tier,
-                          _lift_descend, _rewrite_sorted, pst_weights)
+                          _lift_descend, _rewrite_sorted, pst_weights,
+                          sort_links)
 from ..ops.sort import degree_order
 from .mesh import AXIS, make_mesh
 
@@ -70,7 +71,7 @@ def _row_round(lo, hi, n: int, levels: int, f_combine):
     workers axis for global (reduce) rounds.  Returns (lo, hi, moved, live).
     """
     sent = jnp.int32(n)
-    lo, hi = lax.sort((lo, hi), num_keys=2)
+    lo, hi = sort_links(lo, hi)
     live = jnp.sum(lo != sent, dtype=jnp.int32)
     lo, hi, rewrites = _rewrite_sorted(lo, hi, n)
     # one-step min-up table, combined across the mesh BEFORE lifting so

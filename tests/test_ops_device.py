@@ -445,3 +445,32 @@ def test_hosted_fixpoint_vremap_sparse_matches_dense(seed, monkeypatch):
     monkeypatch.setenv("SHEEP_VREMAP", "0")
     p_off, _ = F.forest_fixpoint_hosted(jnp.asarray(lo), jnp.asarray(hi), n)
     np.testing.assert_array_equal(np.asarray(p_on), np.asarray(p_off))
+
+
+def test_sort_links_branches_agree(monkeypatch):
+    """The packed-int64 and 2-key variadic branches of sort_links must
+    produce identical lexicographic results (the packed branch is the cpu
+    default, the 2-key branch the accelerator default — tests force cpu,
+    so without this check the 2-key branch would be untested).  Eager
+    calls: the gate is read at trace time, so a jitted caller would keep
+    whichever branch it compiled first."""
+    from sheep_tpu.ops.forest import sort_links
+
+    rng = np.random.default_rng(77)
+    n = (1 << 22) + 3
+    lo = rng.integers(0, n, 5000).astype(np.int32)
+    hi = rng.integers(0, n, 5000).astype(np.int32)
+    dead = rng.random(5000) < 0.2
+    lo[dead] = n
+    hi[dead] = n
+    out = {}
+    for mode in ("0", "1"):
+        monkeypatch.setenv("SHEEP_SORT_PACK64", mode)
+        slo, shi = sort_links(jnp.asarray(lo), jnp.asarray(hi))
+        out[mode] = (np.asarray(slo), np.asarray(shi))
+        assert out[mode][0].dtype == np.int32
+    np.testing.assert_array_equal(out["0"][0], out["1"][0])
+    np.testing.assert_array_equal(out["0"][1], out["1"][1])
+    order = np.lexsort((hi, lo))
+    np.testing.assert_array_equal(out["1"][0], lo[order])
+    np.testing.assert_array_equal(out["1"][1], hi[order])

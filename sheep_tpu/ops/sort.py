@@ -44,7 +44,10 @@ def degree_order(deg: jnp.ndarray):
     n = deg.shape[0]
     vid = jnp.arange(n, dtype=jnp.int32)
     key = jnp.where(deg > 0, deg.astype(jnp.int32), _I32_MAX)
-    _, seq = lax.sort((key, vid), num_keys=2)
+    # packed-single-key (deg, vid) sort via the shared helper + gate
+    # (key <= INT32_MAX keeps the packed int64 positive)
+    from .forest import sort_links
+    _, seq = sort_links(key, vid)
     pos_all = jnp.zeros(n, jnp.int32).at[seq].set(vid)
     pos = jnp.where(deg > 0, pos_all, jnp.int32(n))
     return seq, pos, jnp.sum(deg > 0).astype(jnp.int32)

@@ -474,3 +474,24 @@ def test_sort_links_branches_agree(monkeypatch):
     order = np.lexsort((hi, lo))
     np.testing.assert_array_equal(out["1"][0], lo[order])
     np.testing.assert_array_equal(out["1"][1], hi[order])
+
+
+def test_degree_order_branches_agree(monkeypatch):
+    """degree_order's packed and 2-key branches must agree (same gate as
+    sort_links; tests run cpu = packed, accelerators get 2-key)."""
+    import jax
+
+    from sheep_tpu.ops.sort import degree_order
+
+    rng = np.random.default_rng(78)
+    deg = rng.integers(0, 50, 4096).astype(np.int32)
+    deg[rng.random(4096) < 0.3] = 0
+    out = {}
+    for mode in ("0", "1"):
+        monkeypatch.setenv("SHEEP_SORT_PACK64", mode)
+        jax.clear_caches()  # the gate is trace-time; drop the cached branch
+        seq, pos, m = degree_order(jnp.asarray(deg))
+        out[mode] = (np.asarray(seq), np.asarray(pos), int(m))
+    np.testing.assert_array_equal(out["0"][0], out["1"][0])
+    np.testing.assert_array_equal(out["0"][1], out["1"][1])
+    assert out["0"][2] == out["1"][2]

@@ -506,7 +506,7 @@ def main() -> None:
         "vs_baseline": top["vs_baseline"],
         "sweep": [{k: r[k] for k in
                    ("log_n", "edges_per_sec", "rounds", "best_s", "path",
-                    "h2d_s", "partial", "host_native")
+                    "h2d_s", "partial", "hybrid", "device", "host_native")
                    if k in r}
                   for r in sweep],
     }

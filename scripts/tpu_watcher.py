@@ -256,6 +256,12 @@ def build_queue() -> list[Step]:
         Step("ab_pack_off", [PY, "scripts/hybrid_profile.py", "20"],
              f"TPU_AB_{ROUND}.jsonl", 1800,
              env={"SHEEP_PACK_HANDOFF": "0"}, append=True),
+        # packed single-key link sort on the chip (cpu default, off on
+        # accelerators until this A/B: s64 is emulated in 32-bit lanes,
+        # so the 4.2x XLA:CPU win may invert on the TPU)
+        Step("ab_sort_pack64", [PY, "scripts/hybrid_profile.py", "20"],
+             f"TPU_AB_{ROUND}.jsonl", 1800,
+             env={"SHEEP_SORT_PACK64": "1"}, append=True),
         # 5. per-op ceiling proof at 2^22 (VERDICT item 2 fallback evidence)
         Step("diag_hist_22", [PY, "scripts/tpu_diag.py", "hist", "22"],
              f"TPU_DIAG22_{ROUND}.jsonl", 1500, append=True),

@@ -61,16 +61,16 @@ def main() -> None:
             record["live"] = int(live)
             record["converged"] = bool(converged)
         t0 = mark("reduce", t0)
-        # same 64K-granular cut as build_graph_hybrid (exact [:live] slices
-        # would compile a fresh XLA program per live value).  NOTE: the
-        # production path also overlaps the seq/pst fetch with the reduce
-        # loop via a prefetch thread — this breakdown serializes it, so
-        # d2h here is an upper bound on production's visible fetch time.
-        cut = min(int(lo.shape[0]), -(-int(live) // (1 << 16)) * (1 << 16))
-        lo_h = np.asarray(lo[:cut])[:live]
-        hi_h = np.asarray(hi[:cut])[:live]
-        keep = lo_h < n
-        lo_h, hi_h = lo_h[keep], hi_h[keep]
+        # THE production fetch policy (ops.build.fetch_links_host — shared
+        # so the ab_pack_off watcher A/B measures what the hybrid really
+        # ships).  NOTE: the production path also overlaps the seq/pst
+        # fetch with the reduce loop via a prefetch thread — this
+        # breakdown serializes it, so d2h here is an upper bound on
+        # production's visible fetch time.
+        from sheep_tpu.ops.build import fetch_links_host
+        lo_h, hi_h, packed = fetch_links_host(lo, hi, int(live), n)
+        if record is not None:
+            record["packed_handoff"] = packed
         pst_h = np.asarray(pst).astype(np.uint32)
         seq_h = np.asarray(seq)
         t0 = mark("d2h", t0)

@@ -290,6 +290,33 @@ def default_handoff_factor() -> int:
     return int(os.environ.get("SHEEP_HANDOFF_FACTOR", default))
 
 
+def fetch_links_host(lo, hi, live: int, n: int):
+    """THE production link-fetch policy, shared with scripts/hybrid_profile
+    so the profiler's d2h phase can never drift from what the hybrid
+    actually does: 64K-granular cut (each distinct slice length is a fresh
+    XLA program; tunneled compiles are slow), 6-byte packing where the
+    link is byte-bound (SHEEP_PACK_HANDOFF overrides; needs n < 2^24),
+    dead-sentinel filter.  Returns (lo_h, hi_h uint-safe int arrays,
+    packed: bool).
+    """
+    import os
+
+    cut = min(int(lo.shape[0]), -(-live // (1 << 16)) * (1 << 16))
+    pack = os.environ.get("SHEEP_PACK_HANDOFF", "")
+    if pack == "":  # default: pack where the fetch is byte-bound (tunnel)
+        pack = "0" if jax.devices()[0].platform == "cpu" else "1"
+    packed = pack == "1" and n < (1 << 24)
+    if packed:
+        from .forest import pack_links_6b, unpack_links_6b
+        buf = np.asarray(pack_links_6b(lo[:cut], hi[:cut]))[:live]
+        lo_h, hi_h = unpack_links_6b(buf)
+    else:
+        lo_h = np.asarray(lo[:cut])[:live]
+        hi_h = np.asarray(hi[:cut])[:live]
+    keep = lo_h < n  # a few scattered dead slots may remain in the prefix
+    return lo_h[keep], hi_h[keep], packed
+
+
 def handoff_finish_native(lo, hi, live: int, n: int, pst_h):
     """Fetch a reduced link set and finish with the exact sequential
     union-find (the hybrid tail): returns (parent, pst) uint32 [n].
@@ -307,19 +334,7 @@ def handoff_finish_native(lo, hi, live: int, n: int, pst_h):
 
     from ..core.forest import native_or_none
 
-    cut = min(int(lo.shape[0]), -(-live // (1 << 16)) * (1 << 16))
-    pack = os.environ.get("SHEEP_PACK_HANDOFF", "")
-    if pack == "":  # default: pack where the fetch is byte-bound (tunnel)
-        pack = "0" if jax.devices()[0].platform == "cpu" else "1"
-    if pack == "1" and n < (1 << 24):
-        from .forest import pack_links_6b, unpack_links_6b
-        buf = np.asarray(pack_links_6b(lo[:cut], hi[:cut]))[:live]
-        lo_h, hi_h = unpack_links_6b(buf)
-    else:
-        lo_h = np.asarray(lo[:cut])[:live]
-        hi_h = np.asarray(hi[:cut])[:live]
-    keep = lo_h < n  # a few scattered dead slots may remain in the prefix
-    lo_h, hi_h = lo_h[keep], hi_h[keep]
+    lo_h, hi_h, _ = fetch_links_host(lo, hi, live, n)
     if callable(pst_h):
         pst_h = pst_h()
     native = native_or_none("auto")

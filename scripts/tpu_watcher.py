@@ -310,10 +310,19 @@ def build_queue() -> list[Step]:
 def main() -> None:
     interval = int(os.environ.get("SHEEP_WATCH_INTERVAL", "450"))
     probe_timeout = int(os.environ.get("SHEEP_WATCH_PROBE_TIMEOUT", "150"))
+    # hard stop (hours from launch): the driver runs ITS end-of-round
+    # bench on the same tunnel — a watcher step firing then would
+    # contend with the benchmark of record on the chip
+    max_h = float(os.environ.get("SHEEP_WATCH_MAX_HOURS", "0") or 0)
+    deadline = time.time() + max_h * 3600 if max_h > 0 else None
     once = "--once" in sys.argv
     queue = build_queue()
-    log(f"armed: {len(queue)} steps, probing every {interval}s")
+    log(f"armed: {len(queue)} steps, probing every {interval}s"
+        + (f", deadline {max_h}h" if deadline else ""))
     while True:
+        if deadline is not None and time.time() > deadline:
+            log("deadline reached — disarming to leave the tunnel free")
+            return
         pending = [s for s in queue if not s.done()]
         if not pending:
             log("queue complete — all artifacts accelerator-tagged")
@@ -322,6 +331,12 @@ def main() -> None:
         if plat and plat != "cpu":
             log(f"window OPEN (platform={plat}); {len(pending)} steps pending")
             for step in pending:
+                # re-check between steps too: a window that opens just
+                # before the deadline must not keep firing 1500-4500s
+                # steps into the driver's end-of-round tunnel time
+                if deadline is not None and time.time() > deadline:
+                    log("deadline reached mid-queue — disarming")
+                    return
                 ok = step.run()
                 if not ok:
                     # re-probe before burning the next step's timeout on a

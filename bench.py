@@ -32,7 +32,9 @@ compiles run 30-130s per program and each size is a fresh process, so a
 persistent jax compilation cache is also enabled under /tmp),
 SHEEP_BENCH_STARTUP_TIMEOUT (seconds for a child to get past backend
 init, default 300; a child that hasn't printed its platform marker by
-then is recorded as ``backend_hang`` instead of eating the size timeout).
+then is recorded as ``backend_hang`` instead of eating the size timeout),
+SHEEP_BENCH_NO_FALLBACK (suppress the labeled CPU rerun after an empty
+accelerator sweep — for callers whose record is accelerator-or-nothing).
 """
 
 from __future__ import annotations
@@ -473,11 +475,16 @@ def main() -> None:
     accel_fault: dict | None = None
     sweep, first_fault = run_sweep(sizes, run_child, timeout_s, startup_s,
                                    _checkpoint)
-    if not sweep and on_accel:
+    if not sweep and on_accel \
+            and not os.environ.get("SHEEP_BENCH_NO_FALLBACK"):
         # The probe can pass and the tunnel still degrade minutes later
         # (observed: backend init OK, first compile hangs).  An empty
         # accelerator sweep must not publish value 0 — rerun on CPU,
         # clearly labeled, and carry the accelerator fault alongside.
+        # SHEEP_BENCH_NO_FALLBACK suppresses the rerun for callers whose
+        # record is accelerator-or-nothing (the watcher's 2^24 stretch
+        # step: a 134M-edge CPU build would burn the step budget for an
+        # unusable record).
         accel_fault = first_fault
         print("bench: accelerator sweep produced no records; "
               "falling back to CPU", file=sys.stderr)

@@ -14,7 +14,10 @@ resumes cleanly across windows and restarts.
 
 Usage: python scripts/tpu_watcher.py [--once]
 Env: SHEEP_WATCH_INTERVAL (probe cadence seconds, default 450),
-     SHEEP_WATCH_PROBE_TIMEOUT (default 150).
+     SHEEP_WATCH_PROBE_TIMEOUT (default 150),
+     SHEEP_WATCH_MAX_HOURS (hard stop N hours after launch, also
+     refusing any step whose timeout budget would overrun it — keeps
+     the tunnel free for the driver's end-of-round bench; default off).
 """
 
 from __future__ import annotations
@@ -303,6 +306,23 @@ def build_queue() -> list[Step]:
                   "SHEEP_SCALE_BLOCK": str(1 << 20),
                   "SHEEP_SCALE_SKIP_ORACLE": ""},
              done_check=lambda rec: rec.get("oracle_equal") is True),
+        # 8. stretch: 2^24 = 134M edges, double the largest size ever run
+        # on the chip.  Hybrid only; h2d is ~1GB of tunnel transfer, so
+        # this runs last — a healthy window spends ~2-4 min uploading,
+        # a sick one times out without costing anything else.  HBM fits:
+        # the E-pad int32 working set is ~3.2GB of 16GB.
+        Step("bench_24", [PY, "bench.py"],
+             f"TPU_BENCH24_{ROUND}.json", 4000,
+             env={"SHEEP_BENCH_PATHS": "hybrid",
+                  "SHEEP_BENCH_SIZES": "24",
+                  "SHEEP_BENCH_TIMEOUT": "3000",
+                  "SHEEP_BENCH_LOG_N": "",
+                  # accelerator-or-nothing: a 1-core 134M-edge CPU
+                  # fallback would burn the budget for a useless record
+                  "SHEEP_BENCH_NO_FALLBACK": "1"},
+             sidecar="bench_progress.json",
+             done_check=lambda rec: any(
+                 s.get("log_n", 0) >= 24 for s in rec.get("sweep", []))),
     ]
     return q
 
@@ -331,11 +351,14 @@ def main() -> None:
         if plat and plat != "cpu":
             log(f"window OPEN (platform={plat}); {len(pending)} steps pending")
             for step in pending:
-                # re-check between steps too: a window that opens just
-                # before the deadline must not keep firing 1500-4500s
-                # steps into the driver's end-of-round tunnel time
-                if deadline is not None and time.time() > deadline:
-                    log("deadline reached mid-queue — disarming")
+                # re-check between steps too, counting the step's own
+                # budget: a step that would still hold the tunnel past
+                # the deadline must not start (the deadline exists to
+                # keep the driver's end-of-round bench uncontended)
+                if deadline is not None \
+                        and time.time() + step.timeout > deadline:
+                    log(f"step {step.name} would overrun the deadline — "
+                        "disarming")
                     return
                 ok = step.run()
                 if not ok:

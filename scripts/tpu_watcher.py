@@ -323,6 +323,22 @@ def build_queue() -> list[Step]:
              sidecar="bench_progress.json",
              done_check=lambda rec: any(
                  s.get("log_n", 0) >= 24 for s in rec.get("sweep", []))),
+        # 9. the record sizes with the packed single-key sort forced on:
+        # runs only once everything above has retired.  Whatever the
+        # ab_sort_pack64 A/B shows, this artifact documents the packed
+        # kernel's on-chip behavior at the gating sizes — and becomes
+        # the better record if s64 emulation turns out cheap there.
+        Step("bench_pack64", [PY, "bench.py"],
+             f"TPU_BENCH_PACK64_{ROUND}.json", 6000,
+             env={"SHEEP_BENCH_PATHS": "hybrid",
+                  "SHEEP_BENCH_SIZES": "20,22",
+                  "SHEEP_BENCH_TIMEOUT": "2400",
+                  "SHEEP_BENCH_LOG_N": "",
+                  "SHEEP_SORT_PACK64": "1",
+                  "SHEEP_BENCH_NO_FALLBACK": "1"},
+             sidecar="bench_progress.json",
+             done_check=lambda rec: any(
+                 s.get("log_n", 0) >= 22 for s in rec.get("sweep", []))),
     ]
     return q
 

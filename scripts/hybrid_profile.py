@@ -55,11 +55,15 @@ def main() -> None:
         int(jnp.max(lo[:1]) + jnp.max(hi[:1]))  # scalar fetch: sync
         t0 = mark("prep", t0)
         lo, hi, live, rounds, converged = reduce_links_hosted(
-            lo, hi, n, stop_live=factor * n)
+            lo, hi, n, stop_live=factor * n, handoff_input=True)
         if record is not None:
             record["rounds"] = rounds
             record["live"] = int(live)
             record["converged"] = bool(converged)
+            # rounds == 0: the immediate-handoff skip fired and `live`
+            # is the sentinel-inclusive input length, NOT a post-round
+            # live count — don't compare it against older records
+            record["immediate_handoff"] = rounds == 0 and not converged
         t0 = mark("reduce", t0)
         # THE production fetch policy (ops.build.fetch_links_host — shared
         # so the ab_pack_off watcher A/B measures what the hybrid really

@@ -243,7 +243,7 @@ def build_graph_hybrid(tail: np.ndarray, head: np.ndarray,
     pre = threading.Thread(target=_prefetch, daemon=True)
     pre.start()
     lo, hi, live, rounds, converged = reduce_links_hosted(
-        lo, hi, n, stop_live=handoff_factor * n)
+        lo, hi, n, stop_live=handoff_factor * n, handoff_input=True)
     def _pst_resolved():
         # host-prefetched pst when the thread landed it; else the device
         # pst — materialized lazily when prepare_links skipped the scatter

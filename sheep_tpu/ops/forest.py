@@ -470,9 +470,23 @@ def _depth_tier(size: int, pad: int, in_schedule: bool, levels: int,
     return min(levels + 6, cap)
 
 
+@jax.jit
+def _sorted_once(lo: jnp.ndarray, hi: jnp.ndarray):
+    """One plain lexicographic sort as its own cached XLA program, for
+    the immediate-handoff path.  Measured at 2^22 (cpu backend, full-E
+    handoff): raw order 11.1s total, sort-only 9.2s (the native UF reads
+    a sorted stream 3x faster once its parent array outgrows cache),
+    sort+rewrite 23.7s (the rewrite scrambles the order — chain links
+    land at scattered hub positions, worse than raw for the UF), and
+    sort+rewrite+re-sort 10.5s (the dedupe doesn't pay for the second
+    sort).  Plain sort wins."""
+    return sort_links(lo, hi)
+
+
 def reduce_links_hosted(lo, hi, n: int, stop_live: int = 0,
                         levels: int = 10, jrounds: int = 8,
-                        first_levels: int = 4):
+                        first_levels: int = 4,
+                        handoff_input: bool = False):
     """Run chunk rounds until convergence (or until live <= stop_live),
     compacting between dispatches.
 
@@ -502,6 +516,26 @@ def reduce_links_hosted(lo, hi, n: int, stop_live: int = 0,
         fill = jnp.full(pad - e, n, jnp.int32)
         lo = jnp.concatenate([lo, fill])
         hi = jnp.concatenate([hi, fill])
+    if handoff_input and stop_live and e <= stop_live:
+        # The input already satisfies the handoff threshold AND the
+        # caller promised the output goes straight to the native
+        # union-find (``handoff_input`` — NOT the streaming folds, whose
+        # carry contract needs the dedupe rounds): the opener + a sorted
+        # chunk retire ~nothing before the live check stops the loop
+        # anyway (measured 10.3s of a 13.8s CPU hybrid at 2^22 with
+        # factor 8, where stop_live == E).  What the handoff stream
+        # needs depends on whether the union-find's parent array still
+        # fits in cache: below n ~ 2^21 (UF state < ~16MB) raw R-MAT
+        # order chases fine (0.28s at 2^20) and any device work is a
+        # loss; above it, raw order thrashes (8.2s vs 2.9s at 2^22) and
+        # one plain sort on the POW2-PADDED arrays (bounded compile
+        # variants, sentinels sort last) pays for itself in the native
+        # tail (see _sorted_once for the rejected rewrite variants).
+        # The returned count stays the sentinel-inclusive upper bound;
+        # callers' lo < n filter drops dead slots.
+        if n >= (1 << 21):
+            lo, hi = _sorted_once(lo, hi)
+        return lo, hi, e, 0, False
     rounds = 0
     chunk_i = 0
     n_cur = n  # current vertex-space size (shrinks at each remap)

@@ -242,8 +242,15 @@ def build_graph_hybrid(tail: np.ndarray, head: np.ndarray,
 
     pre = threading.Thread(target=_prefetch, daemon=True)
     pre.start()
+    # immediate-handoff only where its trade was measured to win: a free
+    # d2h copy (cpu) AND the native union-find tail (the python UF pays
+    # per link, and a byte-bound accelerator fetch wants the dedupe
+    # rounds to shrink the volume first — same gate as the stream's
+    # final fold)
     lo, hi, live, rounds, converged = reduce_links_hosted(
-        lo, hi, n, stop_live=handoff_factor * n, handoff_input=True)
+        lo, hi, n, stop_live=handoff_factor * n,
+        handoff_input=jax.devices()[0].platform == "cpu"
+        and native_or_none("auto") is not None)
     def _pst_resolved():
         # host-prefetched pst when the thread landed it; else the device
         # pst — materialized lazily when prepare_links skipped the scatter

@@ -5,12 +5,14 @@ from .build import (distributed_build_step, build_graph_distributed,
 from .stream import build_graph_streaming_sharded
 from .chunked import (build_graph_chunked_distributed,
                       build_graph_streaming_chunked,
-                      build_links_chunked_sharded, reduce_links_sharded)
+                      build_links_chunked_sharded,
+                      map_graph_chunked_distributed, reduce_links_sharded)
 
 __all__ = [
     "build_graph_chunked_distributed",
     "build_graph_streaming_chunked",
     "build_links_chunked_sharded",
+    "map_graph_chunked_distributed",
     "reduce_links_sharded",
     "AXIS",
     "make_mesh",

@@ -201,6 +201,21 @@ def _to_forest(parent, pst, n, m):
     return ops_to_forest(np.asarray(parent)[:m], np.asarray(pst)[:m], m)
 
 
+def _mesh_kernel() -> str:
+    """Which multi-worker kernel the public wrappers route through:
+    "chunked" (default — bounded dispatches, the execution shape real
+    hardware needs) or "loop" (the single-dispatch while_loop twin —
+    fewer host syncs, still the dryrun's compile-coverage shape).
+    Anything else is an error: a typo must not silently select the
+    kernel that faults on real hardware at scale."""
+    import os
+    kernel = os.environ.get("SHEEP_MESH_KERNEL", "chunked")
+    if kernel not in ("chunked", "loop"):
+        raise ValueError(
+            f"SHEEP_MESH_KERNEL={kernel!r} must be 'chunked' or 'loop'")
+    return kernel
+
+
 def _run_distributed(tail, head, num_vertices, num_workers, seq, do_merge,
                      mesh=None):
     """Shared prologue + dispatch for the host-facing wrappers.
@@ -288,6 +303,16 @@ def build_graph_distributed(tail: np.ndarray, head: np.ndarray,
         from ..ops.build import build_graph_hybrid
         return build_graph_hybrid(tail, head, num_vertices=num_vertices,
                                   seq=seq)
+    if _mesh_kernel() == "chunked":
+        # production default: bounded dispatches only — the in-jit
+        # while_loop fixpoint below faults on real hardware once its
+        # wall time outgrows the backend's per-execution budget
+        # (PERF_NOTES; SHEEP_MESH_KERNEL=loop selects the
+        # single-dispatch twin, which stays the dryrun's compile shape)
+        from .chunked import build_graph_chunked_distributed
+        return build_graph_chunked_distributed(
+            tail, head, num_vertices=num_vertices,
+            num_workers=num_workers, seq=seq)
     out_seq, parent, pst, n, m, _ = _run_distributed(
         tail, head, num_vertices, num_workers, seq, do_merge=True, mesh=mesh)
     if n == 0:
@@ -305,6 +330,11 @@ def map_graph_distributed(tail: np.ndarray, head: np.ndarray,
     the full vertex set over the shared sequence, ready for the file-path
     merge tournament (reference graph2tree.cpp:148,158 rank-suffixed saves).
     """
+    if _mesh_kernel() == "chunked":
+        from .chunked import map_graph_chunked_distributed
+        return map_graph_chunked_distributed(
+            tail, head, num_vertices=num_vertices,
+            num_workers=num_workers, seq=seq)
     out_seq, parents, psts, n, m, w = _run_distributed(
         tail, head, num_vertices, num_workers, seq, do_merge=False)
     if n == 0:

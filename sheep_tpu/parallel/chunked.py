@@ -118,6 +118,23 @@ def chunk_sharded(lo, hi, n: int, mesh, levels: int, jrounds: int,
 
 
 @functools.partial(jax.jit, static_argnames=("n", "mesh"))
+def parent_sharded_local(lo, hi, n: int, mesh):
+    """Per-shard parent extraction from per-shard converged links: [W, n]
+    stacked, NO cross-worker combine — each row is that worker's partial
+    forest over the shared sequence (the `-i`-without-`-r` map phase)."""
+    def body(lo, hi):
+        sent = jnp.int32(n)
+        p = jnp.full(n + 1, sent, jnp.int32).at[
+            lo[0].astype(jnp.int32)].min(hi[0].astype(jnp.int32))[:n]
+        return p[None, :]
+
+    fn = shard_map(body, mesh=mesh,
+                   in_specs=(P(AXIS, None), P(AXIS, None)),
+                   out_specs=P(AXIS, None), check_vma=False)
+    return fn(lo, hi)
+
+
+@functools.partial(jax.jit, static_argnames=("n", "mesh"))
 def parent_sharded(lo, hi, n: int, mesh):
     """Global parent extraction from converged sharded links: per-shard
     scatter-min pmin-combined (valid once the union forms a forest)."""
@@ -133,13 +150,18 @@ def parent_sharded(lo, hi, n: int, mesh):
     return fn(lo, hi)
 
 
-@functools.partial(jax.jit, static_argnames=("n", "mesh", "with_pos"))
-def prep_sharded(tail, head, n: int, mesh, pos=None, with_pos: bool = False):
+@functools.partial(jax.jit, static_argnames=("n", "mesh", "with_pos",
+                                              "local_pst"))
+def prep_sharded(tail, head, n: int, mesh, pos=None, with_pos: bool = False,
+                 local_pst: bool = False):
     """Degree sort + link mapping over the mesh (the `-i` phase).
 
     tail/head int32 [W, B] sharded (pad with n).  Returns (seq, pos, m,
     lo [W, B], hi [W, B], pst) with everything but lo/hi replicated.
     Matches parallel.build._sharded_build's sequence/pst semantics.
+    ``local_pst``: keep pst per-worker ([W, n] stacked, each row counting
+    only that shard's edges) for the map-only partials path instead of
+    the psum-combined total.
     """
     def body(t, h, posr):
         sent = jnp.int32(n)
@@ -168,20 +190,24 @@ def prep_sharded(tail, head, n: int, mesh, pos=None, with_pos: bool = False):
         dead = (lo >= hi) | (hi >= sent)
         lo = jnp.where(dead, sent, lo)
         hi = jnp.where(dead, sent, hi)
+        if local_pst:
+            return (seq, pos_r, m, lo[None, :], hi[None, :],
+                    pst_local[None, :])
         return (seq, pos_r, m, lo[None, :], hi[None, :],
                 lax.psum(pst_local, AXIS))
 
+    pst_spec = P(AXIS, None) if local_pst else P()
     if with_pos:
         fn = shard_map(lambda t, h, p: body(t, h, p), mesh=mesh,
                        in_specs=(P(AXIS, None), P(AXIS, None), P()),
                        out_specs=(P(), P(), P(), P(AXIS, None),
-                                  P(AXIS, None), P()),
+                                  P(AXIS, None), pst_spec),
                        check_vma=False)
         return fn(tail, head, pos)
     fn = shard_map(lambda t, h: body(t, h, None), mesh=mesh,
                    in_specs=(P(AXIS, None), P(AXIS, None)),
                    out_specs=(P(), P(), P(), P(AXIS, None),
-                              P(AXIS, None), P()),
+                              P(AXIS, None), pst_spec),
                    check_vma=False)
     return fn(tail, head)
 
@@ -432,18 +458,14 @@ def build_graph_streaming_chunked(blocks, n: int, pos: np.ndarray,
     return Forest(out, (pst & 0xFFFFFFFF).astype(np.uint32)), total_rounds
 
 
-def build_graph_chunked_distributed(tail, head, num_vertices=None,
-                                    num_workers=None, seq=None,
-                                    timings=None):
-    """Host-facing chunked mesh build: (seq uint32 [m], Forest over m).
-
-    Same contract as parallel.build.build_graph_distributed, but every
-    device dispatch is bounded — the execution shape real hardware needs.
+def _stage_inputs(tail, head, num_vertices, num_workers, seq):
+    """Shared host-facing prologue of the chunked wrappers: mesh, vertex
+    count inference, edge staging, and the given-seq position-table
+    device staging (including the multi-process make_array branch —
+    kept in ONE place so a fix cannot drift between the merge and map
+    wrappers).  Returns (mesh, n, t2d, h2d, pos_d); n == 0 signals the
+    empty graph (arrays None then), pos_d is None when seq is None.
     """
-    from .. import INVALID_JNID
-    from ..core.forest import Forest
-    from .build import _fetch, _to_forest
-
     mesh = make_mesh(num_workers)
     n = num_vertices
     if n is None:
@@ -452,15 +474,10 @@ def build_graph_chunked_distributed(tail, head, num_vertices=None,
     if seq is not None and len(seq):
         n = max(n, int(seq.max()) + 1)
     if n == 0:
-        return (np.empty(0, np.uint32),
-                Forest(np.empty(0, np.uint32), np.empty(0, np.uint32)))
+        return mesh, 0, None, None, None
     t2d, h2d = stage_edges_2d(tail, head, n, mesh)
-    if seq is None:
-        dseq, _, m, parent, pst = build_links_chunked_sharded(
-            t2d, h2d, n, mesh, fetch=_fetch, timings=timings)
-        m = int(_fetch(m))
-        out_seq = _fetch(dseq)[:m].astype(np.uint32)
-    else:
+    pos_d = None
+    if seq is not None:
         from ..core.sequence import sequence_positions
         pos_np = sequence_positions(seq, n - 1).astype(np.int64)
         sharding = NamedSharding(mesh, P())
@@ -468,8 +485,66 @@ def build_graph_chunked_distributed(tail, head, num_vertices=None,
             if jax.process_count() == 1 else jax.make_array_from_callback(
                 pos_np.shape, sharding,
                 lambda idx: pos_np.astype(np.int32)[idx])
-        dseq, _, m, parent, pst = build_links_chunked_sharded(
-            t2d, h2d, n, mesh, pos=pos_d, fetch=_fetch, timings=timings)
+    return mesh, n, t2d, h2d, pos_d
+
+
+def map_graph_chunked_distributed(tail, head, num_vertices=None,
+                                  num_workers=None, seq=None):
+    """Map-only chunked mesh build: (seq uint32 [m], [Forest] * W).
+
+    The bounded-dispatch twin of parallel.build.map_graph_distributed
+    (`-i` without `-r`): each worker's edge shard reduces with LOCAL
+    chunk rounds only (reduce_links_sharded global_f=False) to a partial
+    forest over the shared sequence, ready for the file-path merge
+    tournament.  Per-worker pst counts only that shard's edges
+    (graph2tree.cpp:148 rank-suffixed saves semantics).
+    """
+    from .build import _fetch, _to_forest
+
+    mesh, n, t2d, h2d, pos_d = _stage_inputs(
+        tail, head, num_vertices, num_workers, seq)
+    if n == 0:
+        return np.empty(0, np.uint32), []
+    if pos_d is None:
+        dseq, _, m, lo, hi, psts = prep_sharded(t2d, h2d, n, mesh,
+                                                local_pst=True)
+        m = int(_fetch(m))
+        out_seq = _fetch(dseq)[:m].astype(np.uint32)
+    else:
+        dseq, _, m, lo, hi, psts = prep_sharded(
+            t2d, h2d, n, mesh, pos=pos_d, with_pos=True, local_pst=True)
+        m = len(seq)
+        out_seq = np.asarray(seq, dtype=np.uint32)
+    lo, hi, _ = reduce_links_sharded(lo, hi, n, mesh, global_f=False,
+                                     fetch=_fetch)
+    parents = _fetch(parent_sharded_local(lo, hi, n, mesh))
+    psts_np = _fetch(psts)
+    return out_seq, [_to_forest(parents[i], psts_np[i], n, m)
+                     for i in range(mesh.size)]
+
+
+def build_graph_chunked_distributed(tail, head, num_vertices=None,
+                                    num_workers=None, seq=None,
+                                    timings=None):
+    """Host-facing chunked mesh build: (seq uint32 [m], Forest over m).
+
+    Same contract as parallel.build.build_graph_distributed, but every
+    device dispatch is bounded — the execution shape real hardware needs.
+    """
+    from ..core.forest import Forest
+    from .build import _fetch, _to_forest
+
+    mesh, n, t2d, h2d, pos_d = _stage_inputs(
+        tail, head, num_vertices, num_workers, seq)
+    if n == 0:
+        return (np.empty(0, np.uint32),
+                Forest(np.empty(0, np.uint32), np.empty(0, np.uint32)))
+    dseq, _, m, parent, pst = build_links_chunked_sharded(
+        t2d, h2d, n, mesh, pos=pos_d, fetch=_fetch, timings=timings)
+    if seq is None:
+        m = int(_fetch(m))
+        out_seq = _fetch(dseq)[:m].astype(np.uint32)
+    else:
         m = len(seq)
         out_seq = np.asarray(seq, dtype=np.uint32)
     return out_seq, _to_forest(_fetch(parent), _fetch(pst), n, m)

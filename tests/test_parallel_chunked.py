@@ -139,3 +139,52 @@ def test_chunked_hepth(hep_edges):
     # unified default: all rounds are global-f, no separate map phase
     assert tm["unified"] and tm["map_rounds"] == 0
     assert tm["reduce_rounds"] >= 1 and tm["reduce_s"] > 0
+
+
+@pytest.mark.parametrize("workers", [1, 2, 8])
+def test_chunked_map_only_partials(workers, monkeypatch):
+    """map_graph_chunked_distributed: per-worker partials (local rounds
+    only) must tournament-merge to the whole-graph oracle, match the
+    while_loop twin bit-exactly, and carry per-shard pst counts."""
+    from sheep_tpu.core.forest import merge_forests
+    from sheep_tpu.parallel import map_graph_chunked_distributed
+    from sheep_tpu.parallel.build import map_graph_distributed
+
+    rng = np.random.default_rng(7500 + workers)
+    tail, head = random_multigraph(rng, n_max=70, e_max=400)
+    want_seq = degree_sequence(tail, head)
+    want = build_forest(tail, head, want_seq)
+
+    seq, partials = map_graph_chunked_distributed(
+        tail, head, num_workers=workers)
+    np.testing.assert_array_equal(seq, want_seq)
+    assert len(partials) == workers
+    merged = merge_forests(*partials) if len(partials) > 1 else partials[0]
+    np.testing.assert_array_equal(merged.parent, want.parent)
+    np.testing.assert_array_equal(merged.pst_weight, want.pst_weight)
+    # per-shard pst sums to the whole (each edge counted on one shard)
+    total_pst = sum(p.pst_weight.astype(np.int64) for p in partials)
+    np.testing.assert_array_equal(total_pst, want.pst_weight.astype(np.int64))
+
+    # bit-identical to the single-dispatch twin, partial by partial
+    monkeypatch.setenv("SHEEP_MESH_KERNEL", "loop")
+    seq2, partials2 = map_graph_distributed(tail, head, num_workers=workers)
+    np.testing.assert_array_equal(seq2, want_seq)
+    assert len(partials2) == workers
+    for a, b in zip(partials, partials2):
+        np.testing.assert_array_equal(a.parent, b.parent)
+        np.testing.assert_array_equal(a.pst_weight, b.pst_weight)
+
+
+def test_mesh_kernel_env_validation(monkeypatch):
+    """A typo'd SHEEP_MESH_KERNEL must raise, not silently pick the
+    while_loop shape that faults on real hardware."""
+    from sheep_tpu.parallel.build import _mesh_kernel
+
+    monkeypatch.setenv("SHEEP_MESH_KERNEL", "chunk")
+    with pytest.raises(ValueError):
+        _mesh_kernel()
+    monkeypatch.setenv("SHEEP_MESH_KERNEL", "loop")
+    assert _mesh_kernel() == "loop"
+    monkeypatch.delenv("SHEEP_MESH_KERNEL")
+    assert _mesh_kernel() == "chunked"

@@ -30,7 +30,7 @@ import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 PY = sys.executable
-ROUND = "r04"
+ROUND = "r05"
 
 
 def log(msg: str) -> None:

@@ -50,6 +50,44 @@ import numpy as np
 _BASELINE_EDGES_PER_SEC = 1_468_364_884 / 18.7  # twitter map, 18 MPI ranks
 
 
+def _last_onchip_pointer() -> dict | None:
+    """Headline of the newest committed on-chip sweep (TPU_BENCH_*.json),
+    for embedding in a CPU-fallback record — VERDICT r04 item 5: a
+    scoreboard reading only BENCH_r0N must still see that a real chip
+    number exists.  Clearly labeled; never substituted into ``value``.
+    """
+    import glob
+    best: tuple[str, dict] | None = None
+    repo = os.path.dirname(os.path.abspath(__file__))
+    for path in glob.glob(os.path.join(repo, "TPU_BENCH*.json")):
+        try:
+            with open(path) as f:
+                lines = f.read().strip().splitlines()
+        except OSError:
+            continue
+        for line in reversed(lines):
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            if not isinstance(rec, dict) or "value" not in rec:
+                continue
+            if "_cpu_fallback" in rec.get("metric", "") or rec.get("_partial"):
+                continue
+            utc = rec.get("_utc", "")
+            if best is None or utc > best[1].get("_utc", ""):
+                best = (os.path.basename(path), rec)
+            break
+    if best is None:
+        return None
+    src, rec = best
+    return {"metric": rec.get("metric"), "value": rec.get("value"),
+            "unit": rec.get("unit"), "vs_baseline": rec.get("vs_baseline"),
+            "utc": rec.get("_utc"), "source": src,
+            "note": "prior committed on-chip sweep, NOT this run's "
+                    "measurement (this run fell back to CPU)"}
+
+
 def _probe_hardware(timeout_s: int = 180) -> str | None:
     """The default backend's platform name, or None when it won't come up.
 
@@ -497,12 +535,15 @@ def main() -> None:
                                        startup_s, _checkpoint)
 
     tag = "_cpu_fallback" if fell_back else ""
+    last_onchip = _last_onchip_pointer() if fell_back else None
     if not sweep:
         # Even a total failure must yield a parseable record.
-        print(json.dumps({
-            "metric": f"device_build_edges_per_sec{tag}",
-            "value": 0.0, "unit": "edges/sec", "vs_baseline": 0.0,
-            "fault": first_fault, "accel_fault": accel_fault}))
+        rec = {"metric": f"device_build_edges_per_sec{tag}",
+               "value": 0.0, "unit": "edges/sec", "vs_baseline": 0.0,
+               "fault": first_fault, "accel_fault": accel_fault}
+        if last_onchip is not None:
+            rec["last_onchip"] = last_onchip
+        print(json.dumps(rec))
         sys.exit(1)
     top = max(sweep, key=lambda r: r["log_n"])
     out = {
@@ -521,6 +562,8 @@ def main() -> None:
         out["first_fault"] = first_fault
     if accel_fault is not None:
         out["accel_fault"] = accel_fault
+    if last_onchip is not None:
+        out["last_onchip"] = last_onchip
     print(json.dumps(out))
 
 

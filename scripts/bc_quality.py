@@ -8,7 +8,7 @@ parity is not reproducible; this script computes exact Brandes
 betweenness (unweighted, undirected, dedup'd edges), orders ascending
 (ties by vid — same convention as the degree sequence), runs the same
 parts 2..40 sweep, and records both columns side by side in
-BCQUALITY_r03.json.  What it demonstrates: arbitrary external sequences
+BCQUALITY_r05.json.  What it demonstrates: arbitrary external sequences
 drive the same pipeline (graph2tree -s), and a centrality order lands in
 the same quality band as the reference's.
 
@@ -155,8 +155,10 @@ def main() -> None:
                 "closeness, PageRank, and sampled Brandes k=4..512 over "
                 "multiple seeds (best ECV 461).  The reference's "
                 "ordering was produced by an unidentified external tool "
-                "and is not recoverable from shipped data; scripts "
-                "bc_search{,2,3}.py hold the full enumeration."),
+                "and is not recoverable from shipped data.  The three "
+                "generations of search scripts (bc_search{,2,3}.py) were "
+                "retired in round 5 with the search concluded; git "
+                "history holds the full enumeration code."),
             "best_sampled_ecv_down_2parts": 461,
             "exact_bc_ecv_down_2parts": rows[0]["ecv_down"] if rows else None,
             "reference_ecv_down_2parts": 314,
@@ -164,7 +166,7 @@ def main() -> None:
         "rows": rows,
     }
     out = os.path.join(os.path.dirname(os.path.dirname(
-        os.path.abspath(__file__))), "BCQUALITY_r04.json")
+        os.path.abspath(__file__))), "BCQUALITY_r05.json")
     with open(out, "w") as f:
         json.dump(rec, f, indent=1)
     head_rows = [r for r in rows if r["parts"] in (2, 3, 4, 8, 16, 32)]

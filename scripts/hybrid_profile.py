@@ -54,8 +54,10 @@ def main() -> None:
         seq, _, m, lo, hi, pst = prepare_links(t, h, n)
         int(jnp.max(lo[:1]) + jnp.max(hi[:1]))  # scalar fetch: sync
         t0 = mark("prep", t0)
+        from sheep_tpu.ops.build import handoff_input_ok
         lo, hi, live, rounds, converged = reduce_links_hosted(
-            lo, hi, n, stop_live=factor * n, handoff_input=True)
+            lo, hi, n, stop_live=factor * n,
+            handoff_input=handoff_input_ok())  # mirror production's gate
         if record is not None:
             record["rounds"] = rounds
             record["live"] = int(live)

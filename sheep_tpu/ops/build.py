@@ -159,10 +159,7 @@ def build_graph_hybrid(tail: np.ndarray, head: np.ndarray,
     vids outside the sequence count toward pst, never the tree —
     jtree.cpp:47-49).
     """
-    import os
-
     from .forest import reduce_links_hosted, parent_from_links
-    from ..core.forest import native_or_none
 
     if handoff_factor is None:
         handoff_factor = default_handoff_factor()
@@ -242,15 +239,12 @@ def build_graph_hybrid(tail: np.ndarray, head: np.ndarray,
 
     pre = threading.Thread(target=_prefetch, daemon=True)
     pre.start()
-    # immediate-handoff only where its trade was measured to win: a free
-    # d2h copy (cpu) AND the native union-find tail (the python UF pays
-    # per link, and a byte-bound accelerator fetch wants the dedupe
-    # rounds to shrink the volume first — same gate as the stream's
-    # final fold)
+    # immediate-handoff only where its trade was measured to win — the
+    # shared handoff_input_ok gate (same for the stream's final fold and
+    # the profiler, so the sites can't drift)
     lo, hi, live, rounds, converged = reduce_links_hosted(
         lo, hi, n, stop_live=handoff_factor * n,
-        handoff_input=jax.devices()[0].platform == "cpu"
-        and native_or_none("auto") is not None)
+        handoff_input=handoff_input_ok())
     def _pst_resolved():
         # host-prefetched pst when the thread landed it; else the device
         # pst — materialized lazily when prepare_links skipped the scatter
@@ -275,6 +269,18 @@ def build_graph_hybrid(tail: np.ndarray, head: np.ndarray,
     m = int(fetched.get("m", m))
     seq_np = np.asarray(fetched.get("seq", seq))[:m].astype(np.uint32)
     return seq_np, Forest(parent_h[:m].copy(), pst_out[:m].copy())
+
+
+def handoff_input_ok() -> bool:
+    """THE immediate-handoff gate, shared by every caller (the hybrid,
+    the streaming final fold, scripts/hybrid_profile) so the sites can't
+    drift: skip the device dedupe rounds only where the d2h copy is free
+    (cpu backend) AND the native union-find consumes the undeduped links
+    (the pure-python UF pays per link; a byte-bound accelerator fetch
+    wants the dedupe rounds to shrink the volume first)."""
+    from ..core.forest import native_or_none
+    return jax.devices()[0].platform == "cpu" \
+        and native_or_none("auto") is not None
 
 
 def default_handoff_factor() -> int:

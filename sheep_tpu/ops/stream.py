@@ -170,13 +170,11 @@ def build_graph_streaming_hosted(blocks, n: int, pos: np.ndarray,
     # handoff threshold and let the native union-find chase the residue —
     # the device-convergence tail was measured at hundreds of rounds on
     # the last few thousand links (SCALE_r03: 781 total rounds).
-    from .build import default_handoff_factor, handoff_finish_native
-    # handoff_input only where the fetch is a free copy (cpu): on a
-    # byte-bound accelerator link the final dedupe chunk shrinks the d2h
-    # volume by more than the chunk costs, so the skip stays off there
+    from .build import (default_handoff_factor, handoff_finish_native,
+                        handoff_input_ok)
     carry_lo, carry_hi, live, rounds, converged = reduce_links_hosted(
         carry_lo, carry_hi, n, stop_live=default_handoff_factor() * n,
-        handoff_input=jax.devices()[0].platform == "cpu")
+        handoff_input=handoff_input_ok())
     total_rounds += rounds
     pst_np = np.asarray(pst).astype(np.uint32)
     if converged:

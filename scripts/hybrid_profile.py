@@ -31,7 +31,6 @@ def main() -> None:
     import jax
     import jax.numpy as jnp
     from sheep_tpu.ops.build import prepare_links
-    from sheep_tpu.ops.forest import reduce_links_hosted, parent_from_links
     from sheep_tpu.core.forest import native_or_none
 
     platform = jax.devices()[0].platform
@@ -54,32 +53,45 @@ def main() -> None:
         seq, _, m, lo, hi, pst = prepare_links(t, h, n)
         int(jnp.max(lo[:1]) + jnp.max(hi[:1]))  # scalar fetch: sync
         t0 = mark("prep", t0)
-        from sheep_tpu.ops.build import handoff_input_ok
-        lo, hi, live, rounds, converged = reduce_links_hosted(
+        # THE production reduce+fetch (ops.build.reduce_and_fetch_links —
+        # shared with build_graph_hybrid so this profile and the watcher
+        # A/Bs measure exactly what the hybrid ships, including the
+        # overlapped speculative handoff on accelerators).  loop_s /
+        # fetch_tail_s are the serialized equivalents of the old
+        # reduce / d2h phases: with overlap on, d2h shows only the
+        # NON-hidden tail of the link fetch.  NOTE: production also
+        # overlaps the seq/pst fetch via a prefetch thread — this
+        # breakdown serializes that part, so d2h stays an upper bound.
+        from sheep_tpu.ops.build import (handoff_input_ok,
+                                         reduce_and_fetch_links,
+                                         fetch_links_host)
+        perf: dict = {}
+        kind, a, b, live, rounds = reduce_and_fetch_links(
             lo, hi, n, stop_live=factor * n,
-            handoff_input=handoff_input_ok())  # mirror production's gate
+            handoff_input=handoff_input_ok(), perf=perf)
         if record is not None:
             record["rounds"] = rounds
             record["live"] = int(live)
-            record["converged"] = bool(converged)
+            record["converged"] = kind == "device"
             # rounds == 0: the immediate-handoff skip fired and `live`
             # is the sentinel-inclusive input length, NOT a post-round
             # live count — don't compare it against older records
-            record["immediate_handoff"] = rounds == 0 and not converged
-        t0 = mark("reduce", t0)
-        # THE production fetch policy (ops.build.fetch_links_host — shared
-        # so the ab_pack_off watcher A/B measures what the hybrid really
-        # ships).  NOTE: the production path also overlaps the seq/pst
-        # fetch with the reduce loop via a prefetch thread — this
-        # breakdown serializes it, so d2h here is an upper bound on
-        # production's visible fetch time.
-        from sheep_tpu.ops.build import fetch_links_host
-        lo_h, hi_h, packed = fetch_links_host(lo, hi, int(live), n)
-        if record is not None:
-            record["packed_handoff"] = packed
+            record["immediate_handoff"] = rounds == 0 and kind == "host"
+            record["reduce"] = perf.get("loop_s")
+            record.update({k: v for k, v in perf.items()
+                           if k == "overlap" or k.startswith("spec_")})
+        t0 = time.perf_counter()
+        if kind == "device":  # converged: links already form the forest
+            lo_h, hi_h, _ = fetch_links_host(a, b, live, n)
+        else:
+            lo_h, hi_h = a, b
         pst_h = np.asarray(pst).astype(np.uint32)
         seq_h = np.asarray(seq)
-        t0 = mark("d2h", t0)
+        t1 = time.perf_counter()
+        if record is not None:
+            record["d2h"] = round(
+                perf.get("fetch_tail_s", 0.0) + (t1 - t0), 4)
+        t0 = t1
         native = native_or_none("auto")
         parent_h, pst_out = native.build_forest_links(
             lo_h.astype(np.uint32), hi_h.astype(np.uint32), n, pst_h)

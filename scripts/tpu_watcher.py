@@ -256,15 +256,25 @@ def build_queue() -> list[Step]:
              f"TPU_AB_{ROUND}.jsonl", 1800, append=True),
         Step("ab_handoff_8", [PY, "scripts/hybrid_profile.py", "20", "8"],
              f"TPU_AB_{ROUND}.jsonl", 1800, append=True),
+        # pack A/B must run with overlap OFF: the overlapped stream packs
+        # purely on n < 2^24 and never consults SHEEP_PACK_HANDOFF, so
+        # with overlap on both arms would measure identical transfers
         Step("ab_pack_off", [PY, "scripts/hybrid_profile.py", "20"],
              f"TPU_AB_{ROUND}.jsonl", 1800,
-             env={"SHEEP_PACK_HANDOFF": "0"}, append=True),
+             env={"SHEEP_PACK_HANDOFF": "0",
+                  "SHEEP_OVERLAP_HANDOFF": "0"}, append=True),
         # packed single-key link sort on the chip (cpu default, off on
         # accelerators until this A/B: s64 is emulated in 32-bit lanes,
         # so the 4.2x XLA:CPU win may invert on the TPU)
         Step("ab_sort_pack64", [PY, "scripts/hybrid_profile.py", "20"],
              f"TPU_AB_{ROUND}.jsonl", 1800,
              env={"SHEEP_SORT_PACK64": "1"}, append=True),
+        # overlapped speculative handoff (round-5, VERDICT item 1):
+        # profile_20/profile_22 above run the default-ON overlap; this is
+        # the off arm at the same size.  Decision rule in PERF_NOTES.
+        Step("ab_overlap_off", [PY, "scripts/hybrid_profile.py", "20"],
+             f"TPU_AB_{ROUND}.jsonl", 1800,
+             env={"SHEEP_OVERLAP_HANDOFF": "0"}, append=True),
         # 5. per-op ceiling proof at 2^22 (VERDICT item 2 fallback evidence)
         Step("diag_hist_22", [PY, "scripts/tpu_diag.py", "hist", "22"],
              f"TPU_DIAG22_{ROUND}.jsonl", 1500, append=True),

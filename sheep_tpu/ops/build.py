@@ -9,6 +9,9 @@ segment-sum.  The mesh-sharded variant lives in sheep_tpu.parallel.
 from __future__ import annotations
 
 import functools
+import os
+import threading
+import time
 
 import numpy as np
 import jax
@@ -159,7 +162,7 @@ def build_graph_hybrid(tail: np.ndarray, head: np.ndarray,
     vids outside the sequence count toward pst, never the tree —
     jtree.cpp:47-49).
     """
-    from .forest import reduce_links_hosted, parent_from_links
+    from .forest import parent_from_links
 
     if handoff_factor is None:
         handoff_factor = default_handoff_factor()
@@ -241,8 +244,10 @@ def build_graph_hybrid(tail: np.ndarray, head: np.ndarray,
     pre.start()
     # immediate-handoff only where its trade was measured to win — the
     # shared handoff_input_ok gate (same for the stream's final fold and
-    # the profiler, so the sites can't drift)
-    lo, hi, live, rounds, converged = reduce_links_hosted(
+    # the profiler, so the sites can't drift).  On accelerators the
+    # reduce and the handoff fetch run OVERLAPPED (reduce_and_fetch_links
+    # streams an early snapshot while later chunks still run).
+    kind, a, b, live, rounds = reduce_and_fetch_links(
         lo, hi, n, stop_live=handoff_factor * n,
         handoff_input=handoff_input_ok())
     def _pst_resolved():
@@ -253,19 +258,18 @@ def build_graph_hybrid(tail: np.ndarray, head: np.ndarray,
             return fetched["pst"]
         return pst if pst is not None else _lazy_pst()
 
-    if converged:
+    if kind == "device":  # converged before the handoff threshold
         pre.join()
-        parent = parent_from_links(lo, hi, n)
+        parent = parent_from_links(a, b, n)
         return _finish(fetched.get("seq", seq), fetched.get("m", m), parent,
                        _pst_resolved())
     def _pst_after_fetch():
-        # joined only after the big link fetch inside handoff_finish_native
-        # has completed, so the seq/pst prefetch keeps overlapping it
+        # resolved only after the link fetch has completed, so the
+        # seq/pst prefetch keeps overlapping it
         pre.join()
         return np.asarray(_pst_resolved()).astype(np.uint32)
 
-    parent_h, pst_out = handoff_finish_native(lo, hi, live, n,
-                                              _pst_after_fetch)
+    parent_h, pst_out = finish_native_host(a, b, n, _pst_after_fetch)
     m = int(fetched.get("m", m))
     seq_np = np.asarray(fetched.get("seq", seq))[:m].astype(np.uint32)
     return seq_np, Forest(parent_h[:m].copy(), pst_out[:m].copy())
@@ -330,6 +334,321 @@ def fetch_links_host(lo, hi, live: int, n: int):
     return lo_h[keep], hi_h[keep], packed
 
 
+@functools.partial(jax.jit, static_argnames=("length",))
+def _slice_rows(buf, start, length: int):
+    """Fixed-length row slice with a DYNAMIC start: one compiled program
+    per (buffer shape, length) instead of one per offset — tunneled
+    compiles run 30-130s each, so the streamed fetch must reuse a single
+    program across all of its slices."""
+    return jax.lax.dynamic_slice_in_dim(buf, start, length, 0)
+
+
+def _overlap_enabled() -> bool:
+    """Overlapped speculative handoff gate (SHEEP_OVERLAP_HANDOFF
+    overrides): default ON for accelerators — where the link d2h is a
+    real transfer worth hiding behind device rounds — and OFF on the cpu
+    backend, where the fetch is a near-free copy and the immediate-
+    handoff path already skips rounds entirely."""
+    v = os.environ.get("SHEEP_OVERLAP_HANDOFF", "")
+    if v != "":
+        return v == "1"
+    return jax.devices()[0].platform != "cpu"
+
+
+class _StreamFetcher:
+    """Background slice-streamed d2h of one link snapshot.
+
+    The snapshot (lo, hi) is an immutable device-array pair with the
+    live-prefix guarantee (all live links in the first ``live`` slots),
+    so fetching it concurrently with later chunk dispatches is safe.
+    Transfers run as fixed-length slices of a 6-byte-packed buffer
+    (n < 2^24; int32 pairs otherwise) so progress is observable between
+    slices and an abort loses at most one slice of link time.
+    """
+
+    def __init__(self, lo, hi, n: int, live: int, slice_links: int):
+        self.n = n
+        self.live = live
+        self.packed = n < (1 << 24)
+        self.bytes_per_link = 6 if self.packed else 8
+        width = int(lo.shape[0])  # pow2-padded
+        # the env knob is an arbitrary int: round DOWN to a power of two
+        # (floor 512) so slice_len always divides the pow2 width — a
+        # non-dividing slice would silently skip tail links (wrong forest)
+        slice_links = 1 << max(9, slice_links.bit_length() - 1)
+        self.slice_len = min(slice_links, width)
+        self.total_slices = min(-(-live // self.slice_len),
+                                width // self.slice_len)
+        self.done_slices = 0
+        self.failed = False
+        self._abort = False
+        self._slices: list = []
+        # one elementwise pack over the padded width: pow2 shapes only,
+        # so the compile family stays bounded
+        if self.packed:
+            from .forest import pack_links_6b
+            self._dev = pack_links_6b(lo, hi)
+        else:
+            self._dev = (lo.astype(jnp.int32), hi.astype(jnp.int32))
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def _run(self) -> None:
+        try:
+            for i in range(self.total_slices):
+                if self._abort:
+                    return
+                start = i * self.slice_len
+                if self.packed:
+                    self._slices.append(
+                        np.asarray(_slice_rows(self._dev, start,
+                                               self.slice_len)))
+                else:
+                    lo_d, hi_d = self._dev
+                    self._slices.append(
+                        (np.asarray(_slice_rows(lo_d, start, self.slice_len)),
+                         np.asarray(_slice_rows(hi_d, start,
+                                                self.slice_len))))
+                self.done_slices = i + 1
+        except Exception:
+            self.failed = True
+        finally:
+            self._dev = None  # release the device buffer promptly
+
+    def finished(self) -> bool:
+        return not self.failed and self.done_slices >= self.total_slices
+
+    def remaining_bytes(self) -> int:
+        return (self.total_slices - self.done_slices) * self.slice_len \
+            * self.bytes_per_link
+
+    def join(self) -> None:
+        self._thread.join()
+
+    def abort(self) -> None:
+        self._abort = True
+        self._thread.join()
+
+    def fetched_bytes(self) -> int:
+        return self.done_slices * self.slice_len * self.bytes_per_link
+
+    def collect(self) -> tuple[np.ndarray, np.ndarray]:
+        """Host (lo, hi) of every fetched slice (unfiltered — dead
+        sentinel slots remain; callers mask lo < n)."""
+        if not self._slices:
+            return (np.empty(0, np.int32), np.empty(0, np.int32))
+        if self.packed:
+            from .forest import unpack_links_6b
+            return unpack_links_6b(np.concatenate(self._slices))
+        los, his = zip(*self._slices)
+        return np.concatenate(los), np.concatenate(his)
+
+
+class _SpecHandoff:
+    """Speculative overlapped handoff policy (VERDICT r04 item 1).
+
+    Soundness: every chunk output has the same threshold connectivity as
+    the input links (ops.forest module proof), the elimination forest is
+    a function of threshold connectivity only, and the native union-find
+    accepts an arbitrary-order multiset — so ANY complete snapshot hands
+    off exactly, and a UNION of (partial or complete) snapshots does too
+    (connectivity of a union of same-connectivity sets is unchanged).
+    That makes speculation free of correctness risk: partial buffers from
+    abandoned fetches are simply kept and fed to the union-find alongside
+    one complete snapshot; the only cost of a wrong guess is bytes.
+
+    Policy: once live <= SHEEP_OVERLAP_SPEC_FACTOR * n (default 8) and
+    the snapshot is at least SHEEP_OVERLAP_MIN_MB (default 4), start
+    streaming it while the chunk loop keeps reducing.  At each later
+    chunk: if the stream finished, stop the loop (the handoff set is
+    already on the host — remaining device rounds would be pure waste);
+    if the bytes still in flight exceed 1.25x a fresh fetch of the
+    now-smaller snapshot, abandon (keeping the partial) and restart on
+    the smaller one.  At loop end, either wait out the stream (when its
+    remainder is cheaper than a fresh final fetch) or abandon and fetch
+    the final set directly.  On a fast link the stream wins early and
+    skips device rounds; on a slow link the rule degrades to today's
+    serial fetch, minus nothing.
+    """
+
+    MARGIN = 1.25
+
+    def __init__(self, n: int):
+        self.n = n
+        self.bpl = 6 if n < (1 << 24) else 8
+        self.spec_live = int(os.environ.get(
+            "SHEEP_OVERLAP_SPEC_FACTOR", "8")) * n
+        self.slice_links = int(os.environ.get(
+            "SHEEP_OVERLAP_SLICE", str(1 << 18)))
+        self.min_bytes = int(float(os.environ.get(
+            "SHEEP_OVERLAP_MIN_MB", "4")) * (1 << 20))
+        self.active: _StreamFetcher | None = None
+        self.kept: list[tuple[np.ndarray, np.ndarray]] = []
+        self.dead = False  # a failed fetch disables further speculation
+        self.stats: dict = {"overlap": True, "spec_starts": 0,
+                            "spec_restarts": 0, "spec_wasted_mb": 0.0,
+                            "spec_stopped_loop": False,
+                            "spec_mode": "never_started"}
+
+    @staticmethod
+    def maybe(n: int) -> "_SpecHandoff | None":
+        from ..core.forest import native_or_none
+        if not _overlap_enabled() or native_or_none("auto") is None:
+            return None
+        return _SpecHandoff(n)
+
+    def _start(self, lo, hi, live: int) -> None:
+        try:
+            self.active = _StreamFetcher(lo, hi, self.n, live,
+                                         self.slice_links)
+            self.stats["spec_starts"] += 1
+            self.stats.setdefault("spec_start_live", live)
+        except Exception:
+            self.active = None
+            self.dead = True
+
+    def _abandon(self) -> None:
+        f = self.active
+        self.active = None
+        if f is None:
+            return
+        f.abort()
+        self.stats["spec_wasted_mb"] = round(
+            self.stats["spec_wasted_mb"] + f.fetched_bytes() / (1 << 20), 2)
+        if not f.failed and f.done_slices:
+            self.kept.append(f.collect())
+        if f.failed:
+            self.dead = True
+
+    def on_chunk(self, lo, hi, live) -> bool:
+        """reduce_links_hosted ``watch`` hook: True stops the loop."""
+        live = int(live)
+        if self.dead:
+            return False
+        if self.active is not None:
+            if self.active.failed:
+                self._abandon()
+                return False
+            if self.active.finished():
+                self.stats["spec_stopped_loop"] = True
+                return True
+            if self.active.remaining_bytes() > \
+                    live * self.bpl * self.MARGIN:
+                self.stats["spec_restarts"] += 1
+                self._abandon()
+                if not self.dead:
+                    self._start(lo, hi, live)
+            return False
+        if live <= self.spec_live and live * self.bpl >= self.min_bytes:
+            self._start(lo, hi, live)
+        return False
+
+    def abort_all(self) -> None:
+        """Converged without a handoff: nothing to collect."""
+        if self.active is not None:
+            self.active.abort()
+            self.active = None
+        self.kept = []
+
+    def complete(self, lo, hi, live: int) -> tuple[np.ndarray, np.ndarray]:
+        """Produce the host handoff link set at loop end: one complete
+        snapshot (streamed or freshly fetched) plus any kept partials."""
+        live = int(live)
+        mode = "plain"
+        lo_h = hi_h = None
+        f = self.active
+        if f is not None and not f.failed:
+            if f.finished():
+                mode = "spec_complete"
+            elif f.remaining_bytes() <= live * self.bpl:
+                mode = "spec_wait"
+                f.join()
+            else:
+                self._abandon()
+                f = None
+                mode = "restart_final"
+            if f is not None and not f.failed:
+                lo_h, hi_h = f.collect()
+                self.active = None
+        if lo_h is None:
+            # never started / failed / abandoned-at-end: fetch the final
+            # reduced set the serial way (production fetch policy)
+            lo_h, hi_h, _ = fetch_links_host(lo, hi, live, self.n)
+            if mode not in ("restart_final",):
+                mode = "plain"
+        if self.kept:
+            klo, khi = zip(*self.kept)
+            lo_h = np.concatenate([lo_h, *klo])
+            hi_h = np.concatenate([hi_h, *khi])
+            self.kept = []
+        keep = lo_h < self.n
+        self.stats["spec_mode"] = mode
+        return np.ascontiguousarray(lo_h[keep]), \
+            np.ascontiguousarray(hi_h[keep])
+
+
+def reduce_and_fetch_links(lo, hi, n: int, stop_live: int,
+                           handoff_input: bool = False, perf=None):
+    """THE production reduce+handoff middle of the hybrid, shared with
+    scripts/hybrid_profile so the profiler can never drift from what the
+    hybrid ships: chunk rounds to ``stop_live`` with the speculative
+    overlapped fetch on accelerators (:class:`_SpecHandoff`; serial
+    fetch elsewhere).
+
+    Returns (kind, a, b, live, rounds) where kind is "device" (converged
+    before the threshold: a/b are device link arrays for
+    parent_from_links) or "host" (a/b are host int arrays of the fetched
+    handoff links, already lo<n-filtered).  ``perf``, when a dict, gains
+    loop_s / fetch_tail_s (the serialized equivalents of the old
+    profiler's reduce / d2h phases) and the speculation counters.
+    """
+    from .forest import reduce_links_hosted
+
+    spec = _SpecHandoff.maybe(n)
+    t0 = time.perf_counter()
+    lo, hi, live, rounds, converged = reduce_links_hosted(
+        lo, hi, n, stop_live=stop_live, handoff_input=handoff_input,
+        watch=spec.on_chunk if spec is not None else None)
+    t1 = time.perf_counter()
+    if converged:
+        if spec is not None:
+            spec.abort_all()
+        if perf is not None:
+            perf["loop_s"] = round(t1 - t0, 4)
+            perf["fetch_tail_s"] = 0.0
+            if spec is not None:
+                perf.update(spec.stats)
+        return "device", lo, hi, int(live), rounds
+    if spec is not None:
+        lo_h, hi_h = spec.complete(lo, hi, int(live))
+    else:
+        lo_h, hi_h, _ = fetch_links_host(lo, hi, int(live), n)
+    if perf is not None:
+        perf["loop_s"] = round(t1 - t0, 4)
+        perf["fetch_tail_s"] = round(time.perf_counter() - t1, 4)
+        if spec is not None:
+            perf.update(spec.stats)
+    return "host", lo_h, hi_h, int(live), rounds
+
+
+def finish_native_host(lo_h: np.ndarray, hi_h: np.ndarray, n: int, pst_h):
+    """Exact union-find tail on HOST link arrays: returns (parent, pst)
+    uint32 [n].  pst_h may be a zero-arg callable resolved here — after
+    the link fetch — so a caller's prefetch thread keeps overlapping it."""
+    if callable(pst_h):
+        pst_h = pst_h()
+    from ..core.forest import native_or_none
+    native = native_or_none("auto")
+    if native is not None:
+        return native.build_forest_links(
+            lo_h.astype(np.uint32), hi_h.astype(np.uint32), n, pst_h)
+    from ..core.forest import build_forest_links
+    forest = build_forest_links(lo_h.astype(np.int64),
+                                hi_h.astype(np.int64), n, pst=pst_h,
+                                impl="python")
+    return forest.parent, forest.pst_weight
+
+
 def handoff_finish_native(lo, hi, live: int, n: int, pst_h):
     """Fetch a reduced link set and finish with the exact sequential
     union-find (the hybrid tail): returns (parent, pst) uint32 [n].
@@ -343,19 +662,5 @@ def handoff_finish_native(lo, hi, live: int, n: int, pst_h):
     6-byte-packed where the link is byte-bound (SHEEP_PACK_HANDOFF
     overrides; needs n < 2^24).
     """
-    import os
-
-    from ..core.forest import native_or_none
-
     lo_h, hi_h, _ = fetch_links_host(lo, hi, live, n)
-    if callable(pst_h):
-        pst_h = pst_h()
-    native = native_or_none("auto")
-    if native is not None:
-        return native.build_forest_links(
-            lo_h.astype(np.uint32), hi_h.astype(np.uint32), n, pst_h)
-    from ..core.forest import build_forest_links
-    forest = build_forest_links(lo_h.astype(np.int64),
-                                hi_h.astype(np.int64), n, pst=pst_h,
-                                impl="python")
-    return forest.parent, forest.pst_weight
+    return finish_native_host(lo_h, hi_h, n, pst_h)

@@ -486,7 +486,8 @@ def _sorted_once(lo: jnp.ndarray, hi: jnp.ndarray):
 def reduce_links_hosted(lo, hi, n: int, stop_live: int = 0,
                         levels: int = 10, jrounds: int = 8,
                         first_levels: int = 4,
-                        handoff_input: bool = False):
+                        handoff_input: bool = False,
+                        watch=None):
     """Run chunk rounds until convergence (or until live <= stop_live),
     compacting between dispatches.
 
@@ -494,6 +495,16 @@ def reduce_links_hosted(lo, hi, n: int, stop_live: int = 0,
     (lo, hi, live, rounds, converged) with lo/hi on device, all remaining
     live links in the first ``live`` slots' prefix region (plus possibly a
     few dead ones — callers must still mask lo < n).
+
+    ``watch`` — optional hook called after each sorted chunk's stats land
+    with the snapshot ``(lo, hi, live)``: immutable device arrays with the
+    live-prefix guarantee, in the ORIGINAL vertex space only (the hook is
+    skipped once a vertex remap is active).  Returning True stops the loop
+    right there (returned converged=False).  This is how the hybrid's
+    overlapped speculative handoff (ops.build) fetches an early snapshot
+    concurrently with later chunks: every chunk output has the same
+    threshold connectivity, so any complete snapshot — or a union of
+    snapshots — hands off soundly.
 
     A sort-free jump-only opener round runs first, then chunks follow
     ``_CHUNK_SCHEDULE`` and repeat ``jrounds``; lifting depth escalates
@@ -569,6 +580,8 @@ def reduce_links_hosted(lo, hi, n: int, stop_live: int = 0,
             return lo, hi, live_i, rounds, True
         if stop_live and live_i <= stop_live:
             lo, hi = _restore(lo, hi)
+            return lo, hi, live_i, rounds, False
+        if watch is not None and back is None and watch(lo, hi, live_i):
             return lo, hi, live_i, rounds, False
         target = _pad_pow2(live_i)
         if target <= lo.shape[0] // 2:

@@ -16,8 +16,17 @@ counts, collective costs, and phase splits scale with W, and becomes a
 true throughput curve the moment a multi-chip window exists).  On an
 accelerator backend it uses however many real devices exist.
 
+Round 5 adds the collective-volume model per variant (the VERDICT r04
+item-4 evidence): per-worker logical payload bytes and a ring-model
+wire-bytes estimate, plus ``collective_reduction_vs_nogather`` — the
+gather-tail's cut vs the round-4 all-rounds-pmin shape.  Honesty note:
+on the VIRTUAL mesh the gather arm's ``total_s`` at W>1 reads slower
+because one core computes the replicated tail W times; on real hardware
+that tail is parallel wall-time while each avoided pmin round saves a
+real dispatch + all-reduce.  The bytes columns are exact on both.
+
 Usage: python scripts/mesh_bench.py [log_n] [edge_factor] [workers_csv]
-Defaults: 2^18, 8, "1,2,4,8".  Writes MESHBENCH_r04.json at the repo root
+Defaults: 2^18, 8, "1,2,4,8".  Writes MESHBENCH_r05.json at the repo root
 when run at the default size or larger (smaller runs only print).
 """
 
@@ -72,17 +81,39 @@ def main() -> None:
         t2d, h2d = stage_edges_2d(tail, head, n, mesh)
         jax.block_until_ready((t2d, h2d))
         row = {"workers": w}
-        for label, unified in (("unified", True), ("split", False)):
+        # unified (gather-tail default ON, the round-5 production path) /
+        # unified_nogather (the round-4 all-rounds-pmin shape, the comm
+        # model's baseline) / split (the reference's transportable-
+        # partials shape)
+        # gather_tail pinned explicitly on BOTH unified arms: an
+        # inherited SHEEP_MESH_GATHER_TAIL=0 would otherwise silently
+        # turn the comparison into nogather-vs-nogather
+        variants = (("unified", True, True), ("unified_nogather", True,
+                                              False), ("split", False, None))
+        for label, unified, gt in variants:
             best = None
             for _ in range(reps + 1):  # +1 warmup/compile
                 tm = {}
+                comm: dict = {}
                 t0 = time.perf_counter()
                 _, _, _, parent, _ = build_links_chunked_sharded(
-                    t2d, h2d, n, mesh, timings=tm, unified=unified)
+                    t2d, h2d, n, mesh, timings=tm, unified=unified,
+                    gather_tail=gt, comm=comm)
                 total = time.perf_counter() - t0
                 tm["total_s"] = total
+                tm["comm"] = comm
                 if best is None or total < best["total_s"]:
                     best = tm
+            comm = best["comm"]
+            # collective-volume model (VERDICT r04 item 4): per-worker
+            # logical payload, plus the ring-allreduce wire model
+            # (aggregate bytes over all W links: 2(W-1) x payload per
+            # all-reduce; all_gather delivers (W-1) x shard to each of
+            # W workers)
+            payload = comm.get("pmin_payload_bytes", 0) \
+                + comm.get("gather_payload_bytes", 0)
+            wire = 2 * (w - 1) * comm.get("pmin_payload_bytes", 0) \
+                + (w - 1) * comm.get("gather_payload_bytes", 0)
             row[label] = {
                 "map_s": round(best["map_s"], 4),
                 "reduce_s": round(best["reduce_s"], 4),
@@ -90,20 +121,33 @@ def main() -> None:
                 "total_s": round(best["total_s"], 4),
                 "map_rounds": best["map_rounds"],
                 "reduce_rounds": best["reduce_rounds"],
+                "sharded_global_rounds": comm.get("sharded_global_rounds"),
+                "tail_rounds": comm.get("tail_rounds"),
+                "pmin_payload_bytes": comm.get("pmin_payload_bytes"),
+                "gather_payload_bytes": comm.get("gather_payload_bytes"),
+                "collective_payload_bytes": payload,
+                "ring_wire_bytes": wire,
                 "edges_per_sec": round(e / best["total_s"], 1)}
         row["edges_per_sec"] = row["unified"]["edges_per_sec"]
+        base = row["unified_nogather"]["collective_payload_bytes"]
+        ours = row["unified"]["collective_payload_bytes"]
+        row["collective_reduction_vs_nogather"] = \
+            round(base / ours, 2) if ours else None
         rec["curve"].append(row)
         print(f"mesh_bench: W={w} unified "
               f"{row['unified']['total_s']}s "
-              f"({row['unified']['reduce_rounds']} r) vs split "
-              f"{row['split']['total_s']}s "
-              f"({row['split']['map_rounds']}+"
-              f"{row['split']['reduce_rounds']} r) -> "
+              f"({row['unified']['sharded_global_rounds']} pmin r + "
+              f"{row['unified']['tail_rounds']} tail r, "
+              f"{ours / 1e6:.1f}MB payload) vs nogather "
+              f"{row['unified_nogather']['total_s']}s "
+              f"({base / 1e6:.1f}MB) = "
+              f"{row['collective_reduction_vs_nogather']}x cut; split "
+              f"{row['split']['total_s']}s -> "
               f"{row['edges_per_sec']:.0f} edges/s", file=sys.stderr)
 
     if log_n >= 18:
         out = os.path.join(os.path.dirname(os.path.dirname(
-            os.path.abspath(__file__))), "MESHBENCH_r04.json")
+            os.path.abspath(__file__))), "MESHBENCH_r05.json")
         with open(out, "w") as f:
             f.write(json.dumps(rec) + "\n")
     print(json.dumps(rec))

@@ -170,22 +170,23 @@ def build_graph_streaming_hosted(blocks, n: int, pos: np.ndarray,
     # handoff threshold and let the native union-find chase the residue —
     # the device-convergence tail was measured at hundreds of rounds on
     # the last few thousand links (SCALE_r03: 781 total rounds).
-    from .build import (default_handoff_factor, handoff_finish_native,
-                        handoff_input_ok)
-    carry_lo, carry_hi, live, rounds, converged = reduce_links_hosted(
+    from .build import (default_handoff_factor, finish_native_host,
+                        handoff_input_ok, reduce_and_fetch_links)
+    # same production reduce+fetch as the hybrid, including the
+    # overlapped speculative handoff stream on accelerators
+    kind, a, b, live, rounds = reduce_and_fetch_links(
         carry_lo, carry_hi, n, stop_live=default_handoff_factor() * n,
         handoff_input=handoff_input_ok())
     total_rounds += rounds
     pst_np = np.asarray(pst).astype(np.uint32)
-    if converged:
-        parent = parent_from_links(carry_lo, carry_hi, n)
+    if kind == "device":  # converged before the handoff threshold
+        parent = parent_from_links(a, b, n)
         parent_np = np.asarray(parent).astype(np.int64)
         out = np.full(n, INVALID_JNID, dtype=np.uint32)
         live_mask = parent_np < n
         out[live_mask] = parent_np[live_mask].astype(np.uint32)
         return Forest(out, pst_np), total_rounds
-    parent_h, pst_out = handoff_finish_native(
-        carry_lo, carry_hi, live, n, pst_np)
+    parent_h, pst_out = finish_native_host(a, b, n, pst_np)
     return Forest(parent_h.copy(), pst_out.copy()), total_rounds
 
 

@@ -50,7 +50,7 @@ import numpy as np
 _BASELINE_EDGES_PER_SEC = 1_468_364_884 / 18.7  # twitter map, 18 MPI ranks
 
 
-def _last_onchip_pointer() -> dict | None:
+def _last_onchip_pointer(search_dir: str | None = None) -> dict | None:
     """Headline of the newest committed on-chip sweep (TPU_BENCH_*.json),
     for embedding in a CPU-fallback record — VERDICT r04 item 5: a
     scoreboard reading only BENCH_r0N must still see that a real chip
@@ -58,7 +58,7 @@ def _last_onchip_pointer() -> dict | None:
     """
     import glob
     best: tuple[str, dict] | None = None
-    repo = os.path.dirname(os.path.abspath(__file__))
+    repo = search_dir or os.path.dirname(os.path.abspath(__file__))
     for path in glob.glob(os.path.join(repo, "TPU_BENCH*.json")):
         try:
             with open(path) as f:
@@ -72,7 +72,8 @@ def _last_onchip_pointer() -> dict | None:
                 continue
             if not isinstance(rec, dict) or "value" not in rec:
                 continue
-            if "_cpu_fallback" in rec.get("metric", "") or rec.get("_partial"):
+            if "_cpu_fallback" in (rec.get("metric") or "") \
+                    or rec.get("_partial"):
                 continue
             utc = rec.get("_utc", "")
             if best is None or utc > best[1].get("_utc", ""):

@@ -120,3 +120,43 @@ def test_last_record_picks_newest_record_line(bench):
     assert bench.last_record(out)["edges_per_sec"] == 2.0
     assert bench.last_record(b"") is None
     assert bench.last_record(None) is None
+
+
+def test_last_onchip_pointer_picks_newest_clean_record(tmp_path):
+    """VERDICT r04 item 5: the CPU-fallback record must point at the
+    newest committed on-chip sweep — skipping cpu_fallback-tagged and
+    _partial records — without ever substituting it into `value`."""
+    import json
+
+    import bench
+
+    def w(name, rec):
+        (tmp_path / name).write_text(json.dumps(rec) + "\n")
+
+    w("TPU_BENCH_r03.json", {"metric": "device_build_edges_per_sec_x",
+                             "value": 1.0, "unit": "edges/sec",
+                             "vs_baseline": 0.01,
+                             "_utc": "2026-07-30T00:00:00Z"})
+    w("TPU_BENCH_r04.json", {"metric": "device_build_edges_per_sec_y",
+                             "value": 2.0, "unit": "edges/sec",
+                             "vs_baseline": 0.02,
+                             "_utc": "2026-07-31T00:00:00Z"})
+    # newer but disqualified records must lose
+    w("TPU_BENCH_r05.json", {"metric": "device_build_edges_per_sec_cpu_fallback",
+                             "value": 9.0, "unit": "edges/sec",
+                             "vs_baseline": 0.09,
+                             "_utc": "2026-07-31T10:00:00Z"})
+    w("TPU_BENCH_r05b.json", {"metric": "device_build_edges_per_sec_z",
+                              "value": 9.0, "unit": "edges/sec",
+                              "vs_baseline": 0.09, "_partial": True,
+                              "_utc": "2026-07-31T11:00:00Z"})
+    p = bench._last_onchip_pointer(str(tmp_path))
+    assert p is not None
+    assert p["value"] == 2.0 and p["source"] == "TPU_BENCH_r04.json"
+    assert "NOT this run's measurement" in p["note"]
+
+
+def test_last_onchip_pointer_empty_dir(tmp_path):
+    import bench
+
+    assert bench._last_onchip_pointer(str(tmp_path)) is None

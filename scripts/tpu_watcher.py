@@ -275,6 +275,11 @@ def build_queue() -> list[Step]:
         Step("ab_overlap_off", [PY, "scripts/hybrid_profile.py", "20"],
              f"TPU_AB_{ROUND}.jsonl", 1800,
              env={"SHEEP_OVERLAP_HANDOFF": "0"}, append=True),
+        # pipelined chunk dispatch (round-5): default-ON arm is
+        # profile_20; this is the off arm (classic sync-per-chunk loop)
+        Step("ab_pipeline_off", [PY, "scripts/hybrid_profile.py", "20"],
+             f"TPU_AB_{ROUND}.jsonl", 1800,
+             env={"SHEEP_PIPELINE_CHUNKS": "0"}, append=True),
         # 5. per-op ceiling proof at 2^22 (VERDICT item 2 fallback evidence)
         Step("diag_hist_22", [PY, "scripts/tpu_diag.py", "hist", "22"],
              f"TPU_DIAG22_{ROUND}.jsonl", 1500, append=True),

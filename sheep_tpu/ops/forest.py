@@ -433,6 +433,18 @@ def _vremap_enabled() -> bool:
     return os.environ.get("SHEEP_VREMAP", "1") != "0"
 
 
+def _pipeline_chunks() -> bool:
+    """Pipelined chunk dispatch gate (SHEEP_PIPELINE_CHUNKS overrides):
+    default ON off-cpu — each hidden sync is a real ~80ms tunnel round
+    trip there — and OFF on the cpu backend, where the stats fetch is
+    instant and the one-chunk-late compaction would only cost width."""
+    import os
+    v = os.environ.get("SHEEP_PIPELINE_CHUNKS", "")
+    if v != "":
+        return v == "1"
+    return jax.devices()[0].platform != "cpu"
+
+
 #: per-chunk round counts — probe every round while live is collapsing
 #: (rounds 1-3 kill 85-93% of edges, and an early stop at the knee saves
 #: both compute and handoff transfer), then batch rounds once the arrays
@@ -564,6 +576,42 @@ def reduce_links_hosted(lo, hi, n: int, stop_live: int = 0,
     # an already-converged input just costs one cheap sorted chunk below.
     lo, hi, _ = jump_chunk(lo, hi, n, first_levels)
     rounds += 1
+    # Pipelined dispatch (round 5, SHEEP_PIPELINE_CHUNKS; default ON
+    # off-cpu): keep the NEXT chunk in flight while the previous chunk's
+    # stats make the ~80ms tunnel round trip, so per-chunk sync hides
+    # behind device compute.  Sound one-chunk-late compaction: live
+    # counts decrease monotonically across chunks and rewrites never
+    # resurrect a dead slot, so every live link of chunk k+1's output
+    # sits within the first pad(live_k) slots.  Costs: the in-flight
+    # chunk runs at the pre-compaction width, and a stop/convergence is
+    # detected one chunk late (that chunk's output is discarded and its
+    # rounds uncounted).  Disabled once a vertex remap engages (the
+    # remap needs exact state; the pipeline drains first).
+    pipeline = _pipeline_chunks()
+    prev = None  # (lo, hi, stats) of the chunk whose stats are unread
+
+    def _consume(stats, alo, ahi, rounds_ret):
+        """THE exit policy after a chunk's stats resolve, shared by the
+        sync, pipelined, and drain sites so they cannot drift: returns
+        (exit_tuple | None, live).  A non-None exit_tuple is the loop's
+        return value, arrays restored to the original vertex space."""
+        moved_i, live_i = (int(x) for x in np.asarray(stats))  # one sync
+        if moved_i == 0:
+            rlo, rhi = _restore(alo, ahi)
+            return (rlo, rhi, live_i, rounds_ret, True), live_i
+        if stop_live and live_i <= stop_live:
+            rlo, rhi = _restore(alo, ahi)
+            return (rlo, rhi, live_i, rounds_ret, False), live_i
+        if watch is not None and back is None and watch(alo, ahi, live_i):
+            return (alo, ahi, live_i, rounds_ret, False), live_i
+        return None, live_i
+
+    def _compact(alo, ahi, live_i):
+        target = _pad_pow2(live_i)
+        if target <= alo.shape[0] // 2:
+            return alo[:target], ahi[:target]
+        return alo, ahi
+
     while True:
         j = _CHUNK_SCHEDULE[chunk_i] if chunk_i < len(_CHUNK_SCHEDULE) \
             else jrounds
@@ -571,23 +619,41 @@ def reduce_links_hosted(lo, hi, n: int, stop_live: int = 0,
         lv = _depth_tier(int(lo.shape[0]), pad,
                          chunk_i < len(_CHUNK_SCHEDULE),
                          levels, first_levels, cap)
-        lo, hi, stats = fixpoint_chunk(lo, hi, n_cur, lv, j)
+        nlo, nhi, stats = fixpoint_chunk(lo, hi, n_cur, lv, j)
         rounds += j
         chunk_i += 1
-        moved_i, live_i = (int(x) for x in np.asarray(stats))  # one sync
-        if moved_i == 0:
-            lo, hi = _restore(lo, hi)
-            return lo, hi, live_i, rounds, True
-        if stop_live and live_i <= stop_live:
-            lo, hi = _restore(lo, hi)
-            return lo, hi, live_i, rounds, False
-        if watch is not None and back is None and watch(lo, hi, live_i):
-            return lo, hi, live_i, rounds, False
-        target = _pad_pow2(live_i)
-        if target <= lo.shape[0] // 2:
-            lo, hi = lo[:target], hi[:target]
+        if not (pipeline and back is None):
+            exit_t, live_i = _consume(stats, nlo, nhi, rounds)
+            if exit_t is not None:
+                return exit_t
+            lo, hi = _compact(nlo, nhi, live_i)
+        else:
+            if prev is not None:
+                plo, phi, pstats = prev
+                # resolves while the chunk dispatched above runs; on an
+                # exit the in-flight chunk is discarded, its rounds
+                # uncounted (rounds - j)
+                exit_t, live_i = _consume(pstats, plo, phi, rounds - j)
+                if exit_t is not None:
+                    return exit_t
+                # one-chunk-late compaction of the IN-FLIGHT output
+                nlo, nhi = _compact(nlo, nhi, live_i)
+            prev = (nlo, nhi, stats)
+            lo, hi = nlo, nhi
         cols = int(lo.shape[0])
         if remap_on and 2 * cols <= n_cur // 4 and n_cur > (1 << 16):
+            if prev is not None:
+                # drain the pipeline: the remap needs exact, settled
+                # state (prev's arrays ARE lo/hi here)
+                _, _, pstats = prev
+                prev = None
+                exit_t, live_i = _consume(pstats, lo, hi, rounds)
+                if exit_t is not None:
+                    return exit_t
+                lo, hi = _compact(lo, hi, live_i)
+                cols = int(lo.shape[0])
+                if not (2 * cols <= n_cur // 4):
+                    continue  # exact compaction voided the remap trigger
             # each remap shrinks table work >= 4x; the O(n_cur) forward
             # table build amortizes over every remaining round
             lo, hi, back_step = vremap_compact(lo, hi, n_cur, 2 * cols)

@@ -1,0 +1,118 @@
+"""Pipelined chunk dispatch (SHEEP_PIPELINE_CHUNKS, round 5): the host
+loop keeps the next chunk in flight while the previous chunk's stats
+resolve, compacting one chunk late.  Must be bit-identical to the
+classic loop through every exit path (convergence, stop_live, watch
+early-stop, vremap drain) — the accelerator default is ON, so the CPU
+tests force the gate."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from conftest import random_multigraph
+
+from sheep_tpu.core import build_forest, degree_sequence
+
+
+def _links(tail, head, n):
+    import jax.numpy as jnp
+    from sheep_tpu.ops.build import prepare_links
+    return prepare_links(jnp.asarray(tail, jnp.int32),
+                         jnp.asarray(head, jnp.int32), n)
+
+
+@pytest.mark.parametrize("trial", range(4))
+def test_pipelined_fixpoint_matches_classic(monkeypatch, trial):
+    from sheep_tpu.ops.forest import forest_fixpoint_hosted
+
+    rng = np.random.default_rng(4200 + trial)
+    tail, head = random_multigraph(rng, n_max=300, e_max=4000)
+    n = int(max(tail.max(initial=0), head.max(initial=0))) + 1
+    _, _, _, lo, hi, _ = _links(tail, head, n)
+    monkeypatch.setenv("SHEEP_PIPELINE_CHUNKS", "0")
+    classic, r0 = forest_fixpoint_hosted(lo, hi, n)
+    monkeypatch.setenv("SHEEP_PIPELINE_CHUNKS", "1")
+    piped, r1 = forest_fixpoint_hosted(lo, hi, n)
+    np.testing.assert_array_equal(np.asarray(classic), np.asarray(piped))
+
+
+@pytest.mark.parametrize("factor", [1, 4])
+def test_pipelined_stop_live_links_rebuild_oracle(monkeypatch, factor):
+    """Early stop one chunk late still returns a connectivity-complete
+    link set: rebuilding the forest from it matches the oracle."""
+    from sheep_tpu.ops.forest import reduce_links_hosted
+    from sheep_tpu.ops.build import finish_native_host
+
+    rng = np.random.default_rng(4300 + factor)
+    tail, head = random_multigraph(rng, n_max=400, e_max=6000)
+    n = int(max(tail.max(initial=0), head.max(initial=0))) + 1
+    want_seq = degree_sequence(tail, head)
+    want = build_forest(tail, head, want_seq)
+    m = len(want_seq)
+    _, _, _, lo, hi, pst = _links(tail, head, n)
+    monkeypatch.setenv("SHEEP_PIPELINE_CHUNKS", "1")
+    lo2, hi2, live, rounds, converged = reduce_links_hosted(
+        lo, hi, n, stop_live=factor * n)
+    lo_h = np.asarray(lo2)
+    hi_h = np.asarray(hi2)
+    keep = lo_h < n
+    parent, pst_out = finish_native_host(
+        lo_h[keep], hi_h[keep], n, np.asarray(pst, np.uint32)[:n])
+    np.testing.assert_array_equal(parent[:m], want.parent)
+    np.testing.assert_array_equal(pst_out[:m], want.pst_weight)
+
+
+def test_pipelined_hybrid_with_overlap(monkeypatch):
+    """Both round-5 mechanisms forced together on cpu: pipelined
+    dispatch + speculative overlapped handoff, end to end."""
+    from sheep_tpu.ops import build_graph_hybrid
+
+    monkeypatch.setenv("SHEEP_PIPELINE_CHUNKS", "1")
+    monkeypatch.setenv("SHEEP_OVERLAP_HANDOFF", "1")
+    monkeypatch.setenv("SHEEP_OVERLAP_MIN_MB", "0.0001")
+    monkeypatch.setenv("SHEEP_OVERLAP_SLICE", "4096")
+    from sheep_tpu.utils import rmat_edges
+    tail, head = rmat_edges(13, 8 << 13, seed=9)
+    want_seq = degree_sequence(tail, head)
+    want = build_forest(tail, head, want_seq)
+    seq, forest = build_graph_hybrid(tail, head, handoff_factor=2)
+    np.testing.assert_array_equal(seq, want_seq)
+    np.testing.assert_array_equal(forest.parent, want.parent)
+    np.testing.assert_array_equal(forest.pst_weight, want.pst_weight)
+
+
+def test_pipelined_vremap_drain(monkeypatch):
+    """Sparse links over a big position space force the vertex remap;
+    under pipelining the loop must drain and still match the oracle
+    (the remap path the hybrid's partial builds exercise)."""
+    from sheep_tpu.ops.forest import forest_fixpoint_hosted
+
+    rng = np.random.default_rng(4400)
+    n = 1 << 17  # big position space
+    e = 2000     # sparse links -> 2*cols <= n/4 fires
+    import jax.numpy as jnp
+    lo_np = rng.integers(0, n - 1, e)
+    hi_np = np.minimum(lo_np + 1 + rng.integers(0, 64, e), n - 1)
+    keep = lo_np < hi_np
+    lo_np, hi_np = lo_np[keep], hi_np[keep]
+    lo = jnp.asarray(lo_np, jnp.int32)
+    hi = jnp.asarray(hi_np, jnp.int32)
+    monkeypatch.setenv("SHEEP_PIPELINE_CHUNKS", "0")
+    classic, _ = forest_fixpoint_hosted(lo, hi, n)
+    monkeypatch.setenv("SHEEP_PIPELINE_CHUNKS", "1")
+    piped, _ = forest_fixpoint_hosted(lo, hi, n)
+    np.testing.assert_array_equal(np.asarray(classic), np.asarray(piped))
+
+
+def test_pipeline_gate_defaults(monkeypatch):
+    import jax
+    from sheep_tpu.ops.forest import _pipeline_chunks
+
+    monkeypatch.delenv("SHEEP_PIPELINE_CHUNKS", raising=False)
+    if jax.devices()[0].platform == "cpu":
+        assert _pipeline_chunks() is False
+    monkeypatch.setenv("SHEEP_PIPELINE_CHUNKS", "1")
+    assert _pipeline_chunks() is True
+    monkeypatch.setenv("SHEEP_PIPELINE_CHUNKS", "0")
+    assert _pipeline_chunks() is False

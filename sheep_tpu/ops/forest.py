@@ -651,9 +651,9 @@ def reduce_links_hosted(lo, hi, n: int, stop_live: int = 0,
                 if exit_t is not None:
                     return exit_t
                 lo, hi = _compact(lo, hi, live_i)
+                # _compact only ever shrinks, so the remap trigger
+                # (checked on the pre-drain width) still holds here
                 cols = int(lo.shape[0])
-                if not (2 * cols <= n_cur // 4):
-                    continue  # exact compaction voided the remap trigger
             # each remap shrinks table work >= 4x; the O(n_cur) forward
             # table build amortizes over every remaining round
             lo, hi, back_step = vremap_compact(lo, hi, n_cur, 2 * cols)

@@ -221,6 +221,15 @@ def build_queue() -> list[Step]:
                        "SHEEP_BENCH_SIZES": "16,18,20,22,23",
                        "SHEEP_BENCH_LOG_N": ""}
     q = [
+        # 0. canary: one cheap 2^16 profile through the FULL round-5
+        # production path (overlap + pipelined dispatch, both new this
+        # round and never yet run on the real backend) — bounds the
+        # blast radius if either misbehaves on the tunnel (900s, vs the
+        # sweep's per-size 2400s x 5) and warms the compile cache for
+        # the sweep that follows.  Its record is also the first
+        # committed on-chip artifact of the window.
+        Step("canary_16", [PY, "scripts/hybrid_profile.py", "16"],
+             f"TPU_CANARY_{ROUND}.json", 900),
         # 1. the benchmark of record FIRST — windows have closed mid-queue
         # three times; the gating artifact gets the freshest minutes, and
         # a timeout still salvages bench_progress.json per-size records.

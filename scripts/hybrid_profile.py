@@ -2,10 +2,12 @@
 
 Usage: python scripts/hybrid_profile.py LOG_N [HANDOFF_FACTOR]
 
-Prints one JSON line with per-phase seconds for the SECOND run (first run
-pays compiles).  Phases: h2d (edge transfer), prep (prepare_links),
-reduce (chunk rounds incl. between-chunk syncs), d2h (link fetch),
-native (C++ union-find tail + Forest build).
+Prints one JSON line with per-phase seconds for the BEST of
+SHEEP_PROFILE_REPS timed runs (default 2) after one untimed compile run;
+every rep's total is kept in ``totals`` so window-variance is visible.
+Phases: h2d (edge transfer), prep (prepare_links), reduce (chunk rounds
+incl. between-chunk syncs), d2h (link fetch tail), native (C++
+union-find tail + Forest build).
 """
 
 from __future__ import annotations
@@ -99,11 +101,24 @@ def main() -> None:
         return parent_h
 
     one(None)  # compile
-    rec = {"op": "hybrid_profile", "log_n": log_n, "platform": platform,
-           "handoff_factor": factor}
-    t0 = time.perf_counter()
-    one(rec)
-    rec["total"] = round(time.perf_counter() - t0, 4)
+    # multiple timed reps (SHEEP_PROFILE_REPS, default 2): the tunnel's
+    # rate varies ~15x within a window (PERF_NOTES), so single-shot A/B
+    # deltas are weakly attributable; the record keeps every rep's total
+    # and reports the best rep's phase breakdown
+    reps = max(1, int(os.environ.get("SHEEP_PROFILE_REPS", "2")))
+    best_rec = None
+    totals = []
+    for _ in range(reps):
+        rec = {"op": "hybrid_profile", "log_n": log_n, "platform": platform,
+               "handoff_factor": factor}
+        t0 = time.perf_counter()
+        one(rec)
+        rec["total"] = round(time.perf_counter() - t0, 4)
+        totals.append(rec["total"])
+        if best_rec is None or rec["total"] < best_rec["total"]:
+            best_rec = rec
+    rec = best_rec
+    rec["totals"] = totals
     e = len(tail)
     rec["edges_per_sec"] = round(e / rec["total"], 1)
     print(json.dumps(rec))

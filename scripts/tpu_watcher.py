@@ -230,9 +230,11 @@ def build_queue() -> list[Step]:
         # committed on-chip artifact of the window.
         Step("canary_16", [PY, "scripts/hybrid_profile.py", "16"],
              f"TPU_CANARY_{ROUND}.json", 900),
-        # 1. the benchmark of record FIRST — windows have closed mid-queue
-        # three times; the gating artifact gets the freshest minutes, and
-        # a timeout still salvages bench_progress.json per-size records.
+        # 1. the benchmark of record right after the canary — windows
+        # have closed mid-queue three times, so the gating artifact gets
+        # the freshest minutes after the 900s-bounded canary has proven
+        # the round-5 defaults run on this backend, and a timeout still
+        # salvages bench_progress.json per-size records.
         # Step timeout covers the worst case: 5 sizes x (300s startup +
         # 2400s budget) = 13500s, so a slow-but-passing sweep is never
         # killed before its final record prints.

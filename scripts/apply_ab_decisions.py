@@ -44,8 +44,11 @@ def _records(path: str) -> list[dict]:
 
 
 def _arm(records: list[dict], step: str) -> dict | None:
+    # accel-only, mirroring the watcher's _on_accel: a cpu-fallback
+    # record (tunnel died before the arm ran) must read as NO DATA,
+    # never as an on-chip verdict
     hits = [r for r in records if r.get("_step") == step
-            and not r.get("_partial")]
+            and not r.get("_partial") and r.get("platform") != "cpu"]
     return hits[-1] if hits else None
 
 

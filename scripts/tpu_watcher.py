@@ -250,11 +250,15 @@ def build_queue() -> list[Step]:
         # 2. window characterization (transfer rates, dispatch floor)
         Step("tunnel_probe", [PY, "scripts/tunnel_probe.py"],
              f"TPU_TUNNEL_{ROUND}.json", 900),
-        # 2. phase profile at the two sizes that matter
+        # 2. phase profile at the two sizes that matter.  Budgets cover
+        # hybrid_profile's round-5 shape: one compile run + TWO timed
+        # reps (SHEEP_PROFILE_REPS default 2), and the JSON only prints
+        # at the end — an undersized budget would kill the step with no
+        # salvageable record every window.
         Step("profile_20", [PY, "scripts/hybrid_profile.py", "20"],
-             f"TPU_PROFILE_{ROUND}.jsonl", 1800, append=True),
+             f"TPU_PROFILE_{ROUND}.jsonl", 2400, append=True),
         Step("profile_22", [PY, "scripts/hybrid_profile.py", "22"],
-             f"TPU_PROFILE_{ROUND}.jsonl", 2700, append=True),
+             f"TPU_PROFILE_{ROUND}.jsonl", 4000, append=True),
         # 3. pallas fast-path probe (stage 1 gate, then kernel race)
         Step("pallas_probe", [PY, "scripts/pallas_probe.py", "20"],
              f"TPU_PALLAS_{ROUND}.json", 1800),
@@ -264,32 +268,32 @@ def build_queue() -> list[Step]:
              f"TPU_PALLASRACE_{ROUND}.json", 1800),
         # 4. shipped-but-unmeasured transfer A/Bs (handoff factor, packing)
         Step("ab_handoff_1", [PY, "scripts/hybrid_profile.py", "20", "1"],
-             f"TPU_AB_{ROUND}.jsonl", 1800, append=True),
+             f"TPU_AB_{ROUND}.jsonl", 2400, append=True),
         Step("ab_handoff_8", [PY, "scripts/hybrid_profile.py", "20", "8"],
-             f"TPU_AB_{ROUND}.jsonl", 1800, append=True),
+             f"TPU_AB_{ROUND}.jsonl", 2400, append=True),
         # pack A/B must run with overlap OFF: the overlapped stream packs
         # purely on n < 2^24 and never consults SHEEP_PACK_HANDOFF, so
         # with overlap on both arms would measure identical transfers
         Step("ab_pack_off", [PY, "scripts/hybrid_profile.py", "20"],
-             f"TPU_AB_{ROUND}.jsonl", 1800,
+             f"TPU_AB_{ROUND}.jsonl", 2400,
              env={"SHEEP_PACK_HANDOFF": "0",
                   "SHEEP_OVERLAP_HANDOFF": "0"}, append=True),
         # packed single-key link sort on the chip (cpu default, off on
         # accelerators until this A/B: s64 is emulated in 32-bit lanes,
         # so the 4.2x XLA:CPU win may invert on the TPU)
         Step("ab_sort_pack64", [PY, "scripts/hybrid_profile.py", "20"],
-             f"TPU_AB_{ROUND}.jsonl", 1800,
+             f"TPU_AB_{ROUND}.jsonl", 2400,
              env={"SHEEP_SORT_PACK64": "1"}, append=True),
         # overlapped speculative handoff (round-5, VERDICT item 1):
         # profile_20/profile_22 above run the default-ON overlap; this is
         # the off arm at the same size.  Decision rule in PERF_NOTES.
         Step("ab_overlap_off", [PY, "scripts/hybrid_profile.py", "20"],
-             f"TPU_AB_{ROUND}.jsonl", 1800,
+             f"TPU_AB_{ROUND}.jsonl", 2400,
              env={"SHEEP_OVERLAP_HANDOFF": "0"}, append=True),
         # pipelined chunk dispatch (round-5): default-ON arm is
         # profile_20; this is the off arm (classic sync-per-chunk loop)
         Step("ab_pipeline_off", [PY, "scripts/hybrid_profile.py", "20"],
-             f"TPU_AB_{ROUND}.jsonl", 1800,
+             f"TPU_AB_{ROUND}.jsonl", 2400,
              env={"SHEEP_PIPELINE_CHUNKS": "0"}, append=True),
         # 5. per-op ceiling proof at 2^22 (VERDICT item 2 fallback evidence)
         Step("diag_hist_22", [PY, "scripts/tpu_diag.py", "hist", "22"],

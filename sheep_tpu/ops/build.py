@@ -422,12 +422,30 @@ class _StreamFetcher:
         return (self.total_slices - self.done_slices) * self.slice_len \
             * self.bytes_per_link
 
-    def join(self) -> None:
-        self._thread.join()
+    def join(self, timeout: float | None = None,
+             mark_failed: bool = True) -> bool:
+        """Wait for the stream; True if it is STILL RUNNING afterwards.
+        A wedged transfer (sick tunnel mid-slice) must never block the
+        build forever — ``mark_failed`` callers treat a timed-out join
+        as failed and fall back to the serial fetch, bounded by the
+        caller's own budget.  The daemon thread is left behind; slice
+        appends are atomic, so a later collect() snapshot stays
+        consistent."""
+        self._thread.join(timeout)
+        alive = self._thread.is_alive()
+        if alive and mark_failed:
+            self.failed = True
+        return alive
 
-    def abort(self) -> None:
+    def abort(self, timeout: float = 5.0) -> None:
+        """Stop at the next slice boundary; wait only briefly.  A
+        slow-but-healthy in-flight slice (queued behind pipelined chunk
+        dispatches) must NOT poison the fetcher as failed — the caller
+        keeps whatever slices have landed and the thread drains itself
+        within one slice; mark_failed=False so only a real _run
+        exception disables later speculation."""
         self._abort = True
-        self._thread.join()
+        self.join(timeout, mark_failed=False)
 
     def fetched_bytes(self) -> int:
         return self.done_slices * self.slice_len * self.bytes_per_link
@@ -562,7 +580,10 @@ class _SpecHandoff:
                 mode = "spec_complete"
             elif f.remaining_bytes() <= live * self.bpl:
                 mode = "spec_wait"
-                f.join()
+                # generous watchdog: remaining bytes at a worst-observed
+                # 0.5MB/s tunnel trough plus grace; a wedged stream must
+                # not hold the build (falls back to the serial fetch)
+                f.join(timeout=f.remaining_bytes() / 5e5 + 120.0)
             else:
                 self._abandon()
                 f = None
@@ -574,7 +595,16 @@ class _SpecHandoff:
             # never started / failed / abandoned-at-end: fetch the final
             # reduced set the serial way (production fetch policy)
             lo_h, hi_h, _ = fetch_links_host(lo, hi, live, self.n)
-            if mode not in ("restart_final",):
+            if mode == "spec_wait":
+                # the watchdog fired mid-wait: record it honestly (the
+                # A/B decision reader must distinguish a wedged stream
+                # from one that never started) and count its bytes
+                mode = "spec_wait_timeout"
+                if f is not None:
+                    self.stats["spec_wasted_mb"] = round(
+                        self.stats["spec_wasted_mb"]
+                        + f.fetched_bytes() / (1 << 20), 2)
+            elif mode not in ("restart_final",):
                 mode = "plain"
         if self.kept:
             klo, khi = zip(*self.kept)

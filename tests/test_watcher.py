@@ -78,9 +78,13 @@ def test_queue_is_consistent(watcher):
     q = watcher.build_queue()
     names = [s.name for s in q]
     assert len(names) == len(set(names)), "duplicate step names"
-    # the benchmark of record must be first (windows close mid-queue)
-    assert names[0] == "bench_sweep"
-    assert q[0].sidecar == "bench_progress.json"
+    # round-5 ordering policy: a 900s-bounded canary proves the new
+    # overlap+pipeline defaults run on the backend, then the benchmark
+    # of record gets the freshest minutes (windows close mid-queue)
+    assert names[0] == "canary_16"
+    assert q[0].timeout <= 900
+    assert names[1] == "bench_sweep"
+    assert q[1].sidecar == "bench_progress.json"
     # non-append steps must not share an output file (they overwrite)
     plain_outs = [s.out for s in q if not s.append]
     assert len(plain_outs) == len(set(plain_outs))

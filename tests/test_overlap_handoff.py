@@ -193,6 +193,7 @@ def test_stream_fetcher_abort_keeps_prefix(overlap_env):
     f = _StreamFetcher(jnp.asarray(lo, jnp.int32), jnp.asarray(hi, jnp.int32),
                        n, pad, slice_links=1024)
     f.abort()  # immediate abort: whatever slices landed must be a prefix
+    assert f.failed is False, "abort must not poison a healthy stream"
     got_lo, got_hi = f.collect()
     k = len(got_lo)
     assert k % 1024 == 0 and k == f.done_slices * 1024
@@ -260,3 +261,80 @@ def test_hybrid_overlap_rmat_larger(overlap_env):
     np.testing.assert_array_equal(seq, want_seq)
     np.testing.assert_array_equal(forest.parent, want.parent)
     np.testing.assert_array_equal(forest.pst_weight, want.pst_weight)
+
+
+def test_spec_wait_timeout_falls_back_serial(overlap_env):
+    """A wedged stream (join watchdog fires) must fall back to the
+    serial fetch, record mode=spec_wait_timeout, count the wasted
+    bytes, and still produce the exact link set."""
+    import sheep_tpu.ops.build as b
+
+    n = 1 << 12
+    rng = np.random.default_rng(97)
+    pad = 1 << 13
+    lo_np = np.full(pad, n, np.int64)
+    hi_np = np.full(pad, n, np.int64)
+    live = 6000
+    lo_np[:live] = rng.integers(0, n - 1, live)
+    hi_np[:live] = np.minimum(lo_np[:live] + 1, n - 1)
+    import jax.numpy as jnp
+    lo = jnp.asarray(lo_np, jnp.int32)
+    hi = jnp.asarray(hi_np, jnp.int32)
+
+    sp = b._SpecHandoff(n)
+
+    class WedgedFetcher:
+        failed = False
+        done_slices = 1
+        def finished(self):
+            return False
+        def remaining_bytes(self):
+            return 1  # tiny remainder -> complete() takes the wait path
+        def join(self, timeout=None, mark_failed=True):
+            if mark_failed:
+                self.failed = True  # watchdog fired
+            return True
+        def abort(self, timeout=5.0):
+            pass
+        def fetched_bytes(self):
+            return 3 << 20
+        def collect(self):
+            raise AssertionError("collect must not run on a wedged stream")
+
+    sp.active = WedgedFetcher()
+    lo_h, hi_h = sp.complete(lo, hi, live)
+    assert sp.stats["spec_mode"] == "spec_wait_timeout"
+    assert sp.stats["spec_wasted_mb"] >= 3.0
+    # pairwise multiset check: both halves of every link must survive
+    order_got = np.lexsort((hi_h, lo_h))
+    order_want = np.lexsort((hi_np[:live], lo_np[:live]))
+    np.testing.assert_array_equal(lo_h[order_got],
+                                  lo_np[:live][order_want])
+    np.testing.assert_array_equal(hi_h[order_got],
+                                  hi_np[:live][order_want])
+    assert len(lo_h) == len(hi_h) == live
+
+
+def test_abort_slow_stream_does_not_poison(overlap_env):
+    """abort() on a slow-but-healthy stream must not mark it failed or
+    disable later speculation; landed slices stay collectable."""
+    from sheep_tpu.ops.build import _SpecHandoff
+
+    sp = _SpecHandoff(1 << 16)
+
+    class SlowFetcher:
+        failed = False
+        done_slices = 2
+        def join(self, timeout=None, mark_failed=True):
+            return True  # still draining, but abort passes mark_failed=False
+        def abort(self, timeout=5.0):
+            self.join(timeout, mark_failed=False)
+        def fetched_bytes(self):
+            return 2 << 20
+        def collect(self):
+            return (np.zeros(100, np.int32), np.ones(100, np.int32))
+
+    sp.active = SlowFetcher()
+    sp._abandon()
+    assert sp.dead is False, "slow abort must not disable speculation"
+    assert len(sp.kept) == 1, "landed partial slices must be kept"

@@ -282,14 +282,14 @@ def _run_one(log_n: int) -> dict:
     t.block_until_ready(), h.block_until_ready()
     h2d_s = time.perf_counter() - t0  # one-time edge upload (load phase)
 
-    def device_build():
+    def device_build(perf=None):
         seq, pos, m, lo, hi, pst = prepare_links(t, h, n)
         parent, rounds = forest_fixpoint_hosted(lo, hi, n)
         # async dispatch on the tunneled backend: force completion with a
         # scalar fetch that depends on the whole parent array
         return int(jnp.max(parent)), rounds
 
-    def hybrid_build():
+    def hybrid_build(perf=None):
         # edges are device-resident (t, h) before the clock starts, same
         # as device_build: the reference's 78.5M edges/s baseline is the
         # MAP phase with the graph already in each rank's RAM (load and
@@ -303,7 +303,7 @@ def _run_one(log_n: int) -> dict:
         # recompute seq/pst host-side (bit-identical) instead of fetching
         # 2n*4B through the ~10MB/s tunnel (on cpu the fetch is free)
         he = (tail, head) if platform != "cpu" else None
-        return build_graph_hybrid(t, h, n, host_edges=he)
+        return build_graph_hybrid(t, h, n, host_edges=he, perf=perf)
 
     rec = {"log_n": log_n, "edges": e, "platform": platform,
            "h2d_s": round(h2d_s, 4)}
@@ -319,14 +319,26 @@ def _run_one(log_n: int) -> dict:
             continue
         out = fn()  # warmup / compile (all chunk shapes)
         times = []
+        perfs = []
         for _ in range(reps):
+            p: dict = {}
             t0 = time.perf_counter()
-            fn()
+            fn(p)
             times.append(time.perf_counter() - t0)
+            perfs.append(p)
         best = min(times)
         rec[name] = {"best_s": round(best, 4),
                      "times": [round(x, 4) for x in times],
                      "edges_per_sec": round(e / best, 1)}
+        # overlap/pipeline observability for on-chip interpretation: the
+        # best rep's reduce+fetch breakdown and speculation counters
+        # (hybrid only; keys are set by reduce_and_fetch_links)
+        best_perf = perfs[times.index(best)]
+        if best_perf:
+            rec[name]["perf"] = {k: v for k, v in best_perf.items()
+                                 if k in ("loop_s", "fetch_tail_s",
+                                          "overlap")
+                                 or k.startswith("spec_")}
         if name == "device":
             rec[name]["rounds"] = int(out[1])
         print(f"bench: n=2^{log_n} {name}: {e / best:.0f} edges/s "

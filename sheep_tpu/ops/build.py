@@ -125,7 +125,8 @@ def build_graph_hybrid(tail: np.ndarray, head: np.ndarray,
                        num_vertices: int | None = None,
                        handoff_factor: int | None = None,
                        host_edges: tuple[np.ndarray, np.ndarray] | None = None,
-                       seq: np.ndarray | None = None):
+                       seq: np.ndarray | None = None,
+                       perf: dict | None = None):
     """Flagship heterogeneous build: TPU reduction + native union-find tail.
 
     The device runs the bandwidth-parallel phases (histogram, degree sort,
@@ -154,6 +155,10 @@ def build_graph_hybrid(tail: np.ndarray, head: np.ndarray,
     way, but 2n*4B less d2h traffic, which on a tunneled backend
     (~10MB/s, scripts/tunnel_probe.py) is seconds at 2^22+.  Numpy
     tail/head inputs serve as their own host copy automatically.
+
+    ``perf`` — optional dict receiving the reduce+fetch breakdown and
+    speculation counters (loop_s / fetch_tail_s / overlap / spec_* —
+    see reduce_and_fetch_links), for bench/profile observability.
 
     ``seq`` — an externally given elimination order (the `-s`/`-r` case):
     skips the device degree histogram + sort entirely (two fewer full-E
@@ -249,7 +254,7 @@ def build_graph_hybrid(tail: np.ndarray, head: np.ndarray,
     # streams an early snapshot while later chunks still run).
     kind, a, b, live, rounds = reduce_and_fetch_links(
         lo, hi, n, stop_live=handoff_factor * n,
-        handoff_input=handoff_input_ok())
+        handoff_input=handoff_input_ok(), perf=perf)
     def _pst_resolved():
         # host-prefetched pst when the thread landed it; else the device
         # pst — materialized lazily when prepare_links skipped the scatter

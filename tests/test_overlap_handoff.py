@@ -338,3 +338,24 @@ def test_abort_slow_stream_does_not_poison(overlap_env):
     sp._abandon()
     assert sp.dead is False, "slow abort must not disable speculation"
     assert len(sp.kept) == 1, "landed partial slices must be kept"
+
+
+def test_hybrid_overlap_pair_mode_large_n(overlap_env):
+    """End-to-end hybrid at n >= 2^24 (sparse edges over a huge vertex
+    space): the overlapped stream must take the int32-pair mode (no
+    6-byte packing above 2^24) and stay oracle-exact — the shape the
+    watcher's 2^24 on-chip step runs."""
+    n = (1 << 24) + 1000
+    e = 60_000
+    rng = np.random.default_rng(98)
+    tail = rng.integers(0, n, e).astype(np.uint32)
+    head = rng.integers(0, n, e).astype(np.uint32)
+    from sheep_tpu.ops import build_graph_hybrid
+
+    want_seq, want = _oracle(tail, head)
+    overlap_env.setenv("SHEEP_OVERLAP_SPEC_FACTOR", "100000")
+    seq, forest = build_graph_hybrid(tail, head, num_vertices=n,
+                                     handoff_factor=1)
+    np.testing.assert_array_equal(seq, want_seq)
+    np.testing.assert_array_equal(forest.parent, want.parent)
+    np.testing.assert_array_equal(forest.pst_weight, want.pst_weight)

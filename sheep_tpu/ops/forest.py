@@ -433,6 +433,14 @@ def _vremap_enabled() -> bool:
     return os.environ.get("SHEEP_VREMAP", "1") != "0"
 
 
+def _pipe_width_ok(width: int, pad: int) -> bool:
+    """The pipelined-dispatch width gate: engage only at 4x-compacted
+    AND width <= 2^17 — where one hidden ~80ms RTT outweighs the
+    one-chunk-late compaction's stale-width compute (break-even
+    W ~ 1e5 at j=8 rounds and ~100M elem/s; PERF_NOTES round 5)."""
+    return 4 * width <= pad and width <= (1 << 17)
+
+
 def _pipeline_chunks() -> bool:
     """Pipelined chunk dispatch gate (SHEEP_PIPELINE_CHUNKS overrides):
     default ON off-cpu — each hidden sync is a real ~80ms tunnel round
@@ -622,7 +630,23 @@ def reduce_links_hosted(lo, hi, n: int, stop_live: int = 0,
         nlo, nhi, stats = fixpoint_chunk(lo, hi, n_cur, lv, j)
         rounds += j
         chunk_i += 1
-        if not (pipeline and back is None):
+        # width gate: pipeline only once the arrays are small.  Early
+        # full-width chunks carry most of the compute, and the
+        # one-chunk-late compaction makes them run at stale widths — a
+        # forced-pipeline A/B on the instant-stats cpu backend measured
+        # +29.5% end-to-end ungated and +16.7% gated at 4x-compacted
+        # (PERF_NOTES round 5).  The hidden sync saves one ~80ms RTT;
+        # at the backend's ~100M elem/s a j-round chunk at width W
+        # costs ~j*W*12/1e8 s, so the crossover is W ~ 1e5 at j=8 —
+        # hence the absolute cap alongside the relative one.  Width is
+        # monotone non-increasing, so the mode never flips back.
+        use_pipe = pipeline and back is None \
+            and _pipe_width_ok(int(lo.shape[0]), pad)
+        if not use_pipe:
+            # invariant: the gate can only turn OFF via a remap, which
+            # drains prev first (width is monotone, so the width gate
+            # never un-fires)
+            assert prev is None
             exit_t, live_i = _consume(stats, nlo, nhi, rounds)
             if exit_t is not None:
                 return exit_t

@@ -76,6 +76,43 @@ def maybe_init_distributed() -> int:
     return jax.process_index()
 
 
+def runtime_config_from_opts(opts):
+    """Fold the fault-tolerance long options (--checkpoint-dir DIR,
+    --resume, --max-retries N) plus their env fallbacks
+    (SHEEP_CHECKPOINT_DIR / SHEEP_RESUME / SHEEP_MAX_RETRIES, the
+    dist-partition.sh -C contract) into a runtime.RuntimeConfig.
+
+    Returns None when no checkpoint dir is configured anywhere — the
+    caller then keeps the plain fast path.  --resume / --max-retries
+    without a checkpoint dir are a configuration error (there is nothing
+    to resume from and nothing durable to retry toward): reported, not
+    ignored.
+    """
+    import os
+
+    ckpt_dir = os.environ.get("SHEEP_CHECKPOINT_DIR") or None
+    resume = os.environ.get("SHEEP_RESUME", "") == "1"
+    max_retries = None
+    for o, a in opts:
+        if o == "--checkpoint-dir":
+            ckpt_dir = a
+        elif o == "--resume":
+            resume = True
+        elif o == "--max-retries":
+            max_retries = int(a)
+    if ckpt_dir is None:
+        if resume or max_retries is not None:
+            raise SystemExit(
+                "--resume/--max-retries need --checkpoint-dir (or "
+                "SHEEP_CHECKPOINT_DIR) to name the checkpoint location")
+        return None
+    from ..runtime.driver import RuntimeConfig
+    overrides = {"checkpoint_dir": ckpt_dir, "resume": resume}
+    if max_retries is not None:
+        overrides["max_retries"] = max_retries
+    return RuntimeConfig.from_env(**overrides)
+
+
 class PhaseClock:
     """Elapsed-time phases with duration_cast<milliseconds> truncation."""
 

@@ -62,8 +62,17 @@ def _finish_sort(seq, use_mesh_sort, sequence_filename, clock,
 def main(argv: list[str] | None = None) -> int:
     argv = sys.argv[1:] if argv is None else argv
     try:
-        opts, args = getopt.gnu_getopt(argv, "irl:p:s:o:vkejm:w:xfdtc")
+        # Long options are the fault-tolerance surface (sheep_tpu.runtime):
+        # they have no reference counterpart, so they take GNU spellings
+        # instead of burning more single-letter flags.
+        opts, args = getopt.gnu_getopt(
+            argv, "irl:p:s:o:vkejm:w:xfdtc",
+            ["checkpoint-dir=", "resume", "max-retries="])
     except getopt.GetoptError as exc:
+        if (exc.opt or "").startswith(("checkpoint-dir", "max-retries",
+                                       "resume")):
+            print(f"Option --{exc.opt}: {exc.msg}.")
+            return 1
         o = (exc.opt or "?")[:1]
         if o in ("s", "o", "l"):
             print(f"Option -{o} requires a string.")
@@ -124,6 +133,9 @@ def main(argv: list[str] | None = None) -> int:
         print(USAGE)
         return 1
     graph_filename = args[0]
+
+    from .common import runtime_config_from_opts
+    rt_cfg = runtime_config_from_opts(opts)
 
     clock = PhaseClock()
     use_mesh = use_mesh_sort or use_mesh_reduce
@@ -267,6 +279,17 @@ def main(argv: list[str] | None = None) -> int:
                         break
             edges = EdgeList(edges.tail[a0:b0], edges.head[a0:b0],
                              file_edges=edges.file_edges, start=a0)
+        elif rt_cfg is not None:
+            # Fault-tolerant build (--checkpoint-dir / SHEEP_CHECKPOINT_DIR):
+            # checkpointed chunk loops, retry-with-backoff, and the
+            # mesh -> single-chip -> host degradation ladder.  Bit-identical
+            # results; the pipelined fast paths are traded for survivability.
+            from ..runtime.driver import build_graph_resilient
+            seq, forest = build_graph_resilient(
+                edges.tail, edges.head, num_workers=mesh_workers,
+                seq=given_seq, max_vid=edges.max_vid, config=rt_cfg)
+            _finish_sort(seq, use_mesh_sort, sequence_filename, clock,
+                         leader=is_leader, writer=proc0)
         else:
             seq, forest = build_graph_distributed(
                 edges.tail, edges.head, num_workers=mesh_workers,
@@ -290,6 +313,20 @@ def main(argv: list[str] | None = None) -> int:
                                 width_limit, find_max_width)
             forest, seq, widths = build_forest_jxn(
                 edges.tail, edges.head, seq, jopts)
+        elif rt_cfg is not None:
+            # Serial path with fault tolerance: the single-chip chunked
+            # driver under checkpoint/retry, degrading to the host oracle
+            # (no mesh rung — the user did not ask for -i/-r).
+            import dataclasses
+
+            from .common import ensure_jax_platform
+            ensure_jax_platform()
+            from ..runtime.driver import build_graph_resilient
+            serial_cfg = dataclasses.replace(
+                rt_cfg, ladder=("single", "host"))
+            _, forest = build_graph_resilient(
+                edges.tail, edges.head, seq=seq, max_vid=edges.max_vid,
+                config=serial_cfg)
         else:
             forest = build_forest(edges.tail, edges.head, seq,
                                   max_vid=edges.max_vid)

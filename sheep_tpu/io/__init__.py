@@ -1,3 +1,4 @@
+from .atomic import atomic_write
 from .edges import (
     EdgeList,
     load_edges,
@@ -12,6 +13,7 @@ from .seqfile import read_sequence, write_sequence
 from .trefile import read_tree, write_tree
 
 __all__ = [
+    "atomic_write",
     "EdgeList",
     "load_edges",
     "write_edges",

@@ -1,0 +1,68 @@
+"""Crash-safe file writes: temp file + flush + fsync + atomic rename.
+
+Every durable artifact this package writes (trees, sequences, partition
+edge files, runtime checkpoints) goes through :func:`atomic_write`, so a
+killed process can never leave a half-written file under the final name —
+a reader either sees the old complete file or the new complete one.  This
+is the file-level analog of the shell contract in scripts/lib.sh
+("producers write to a temp name and atomically mv into place"), enforced
+at the library layer so Python callers cannot forget it.
+
+The temp file lives in the SAME directory as the target (rename is only
+atomic within a filesystem), and the directory entry is fsync'd after the
+rename so the new name survives a power loss, not just a process kill.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import tempfile
+
+
+def _fsync_dir(path: str) -> None:
+    """Best-effort fsync of the directory containing ``path`` (some
+    filesystems/platforms disallow opening directories — not fatal)."""
+    d = os.path.dirname(os.path.abspath(path)) or "."
+    try:
+        fd = os.open(d, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
+@contextlib.contextmanager
+def atomic_write(path: str, mode: str = "wb"):
+    """Context manager yielding a file object; on clean exit the data is
+    flushed, fsync'd, and atomically renamed onto ``path``.  On an
+    exception (or a kill) the target is untouched and the temp file is
+    removed (or left as an orphaned dot-file a later run may clean).
+
+    ``mode``: "wb" (default) or "w" for text.
+    """
+    if mode not in ("wb", "w"):
+        raise ValueError(f"atomic_write mode must be 'w' or 'wb', got {mode!r}")
+    d = os.path.dirname(os.path.abspath(path)) or "."
+    base = os.path.basename(path)
+    fd, tmp = tempfile.mkstemp(dir=d, prefix=f".{base}.", suffix=".tmp")
+    f = os.fdopen(fd, mode)
+    try:
+        yield f
+        f.flush()
+        os.fsync(f.fileno())
+        f.close()
+        os.replace(tmp, path)
+        _fsync_dir(path)
+    except BaseException:
+        try:
+            f.close()
+        except Exception:
+            pass
+        with contextlib.suppress(OSError):
+            os.unlink(tmp)
+        raise

@@ -237,15 +237,21 @@ def iter_net_blocks(path: str, block_bytes: int = 1 << 26):
 
 
 def write_dat(path: str, tail: np.ndarray, head: np.ndarray) -> None:
+    # Crash-safe like every writer in this package (io/atomic.py): the
+    # per-part edge files feed the next pipeline stage through a polling
+    # filesystem handoff, so a torn record prefix must be impossible.
+    from .atomic import atomic_write
     rec = np.empty(len(tail), dtype=_XS1_DTYPE)
     rec["tail"] = tail
     rec["head"] = head
     rec["weight"] = 1.0
-    rec.tofile(path)
+    with atomic_write(path, "wb") as f:
+        f.write(rec.tobytes())
 
 
 def write_net(path: str, tail: np.ndarray, head: np.ndarray) -> None:
-    with open(path, "w") as f:
+    from .atomic import atomic_write
+    with atomic_write(path, "w") as f:
         for x, y in zip(tail.tolist(), head.tolist()):
             f.write(f"{x} {y}\n")
 

@@ -10,15 +10,19 @@ from __future__ import annotations
 
 import numpy as np
 
+from .atomic import atomic_write
+
 
 def write_sequence(seq: np.ndarray, path: str, binary: bool = False) -> None:
+    # Crash-safe (see io/atomic.py): downstream workers poll for the .seq
+    # file and must never read a truncated sequence as a complete one.
     seq = np.asarray(seq, dtype=np.uint32)
     if binary:
-        with open(path, "wb") as f:
+        with atomic_write(path, "wb") as f:
             f.write(np.uint64(len(seq)).tobytes())
             f.write(seq.astype("<u4").tobytes())
     else:
-        with open(path, "w") as f:
+        with atomic_write(path, "w") as f:
             f.write("\n".join(map(str, seq.tolist())))
             if len(seq):
                 f.write("\n")

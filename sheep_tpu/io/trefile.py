@@ -12,6 +12,7 @@ from __future__ import annotations
 import numpy as np
 
 from .. import INVALID_JNID
+from .atomic import atomic_write
 
 _NODE_DTYPE = np.dtype([("parent", "<u4"), ("pst_weight", "<u4")])
 
@@ -21,7 +22,10 @@ def write_tree(path: str, parent: np.ndarray, pst_weight: np.ndarray) -> None:
     rec = np.empty(len(parent), dtype=_NODE_DTYPE)
     rec["parent"] = parent
     rec["pst_weight"] = pst_weight
-    with open(path, "wb") as f:
+    # Crash-safe: the shell pipeline polls for .tre files appearing on a
+    # shared filesystem (scripts/lib.sh sheep_wait_for), so a consumer
+    # must never observe a torn header/record prefix from a killed writer.
+    with atomic_write(path, "wb") as f:
         f.write(np.uint32(len(parent)).tobytes())
         f.write(rec.tobytes())
 

@@ -108,11 +108,26 @@ def sort_links(lo: jnp.ndarray, hi: jnp.ndarray):
     ops even under a jit trace of an otherwise-x32 program.
     """
     if _pack64_sorts():
-        with jax.enable_x64():
-            key = (lo.astype(jnp.int64) << 32) | hi.astype(jnp.int64)
+        from ..utils.compat import enable_x64
+        with enable_x64():
+            # pure-lax packing: jnp binary ops re-canonicalize the scalar
+            # operand to i32 on older jax (even inside the scoped x64
+            # context, when tracing under an outer x32 jit), which trips
+            # the StableHLO verifier with i64 << i32.  convert_element_type
+            # + same-shape lax bit ops sidestep dtype canonicalization on
+            # every jax generation.
+            def i64(x):
+                return lax.convert_element_type(x, jnp.int64)
+            shift = i64(jnp.full(lo.shape, 32, jnp.int32))
+            mask = i64(jnp.full(lo.shape, 0xFFFFFFFF, jnp.uint32))
+            key = lax.bitwise_or(lax.shift_left(i64(lo), shift), i64(hi))
             key = lax.sort(key)
-            return ((key >> 32).astype(jnp.int32),
-                    (key & 0xFFFFFFFF).astype(jnp.int32))
+            # values are nonnegative (package-wide int32 contract), so the
+            # logical right shift recovers lo exactly
+            return (lax.convert_element_type(
+                        lax.shift_right_logical(key, shift), jnp.int32),
+                    lax.convert_element_type(
+                        lax.bitwise_and(key, mask), jnp.int32))
     return lax.sort((lo, hi), num_keys=2)
 
 
@@ -503,11 +518,20 @@ def _sorted_once(lo: jnp.ndarray, hi: jnp.ndarray):
     return sort_links(lo, hi)
 
 
+def _live_links_np(lo, hi, n: int):
+    """Host copies of the live links (lo < n) — the checkpointable state
+    at a chunk boundary (runtime/snapshot.py's soundness argument)."""
+    l = np.asarray(lo)
+    h = np.asarray(hi)
+    keep = l < n
+    return l[keep], h[keep]
+
+
 def reduce_links_hosted(lo, hi, n: int, stop_live: int = 0,
                         levels: int = 10, jrounds: int = 8,
                         first_levels: int = 4,
                         handoff_input: bool = False,
-                        watch=None):
+                        watch=None, runtime=None):
     """Run chunk rounds until convergence (or until live <= stop_live),
     compacting between dispatches.
 
@@ -536,6 +560,15 @@ def reduce_links_hosted(lo, hi, n: int, stop_live: int = 0,
     O(n * levels) jump-table work becomes O(cols * levels), which on the
     measured backends is the whole cost of the late phase.  The returned
     links are always back in the original vertex space.
+
+    ``runtime`` — optional runtime.ChunkRuntime: wraps every dispatch in
+    the retry/backoff/watchdog policy (halving the per-dispatch round
+    count on a fault) and checkpoints the live links at each chunk
+    boundary while the loop is still in the original vertex space (once a
+    vertex remap engages, the last pre-remap checkpoint stands — the
+    remap is an optimization detail a resume need not replay).  Fault
+    tolerance trades the pipelined-dispatch overlap away: checkpoint
+    boundaries need settled state, so the pipeline is disabled.
     """
     lo = jnp.asarray(lo, jnp.int32)
     hi = jnp.asarray(hi, jnp.int32)
@@ -582,7 +615,11 @@ def reduce_links_hosted(lo, hi, n: int, stop_live: int = 0,
     # stats are deliberately NOT fetched (each host sync is a ~70ms
     # tunnel round trip, and the streaming path calls this per block);
     # an already-converged input just costs one cheap sorted chunk below.
-    lo, hi, _ = jump_chunk(lo, hi, n, first_levels)
+    if runtime is None:
+        lo, hi, _ = jump_chunk(lo, hi, n, first_levels)
+    else:
+        (lo, hi, _), _ = runtime.dispatch(
+            "chunk", lambda _j: jump_chunk(lo, hi, n, first_levels))
     rounds += 1
     # Pipelined dispatch (round 5, SHEEP_PIPELINE_CHUNKS; default ON
     # off-cpu): keep the NEXT chunk in flight while the previous chunk's
@@ -595,7 +632,7 @@ def reduce_links_hosted(lo, hi, n: int, stop_live: int = 0,
     # detected one chunk late (that chunk's output is discarded and its
     # rounds uncounted).  Disabled once a vertex remap engages (the
     # remap needs exact state; the pipeline drains first).
-    pipeline = _pipeline_chunks()
+    pipeline = _pipeline_chunks() and runtime is None
     prev = None  # (lo, hi, stats) of the chunk whose stats are unread
 
     def _consume(stats, alo, ahi, rounds_ret):
@@ -627,7 +664,13 @@ def reduce_links_hosted(lo, hi, n: int, stop_live: int = 0,
         lv = _depth_tier(int(lo.shape[0]), pad,
                          chunk_i < len(_CHUNK_SCHEDULE),
                          levels, first_levels, cap)
-        nlo, nhi, stats = fixpoint_chunk(lo, hi, n_cur, lv, j)
+        if runtime is None:
+            nlo, nhi, stats = fixpoint_chunk(lo, hi, n_cur, lv, j)
+        else:
+            # the retry wrapper may shrink j (a dispatch that faulted asks
+            # for half the rounds next attempt); account the shrunk value
+            (nlo, nhi, stats), j = runtime.dispatch(
+                "chunk", lambda jj: fixpoint_chunk(lo, hi, n_cur, lv, jj), j)
         rounds += j
         chunk_i += 1
         # width gate: pipeline only once the arrays are small.  Early
@@ -651,6 +694,11 @@ def reduce_links_hosted(lo, hi, n: int, stop_live: int = 0,
             if exit_t is not None:
                 return exit_t
             lo, hi = _compact(nlo, nhi, live_i)
+            if runtime is not None and back is None:
+                # chunk boundary: persist the live multiset (original
+                # vertex space only — the snapshot soundness contract)
+                runtime.boundary(
+                    rounds, lambda: _live_links_np(lo, hi, n))
         else:
             if prev is not None:
                 plo, phi, pstats = prev

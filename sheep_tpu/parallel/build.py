@@ -27,7 +27,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 from jax import lax
-from jax import shard_map
+from ..utils.compat import shard_map
 from jax.sharding import PartitionSpec as P
 
 from .. import INVALID_JNID
@@ -297,7 +297,19 @@ def build_graph_distributed(tail: np.ndarray, head: np.ndarray,
     through the flagship hybrid (device reduction + native union-find
     tail — measured ~4x the pure-device path on-chip), which with a given
     ``seq`` also skips the device degree sort entirely.
+
+    SHEEP_CHECKPOINT_DIR (the scripts' restart contract,
+    dist-partition.sh -C) reroutes through the fault-tolerant runtime:
+    checkpoint/resume at chunk boundaries, retry-with-backoff, and the
+    mesh -> single-chip -> host degradation ladder (sheep_tpu.runtime).
+    Results are bit-identical; the hybrid/pipelined fast paths are
+    traded for survivability.
     """
+    import os
+    if os.environ.get("SHEEP_CHECKPOINT_DIR"):
+        from ..runtime.driver import build_graph_resilient
+        return build_graph_resilient(tail, head, num_vertices=num_vertices,
+                                     num_workers=num_workers, seq=seq)
     mesh = make_mesh(num_workers)
     if mesh.size == 1 and len(tail):
         from ..ops.build import build_graph_hybrid

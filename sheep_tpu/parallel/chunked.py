@@ -61,7 +61,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 from jax import lax
-from jax import shard_map
+from ..utils.compat import shard_map
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..ops.forest import (_CHUNK_SCHEDULE as _SCHEDULE, _depth_tier,
@@ -268,7 +268,7 @@ def reduce_links_sharded(lo, hi, n: int, mesh, global_f: bool,
                          levels: int = _LEVELS, jrounds: int = _JROUNDS,
                          first_levels: int = _FIRST_LEVELS,
                          fetch=None, gather_tail: bool | None = None,
-                         comm: dict | None = None):
+                         comm: dict | None = None, runtime=None):
     """Host-orchestrated chunk loop on [W, B] sharded links.
 
     ``global_f`` False = map phase (per-shard independent), True = reduce
@@ -304,6 +304,16 @@ def reduce_links_sharded(lo, hi, n: int, mesh, global_f: bool,
     (per-worker logical payload bytes): sharded_global_rounds,
     pmin_payload_bytes (4(n+1) per global round), gather_payload_bytes
     (8*W*cols at the handoff), tail_rounds (collective-free).
+
+    ``runtime`` — optional runtime.ChunkRuntime (see
+    ops/forest.reduce_links_hosted): each sharded dispatch runs under the
+    retry/backoff policy (halving jrounds on a fault), and — for global-f
+    (reduce) phases — each chunk boundary checkpoints the link multiset
+    via one all_gather (multi-process safe; the flat union of shard links
+    is the complete, rung-portable build state).  Map phases (global_f
+    False) get retries but no checkpoints: their per-worker partials are
+    not a single multiset.  The gather-tail inherits the same runtime, so
+    checkpointing continues seamlessly once the tail goes replicated.
     """
     fetch = fetch or np.asarray
     cols0 = int(lo.shape[1])
@@ -329,7 +339,7 @@ def reduce_links_sharded(lo, hi, n: int, mesh, global_f: bool,
             from ..ops.forest import reduce_links_hosted
             flat_lo, flat_hi, _, tail_rounds, _ = reduce_links_hosted(
                 flat_lo, flat_hi, n, levels=levels, jrounds=jrounds,
-                first_levels=first_levels)
+                first_levels=first_levels, runtime=runtime)
             rounds += tail_rounds
             if comm is not None:
                 comm["tail_rounds"] += tail_rounds
@@ -347,7 +357,13 @@ def reduce_links_sharded(lo, hi, n: int, mesh, global_f: bool,
             lv = _depth_tier(cols, cols0,
                              chunk_i < len(_SCHEDULE),
                              levels, first_levels, cap)
-        lo, hi, stats = chunk_sharded(lo, hi, n, mesh, lv, j, global_f)
+        if runtime is None:
+            lo, hi, stats = chunk_sharded(lo, hi, n, mesh, lv, j, global_f)
+        else:
+            (lo, hi, stats), j = runtime.dispatch(
+                "mesh_chunk",
+                lambda jj: chunk_sharded(lo, hi, n, mesh, lv, jj, global_f),
+                j)
         rounds += j
         chunk_i += 1
         if comm is not None and global_f:
@@ -359,6 +375,15 @@ def reduce_links_sharded(lo, hi, n: int, mesh, global_f: bool,
         target = _pad_pow2_cols(live_i)
         if target <= int(lo.shape[1]) // 2:
             lo, hi = lo[:, :target], hi[:, :target]
+        if runtime is not None and global_f:
+            # chunk boundary: the flat union of shard links is the
+            # complete resumable state (rung-portable — see driver.py)
+            def _mesh_links(lo=lo, hi=hi):
+                flat_lo, flat_hi = gather_links_replicated(lo, hi, mesh)
+                l, h = fetch(flat_lo), fetch(flat_hi)
+                keep = l < n
+                return l[keep], h[keep]
+            runtime.boundary(rounds, _mesh_links)
 
 
 def _extract_parent(lo, hi, n: int, mesh, gathered: bool):
@@ -378,7 +403,7 @@ def build_links_chunked_sharded(tail_2d, head_2d, n: int, mesh,
                                 pos=None, fetch=None, timings=None,
                                 unified: bool = True,
                                 gather_tail: bool | None = None,
-                                comm: dict | None = None):
+                                comm: dict | None = None, runtime=None):
     """Full chunked mesh build from staged [W, B] edge arrays.
 
     Returns (seq, pos, m, parent, pst) — all replicated device arrays,
@@ -413,19 +438,19 @@ def build_links_chunked_sharded(tail_2d, head_2d, n: int, mesh,
     if unified:
         lo, hi, red_rounds, gathered = reduce_links_sharded(
             lo, hi, n, mesh, global_f=True, fetch=fetch,
-            gather_tail=gather_tail, comm=comm)
+            gather_tail=gather_tail, comm=comm, runtime=runtime)
         map_rounds = 0
         t2 = t1
     else:
         # map: shards reduce independently to per-worker partial forests
         lo, hi, map_rounds, _ = reduce_links_sharded(
-            lo, hi, n, mesh, global_f=False, fetch=fetch)
+            lo, hi, n, mesh, global_f=False, fetch=fetch, runtime=runtime)
         jax.block_until_ready(lo)
         t2 = _time.perf_counter()
         # reduce: global-f rounds stitch the partials into one forest
         lo, hi, red_rounds, gathered = reduce_links_sharded(
             lo, hi, n, mesh, global_f=True, fetch=fetch,
-            gather_tail=gather_tail, comm=comm)
+            gather_tail=gather_tail, comm=comm, runtime=runtime)
     parent = _extract_parent(lo, hi, n, mesh, gathered)
     jax.block_until_ready(parent)
     t3 = _time.perf_counter()

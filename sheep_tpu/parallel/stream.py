@@ -29,13 +29,13 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 from jax import lax
-from jax import shard_map
 from jax.sharding import PartitionSpec as P
 
 from .. import INVALID_JNID
 from ..core.forest import Forest
 from ..ops.forest import forest_fixpoint, links_from_parent
 from ..ops.stream import _full_vid_pos
+from ..utils.compat import shard_map
 from .build import _gather_merge, _links_from_positions, _stage, _fetch
 from .mesh import AXIS, make_mesh
 

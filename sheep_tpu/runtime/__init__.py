@@ -1,0 +1,40 @@
+"""Fault-tolerant chunked-build runtime.
+
+Four modules, layered bottom-up:
+
+  faults.py    deterministic fault injection + the exception taxonomy
+  snapshot.py  resumable checkpoint format (atomic .npz at chunk bounds)
+  retry.py     retry-with-backoff + adaptive round-count shrinking
+  driver.py    checkpointed build driver + the mesh -> single-chip ->
+               host-numpy graceful-degradation ladder
+
+See driver.py's docstring for the failure model and the determinism
+argument (why a resumed or degraded build is bit-identical).
+"""
+
+from .driver import (ChunkRuntime, RuntimeConfig, build_graph_resilient)
+from .faults import (BuildKilled, DeadlineExceeded, FaultPlan,
+                     InjectedDispatchFault, RetryBudgetExhausted, clear_plan,
+                     fault_point, install_plan, reset_counters)
+from .retry import RetryPolicy, run_with_retry
+from .snapshot import Checkpointer, Snapshot, input_signature
+
+__all__ = [
+    "BuildKilled",
+    "Checkpointer",
+    "ChunkRuntime",
+    "DeadlineExceeded",
+    "FaultPlan",
+    "InjectedDispatchFault",
+    "RetryBudgetExhausted",
+    "RetryPolicy",
+    "RuntimeConfig",
+    "Snapshot",
+    "build_graph_resilient",
+    "clear_plan",
+    "fault_point",
+    "input_signature",
+    "install_plan",
+    "reset_counters",
+    "run_with_retry",
+]

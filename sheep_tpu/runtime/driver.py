@@ -1,0 +1,293 @@
+"""The fault-tolerant build driver: checkpointed chunk loops + retry +
+the graceful-degradation ladder.
+
+Failure model (ROADMAP north star: a production system serving heavy
+traffic).  The chunked architecture already bounds each device dispatch
+(ops/forest.py, parallel/chunked.py) because unbounded dispatches fault on
+real hardware; this module makes the HOST loop around those dispatches
+survivable:
+
+  faulted dispatch      retry with exponential backoff, halving the
+                        per-dispatch round count (runtime/retry.py) — a
+                        dispatch that tripped the per-execution budget
+                        asks for half the work next time.
+  killed process        every chunk boundary checkpoints the complete
+                        build state (runtime/snapshot.py); a new process
+                        with ``resume=True`` continues from the last
+                        completed chunk and produces the bit-identical
+                        tree (forest = f(threshold connectivity) only).
+  sick backend          the degradation ladder: mesh-chunked ->
+                        single-chip-chunked -> host numpy union-find.
+                        Every rung consumes the previous rung's
+                        checkpoint, because all rungs reduce the same
+                        link multiset over the same sequence — the
+                        associativity that powers the reference's tree
+                        merge (lib/jnode.cpp:174-201) is exactly what
+                        makes partial state transportable across rungs.
+
+Determinism: pst is order-free and counted once at prep; the parent array
+is the unique elimination forest of the link multiset, so ANY interleaving
+of chunks, retries, resumes, and rung handoffs converges to the same
+output.  The resume-equivalence property test (tests/test_runtime.py)
+kills a build at every chunk boundary and asserts bit-identical parent,
+pst, and ECV(down) against the uninterrupted build.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from .. import INVALID_JNID
+from ..core.forest import Forest, build_forest_links, edges_to_positions
+from ..core.sequence import degree_sequence
+from .faults import (RetryBudgetExhausted, fault_point, is_retryable,
+                     reset_counters)
+from .retry import RetryPolicy, run_with_retry
+from .snapshot import Checkpointer, Snapshot, input_signature
+
+
+@dataclass
+class RuntimeConfig:
+    """One build's fault-tolerance knobs (CLI --checkpoint-dir/--resume/
+    --max-retries; env SHEEP_CHECKPOINT_DIR/SHEEP_RESUME/SHEEP_MAX_RETRIES
+    and friends — the env surface is what scripts/dist-partition.sh -C
+    exports)."""
+
+    checkpoint_dir: str | None = None
+    resume: bool = False
+    max_retries: int = 3
+    backoff_base_s: float = 0.05
+    backoff_cap_s: float = 2.0
+    watchdog_s: float | None = None
+    checkpoint_every: int = 1
+    #: degradation ladder, tried in order.  "mesh" is skipped when fewer
+    #: than two devices are visible; "host" cannot fail (pure numpy).
+    ladder: tuple[str, ...] = ("mesh", "single", "host")
+    #: observable trace of what the runtime did: ("retry", site, attempt,
+    #: j), ("checkpoint", rung, boundary), ("degrade", rung, next, why),
+    #: ("resume", rung, boundary, rounds).  Tests and the CLI -v path
+    #: read this.
+    events: list = field(default_factory=list)
+
+    @classmethod
+    def from_env(cls, **overrides) -> "RuntimeConfig":
+        env = os.environ
+        kw: dict = dict(
+            checkpoint_dir=env.get("SHEEP_CHECKPOINT_DIR") or None,
+            resume=env.get("SHEEP_RESUME", "") == "1",
+            max_retries=int(env.get("SHEEP_MAX_RETRIES", "3")),
+            backoff_base_s=float(env.get("SHEEP_BACKOFF_BASE", "0.05")),
+            checkpoint_every=int(env.get("SHEEP_CHECKPOINT_EVERY", "1")),
+        )
+        if env.get("SHEEP_WATCHDOG_S"):
+            kw["watchdog_s"] = float(env["SHEEP_WATCHDOG_S"])
+        kw.update(overrides)
+        return cls(**kw)
+
+    def policy(self) -> RetryPolicy:
+        return RetryPolicy(max_retries=self.max_retries,
+                           backoff_base_s=self.backoff_base_s,
+                           backoff_cap_s=self.backoff_cap_s,
+                           watchdog_s=self.watchdog_s)
+
+
+class ChunkRuntime:
+    """The per-rung context the chunk drivers thread their host-sync
+    boundaries through (ops/forest.reduce_links_hosted and
+    parallel/chunked.reduce_links_sharded accept one as ``runtime=``).
+
+    ``dispatch`` wraps one device dispatch in the retry/watchdog/fault-
+    injection policy; ``boundary`` checkpoints the live link multiset at a
+    completed chunk and is itself a fault-injection site ("boundary" —
+    the kill point of the resume property test).
+    """
+
+    def __init__(self, policy: RetryPolicy, checkpointer: Checkpointer | None,
+                 events: list, rung: str, n: int, seq: np.ndarray,
+                 pst: np.ndarray, input_sig: str, rounds_base: int = 0):
+        self.policy = policy
+        self.ckpt = checkpointer
+        self.events = events
+        self.rung = rung
+        self.n = n
+        self.seq = seq
+        self.pst = pst
+        self.input_sig = input_sig
+        self.rounds_base = rounds_base
+
+    def dispatch(self, site: str, fn, j: int | None = None):
+        """Run dispatch ``fn(j)`` under the retry policy.  Returns
+        (outputs, j_used) — ``j_used`` may have shrunk."""
+        def on_retry(s, attempt, jj):
+            self.events.append(("retry", s, attempt, jj))
+        return run_with_retry(self.policy, site, fn, j, on_retry)
+
+    def boundary(self, rounds: int, links_fn) -> None:
+        """One completed chunk boundary.  ``links_fn() -> (lo, hi)`` host
+        int32 live links in the ORIGINAL vertex space (called only when
+        this boundary is on the checkpoint cadence — it may cost a device
+        fetch or an all_gather)."""
+        if self.ckpt is None:
+            return
+        if self.ckpt.want():
+            lo, hi = links_fn()
+            self.ckpt.save(Snapshot(
+                n=self.n, seq=self.seq, pst=self.pst,
+                lo=np.asarray(lo, np.int32), hi=np.asarray(hi, np.int32),
+                rounds=self.rounds_base + rounds, boundary=0,
+                rung=self.rung, input_sig=self.input_sig))
+            self.events.append(("checkpoint", self.rung,
+                                self.ckpt.boundary - 1))
+        else:
+            self.ckpt.skip()
+        # the deterministic kill point: "died between chunks"
+        fault_point("boundary")
+
+
+# ---------------------------------------------------------------------------
+# Ladder rungs.  Contract: (lo, hi int32 live links, n, runtime,
+# num_workers) -> parent array; int32 with n marking roots (device rungs)
+# or uint32 with INVALID_JNID (host rung) — both normalized by the driver.
+# All rungs reduce the same link multiset, so any rung may pick up any
+# other rung's checkpoint.
+# ---------------------------------------------------------------------------
+
+
+def _rung_mesh(lo, hi, n, rt, num_workers):
+    import jax
+
+    from ..parallel.build import _fetch
+    from ..parallel.chunked import (_extract_parent, reduce_links_sharded,
+                                    stage_edges_2d)
+    from ..parallel.mesh import make_mesh
+
+    w = num_workers or len(jax.devices())
+    mesh = make_mesh(min(w, len(jax.devices())))
+    lo2d, hi2d = stage_edges_2d(lo, hi, n, mesh)
+    slo, shi, _, gathered = reduce_links_sharded(
+        lo2d, hi2d, n, mesh, global_f=True, fetch=_fetch, runtime=rt)
+    return _fetch(_extract_parent(slo, shi, n, mesh, gathered))
+
+
+def _rung_single(lo, hi, n, rt, num_workers):
+    import jax.numpy as jnp
+
+    from ..ops.forest import parent_from_links, reduce_links_hosted
+
+    flo, fhi, _, _, _ = reduce_links_hosted(
+        jnp.asarray(lo, jnp.int32), jnp.asarray(hi, jnp.int32), n,
+        runtime=rt)
+    return np.asarray(parent_from_links(flo, fhi, n))
+
+
+def _rung_host(lo, hi, n, rt, num_workers):
+    # the floor of the ladder: exact numpy/native union-find, no device
+    # dispatches, cannot fault.  pst is NOT recounted here — the driver
+    # already holds the order-free pst from prep (these links may be
+    # chunk-rewritten, so per-link counting would be wrong anyway).
+    zero = np.zeros(n, dtype=np.uint32)
+    forest = build_forest_links(lo.astype(np.int64), hi.astype(np.int64), n,
+                                pst=zero)
+    return forest.parent
+
+
+_RUNGS = {"mesh": _rung_mesh, "single": _rung_single, "host": _rung_host}
+
+
+def _ladder_rungs(config: RuntimeConfig, num_workers) -> list[str]:
+    import jax
+
+    rungs = [r for r in config.ladder if r in _RUNGS]
+    devs = len(jax.devices())
+    if devs < 2 or (num_workers is not None and num_workers < 2):
+        rungs = [r for r in rungs if r != "mesh"]
+    return rungs or ["host"]
+
+
+def build_graph_resilient(tail, head, num_vertices=None, num_workers=None,
+                          seq=None, max_vid=None,
+                          config: RuntimeConfig | None = None):
+    """Fault-tolerant build: (seq uint32 [m], Forest over m), same contract
+    as parallel.build.build_graph_distributed.
+
+    ``config.resume`` continues from the checkpoint in
+    ``config.checkpoint_dir`` (written by a previous, killed invocation of
+    this function over the same input — verified by signature); without a
+    usable checkpoint it falls through to a fresh build.  The result is
+    bit-identical either way (module docstring).
+    """
+    config = config or RuntimeConfig.from_env()
+    reset_counters()
+    policy = config.policy()
+    events = config.events
+    ckpt = Checkpointer(config.checkpoint_dir, config.checkpoint_every) \
+        if config.checkpoint_dir else None
+
+    tail = np.asarray(tail)
+    head = np.asarray(head)
+    seq_h = np.asarray(seq, dtype=np.uint32) if seq is not None \
+        else degree_sequence(tail, head)
+    n = len(seq_h)
+    if n == 0:
+        return np.empty(0, np.uint32), Forest(
+            np.empty(0, np.uint32), np.empty(0, np.uint32))
+    sig = input_signature(n, seq_h, tail, head)
+
+    snap = ckpt.load() if (ckpt is not None and config.resume) else None
+    rungs = _ladder_rungs(config, num_workers)
+    if snap is not None:
+        snap.verify(sig)
+        pst = snap.pst
+        lo, hi = snap.lo, snap.hi
+        rounds = snap.rounds
+        if snap.rung in rungs:  # restart at the rung that wrote it
+            rungs = rungs[rungs.index(snap.rung):]
+        events.append(("resume", snap.rung, snap.boundary, rounds))
+    else:
+        # host prep: exact core semantics (deterministic, rung-neutral).
+        # lo of every kept record is a present position < n; hi >= n marks
+        # pst-only links (absent endpoint) excluded from the tree links.
+        lo64, hi64 = edges_to_positions(tail, head, seq_h, max_vid)
+        pst = np.bincount(lo64, minlength=n)[:n].astype(np.uint32)
+        tree = hi64 < n
+        lo = lo64[tree].astype(np.int32)
+        hi = hi64[tree].astype(np.int32)
+        rounds = 0
+
+    parent = None
+    for i, rung in enumerate(rungs):
+        rt = ChunkRuntime(policy, ckpt, events, rung, n, seq_h, pst, sig,
+                          rounds_base=rounds)
+        if snap is None and i == 0:
+            # boundary 0 = "prep complete": a kill during the first chunk
+            # resumes without re-running the degree sort / link mapping
+            rt.boundary(0, lambda: (lo, hi))
+        try:
+            parent = _RUNGS[rung](lo, hi, n, rt, num_workers)
+            break
+        except Exception as exc:
+            retryable = isinstance(exc, RetryBudgetExhausted) \
+                or is_retryable(exc)
+            if not retryable or i + 1 >= len(rungs):
+                raise
+            events.append(("degrade", rung, rungs[i + 1],
+                           f"{type(exc).__name__}: {exc}"))
+            if ckpt is not None:
+                # pick up whatever progress the failed rung checkpointed
+                mid = ckpt.load()
+                if mid is not None:
+                    mid.verify(sig)
+                    lo, hi, rounds = mid.lo, mid.hi, mid.rounds
+    if parent is None:  # pragma: no cover - host rung cannot fail
+        raise RuntimeError("degradation ladder exhausted without a result")
+
+    pa = np.asarray(parent).astype(np.int64)
+    out = np.full(n, INVALID_JNID, dtype=np.uint32)
+    live = (pa >= 0) & (pa < n)
+    out[live] = pa[live].astype(np.uint32)
+    if ckpt is not None:
+        ckpt.clear()  # build complete: a later --resume starts fresh
+    return seq_h, Forest(out, pst.astype(np.uint32))

@@ -1,0 +1,156 @@
+"""Resumable build snapshots: the checkpoint format + atomic persistence.
+
+What makes a mid-build checkpoint SOUND here is a structural property of
+the whole architecture (ops/forest.py module docstring): every chunk
+transform preserves threshold connectivity, and the elimination forest is a
+function of threshold connectivity only.  So the complete build state at
+any chunk boundary is just
+
+    (sequence, pst accumulator, live link multiset, round counter)
+
+— no schedule position, no lifting depth, no device state.  A build
+resumed from ANY boundary snapshot converges to the bit-identical parent
+array, because every trajectory over the same link multiset reaches the
+same (unique) forest; and pst is order-free (counted once from the
+original links before any reduction).  The same property is what lets the
+degradation ladder hand a snapshot from the mesh rung to the single-chip
+rung to the host oracle: all rungs operate on the same link multiset over
+the same sequence.
+
+On disk a snapshot is ONE uncompressed ``.npz`` written crash-safely
+(io/atomic.py: temp + fsync + atomic rename), so the file under the final
+name is always a complete, self-consistent checkpoint — a kill mid-write
+leaves the previous checkpoint in place.  An ``input_sig`` (sha256 over
+the vertex count, sequence, and edge bytes) guards against resuming
+someone else's build: a mismatch is an error, not a silent wrong tree.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+from dataclasses import dataclass
+
+import numpy as np
+
+from ..io.atomic import atomic_write
+
+SNAPSHOT_NAME = "sheep-ckpt.npz"
+_VERSION = 1
+
+
+def input_signature(n: int, seq: np.ndarray,
+                    tail: np.ndarray | None = None,
+                    head: np.ndarray | None = None) -> str:
+    """Stable identity of a build input.  Edge bytes are included when the
+    caller still has them (one linear pass); a resume deliberately hashes
+    the same fields so mismatched graphs are rejected up front."""
+    h = hashlib.sha256()
+    h.update(f"v{_VERSION}:n{n}:".encode())
+    h.update(np.ascontiguousarray(seq, dtype=np.uint32).tobytes())
+    for arr in (tail, head):
+        if arr is not None:
+            h.update(np.ascontiguousarray(arr, dtype=np.uint32).tobytes())
+    return h.hexdigest()
+
+
+@dataclass
+class Snapshot:
+    """One resumable build state (see module docstring for why this tuple
+    is complete)."""
+
+    n: int                 # position-space size (len(seq))
+    seq: np.ndarray        # uint32 [m] — the elimination order
+    pst: np.ndarray        # uint32 [n] — order-free, final from round 0
+    lo: np.ndarray         # int32 [k] live links (lo < hi < n)
+    hi: np.ndarray         # int32 [k]
+    rounds: int            # chunk rounds completed so far
+    boundary: int          # checkpointed chunk boundaries so far
+    rung: str              # ladder rung that wrote it (mesh/single/host)
+    input_sig: str         # sha256 identity of the build input
+
+    def verify(self, input_sig: str | None) -> None:
+        if input_sig is not None and input_sig != self.input_sig:
+            raise ValueError(
+                "checkpoint does not belong to this input graph/sequence "
+                f"(snapshot sig {self.input_sig[:12]}..., "
+                f"input sig {input_sig[:12]}...) — refusing to resume")
+
+
+class Checkpointer:
+    """Owns the snapshot file of one build: save at chunk boundaries,
+    load at resume, clear on success.
+
+    ``every``: persist every k-th boundary (the fetch + write costs one
+    host sync; on the tunneled backend a coarser cadence may be wanted).
+    Boundaries are still COUNTED every time so fault-injection indices
+    stay stable regardless of cadence.
+    """
+
+    def __init__(self, directory: str, every: int = 1):
+        if every < 1:
+            raise ValueError(f"checkpoint every={every} must be >= 1")
+        self.directory = directory
+        self.every = every
+        self.boundary = 0
+        os.makedirs(directory, exist_ok=True)
+
+    @property
+    def path(self) -> str:
+        return os.path.join(self.directory, SNAPSHOT_NAME)
+
+    def want(self) -> bool:
+        """Will the NEXT boundary be persisted?  Callers use this to skip
+        an expensive link fetch/gather when the answer is no."""
+        return (self.boundary % self.every) == 0
+
+    def skip(self) -> None:
+        """Count an off-cadence boundary without persisting anything."""
+        self.boundary += 1
+
+    def save(self, snap: Snapshot) -> None:
+        """Persist ``snap`` at the current boundary and advance the
+        counter (callers gate on :meth:`want` first)."""
+        snap.boundary = self.boundary
+        self.boundary += 1
+        with atomic_write(self.path, "wb") as f:
+            np.savez(
+                f,
+                version=np.int64(_VERSION),
+                n=np.int64(snap.n),
+                seq=np.asarray(snap.seq, dtype=np.uint32),
+                pst=np.asarray(snap.pst, dtype=np.uint32),
+                lo=np.asarray(snap.lo, dtype=np.int32),
+                hi=np.asarray(snap.hi, dtype=np.int32),
+                rounds=np.int64(snap.rounds),
+                boundary=np.int64(snap.boundary),
+                rung=np.str_(snap.rung),
+                input_sig=np.str_(snap.input_sig),
+            )
+        return True
+
+    def load(self) -> Snapshot | None:
+        """The last persisted snapshot, or None when there is none."""
+        if not os.path.exists(self.path):
+            return None
+        with np.load(self.path) as z:
+            if int(z["version"]) != _VERSION:
+                raise ValueError(
+                    f"{self.path}: snapshot version {int(z['version'])} "
+                    f"!= supported {_VERSION}")
+            snap = Snapshot(
+                n=int(z["n"]), seq=z["seq"].copy(), pst=z["pst"].copy(),
+                lo=z["lo"].copy(), hi=z["hi"].copy(),
+                rounds=int(z["rounds"]), boundary=int(z["boundary"]),
+                rung=str(z["rung"]), input_sig=str(z["input_sig"]))
+        # resume continues counting boundaries where the dead build stopped
+        self.boundary = snap.boundary + 1
+        return snap
+
+    def clear(self) -> None:
+        """Remove the snapshot (the build completed; a later --resume must
+        start fresh rather than replay a finished state)."""
+        try:
+            os.unlink(self.path)
+        except FileNotFoundError:
+            pass

@@ -1,0 +1,32 @@
+"""JAX version compatibility shims.
+
+The package targets the current jax API surface (``jax.shard_map``,
+``jax.enable_x64``), but deployment containers pin older releases where
+those names still live under ``jax.experimental``.  Importing through this
+module keeps every call site on one spelling; the fallbacks can be deleted
+once the fleet's minimum jax passes 0.4.x.
+"""
+
+from __future__ import annotations
+
+import jax
+
+try:  # newer jax re-exports shard_map at top level
+    from jax import shard_map as _shard_map
+except ImportError:  # pragma: no cover - exercised only on old jax
+    from jax.experimental.shard_map import shard_map as _shard_map  # type: ignore
+
+import inspect as _inspect
+
+if "check_vma" in _inspect.signature(_shard_map).parameters:
+    shard_map = _shard_map
+else:  # pragma: no cover - old jax spells the replication check check_rep
+    def shard_map(f, *, check_vma=True, **kw):
+        return _shard_map(f, check_rep=check_vma, **kw)
+
+if hasattr(jax, "enable_x64"):
+    enable_x64 = jax.enable_x64
+else:  # pragma: no cover - exercised only on old jax
+    from jax.experimental import enable_x64  # type: ignore
+
+__all__ = ["shard_map", "enable_x64"]

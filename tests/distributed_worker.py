@@ -19,6 +19,10 @@ def main() -> None:
                                 int(sys.argv[3]), sys.argv[4])
     mode = sys.argv[5] if len(sys.argv) > 5 else "degree"
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    # Bound the coordinator join: a dead/misaddressed coordinator must
+    # fail this worker with a clear error (parallel/mesh.init_distributed)
+    # instead of hanging until the pytest-level subprocess timeout.
+    os.environ.setdefault("SHEEP_CONNECT_TIMEOUT", "120")
     if mode in ("build", "stream", "chunked", "chunked_stream"):
         return main_build(coord, num, pid, out_dir, mode)
 
@@ -62,7 +66,9 @@ def main() -> None:
     hg = jax.make_array_from_process_local_data(shard, h[
         pid * (e_pad // num): (pid + 1) * (e_pad // num)], (e_pad,))
 
-    from jax import lax, shard_map
+    from jax import lax
+
+    from sheep_tpu.utils.compat import shard_map
 
     def body(ts, hs):
         local = jnp.zeros(n, jnp.int32).at[ts].add(1).at[hs].add(1)

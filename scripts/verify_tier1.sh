@@ -8,4 +8,45 @@
 # prints DOTS_PASSED=<n> (count of passing tests); the exit code is
 # pytest's.
 cd "$(dirname "$0")/.." || exit 1
+
+# --- fsck smoke (integrity layer, ISSUE 2) -------------------------------
+# Build a tiny artifact set, assert `sheep fsck` passes it clean, corrupt
+# one artifact, assert fsck exits nonzero.  Seconds of work; a regression
+# in the end-to-end integrity path fails the gate before pytest even runs.
+FSCK_DIR=$(mktemp -d)
+if env JAX_PLATFORMS=cpu python - "$FSCK_DIR" <<'EOF'
+import sys, numpy as np
+from sheep_tpu.io import write_edges, write_sequence, write_tree
+from sheep_tpu.core import build_forest, degree_sequence
+d = sys.argv[1]
+tail = np.array([0, 1, 2, 3, 0], np.uint32)
+head = np.array([1, 2, 3, 0, 2], np.uint32)
+write_edges(d + "/g.dat", tail, head)
+seq = degree_sequence(tail, head)
+write_sequence(seq, d + "/g.seq")
+f = build_forest(tail, head, seq)
+write_tree(d + "/g.tre", f.parent, f.pst_weight)
+EOF
+then
+  if ! env JAX_PLATFORMS=cpu bin/fsck -q "$FSCK_DIR" > /dev/null; then
+    echo "FSCK SMOKE FAILED: clean artifacts did not pass fsck" >&2
+    rm -rf "$FSCK_DIR"; exit 1
+  fi
+  # flip one record byte in the tree; fsck must now exit nonzero
+  python -c "
+import sys
+p = sys.argv[1] + '/g.tre'
+b = bytearray(open(p, 'rb').read()); b[5] ^= 0xFF
+open(p, 'wb').write(bytes(b))" "$FSCK_DIR"
+  if env JAX_PLATFORMS=cpu bin/fsck -q "$FSCK_DIR" > /dev/null 2>&1; then
+    echo "FSCK SMOKE FAILED: corrupted artifact passed fsck" >&2
+    rm -rf "$FSCK_DIR"; exit 1
+  fi
+  rm -rf "$FSCK_DIR"
+else
+  echo "FSCK SMOKE FAILED: could not build the tiny artifact set" >&2
+  rm -rf "$FSCK_DIR"; exit 1
+fi
+# -------------------------------------------------------------------------
+
 set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=${PIPESTATUS[0]}; echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c); exit $rc

@@ -15,11 +15,13 @@ SURVEY.md) designed for TPU execution with JAX/XLA:
 
 Layout:
   io/         edge-list / sequence / tree file formats (.dat .net .seq .tre)
+  integrity/  sidecar checksums, typed corruption errors, `sheep fsck`
   core/       exact sequential semantics (numpy oracle) + facts + validation
   ops/        single-device JAX kernels (sort, hooking, segment sums, eval)
   parallel/   mesh construction, sharded fused build, tournament merge
   partition/  tree partitioners (forward FFD et al.), fennel, evaluators
   cli/        graph2tree / partition_tree / degree_sequence / merge_trees
+              / fsck
   utils/      phase timers (stdout grammar), misc helpers
 """
 

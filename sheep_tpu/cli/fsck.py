@@ -1,0 +1,69 @@
+"""sheep fsck: verify artifacts (or whole trial directories) and exit
+nonzero on ANY corruption.
+
+No reference counterpart — the reference trusts its bytes; this tool is
+the operational face of the integrity layer (ISSUE 2).  The shell
+pipeline runs it on every worker tree before a merge tournament
+(scripts/horizontal-dist.sh), and operators run it by hand on anything a
+flaky disk or interrupted copy may have touched:
+
+    bin/fsck trial-dir/                      # every artifact underneath
+    bin/fsck graph.dat out.tre ckpt/sheep-ckpt.npz
+    bin/fsck -m repair damaged.net           # report what repair would keep
+
+Exit codes: 0 all clean, 1 corruption found, 2 usage error.
+"""
+
+from __future__ import annotations
+
+import getopt
+import sys
+
+from ..integrity.fsck import fsck_paths
+from ..integrity.sidecar import POLICIES
+
+USAGE = "USAGE: fsck [-q] [-m strict|repair|trust] path [path ...]"
+
+
+def main(argv: list[str] | None = None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    try:
+        opts, args = getopt.gnu_getopt(argv, "qm:v")
+    except getopt.GetoptError as exc:
+        print(f"Unknown option character '{(exc.opt or '?')[:1]}'.")
+        return 2
+
+    quiet = False
+    mode = None
+    for o, a in opts:
+        if o == "-q":
+            quiet = True
+        elif o == "-m":
+            if a not in POLICIES:
+                print(f"fsck: -m {a!r} must be one of {'/'.join(POLICIES)}")
+                return 2
+            mode = a
+        elif o == "-v":
+            quiet = False
+
+    if not args:
+        print(USAGE)
+        return 2
+
+    import warnings
+    with warnings.catch_warnings():
+        # repair-mode salvage warnings become part of the report lines
+        warnings.simplefilter("ignore")
+        results, failures = fsck_paths(args, mode)
+    for path, ok, detail in results:
+        if ok and not quiet:
+            print(f"OK   {path}: {detail}")
+        elif not ok:
+            print(f"FAIL {path}: {detail}")
+    checked = len(results)
+    print(f"fsck: {checked} artifact(s) checked, {len(failures)} bad")
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

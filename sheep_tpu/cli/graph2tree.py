@@ -37,6 +37,19 @@ from .common import PhaseClock, graph_stats, print_phase, print_tree
 USAGE = "USAGE: graph2tree input_graph [options ...]"
 
 
+def _tree_sig(seq) -> str:
+    """Input signature stamped into .tre sidecars: identifies the
+    (n, sequence) the tree was built over.  Partial trees of one build
+    share it, so merge_trees can refuse a cross-build tournament — the
+    real compatibility requirement is "same sequence", which edge bytes
+    cannot express (each worker sees a different slice)."""
+    import numpy as np
+
+    from ..runtime.snapshot import input_signature
+    seq = np.asarray(seq, dtype=np.uint32)
+    return input_signature(len(seq), seq)
+
+
 def _make_jopts(make_kids, make_pst, make_jxn, memory_limit, width_limit,
                 find_max_width):
     from ..core.jxn import JxnOptions
@@ -252,9 +265,10 @@ def main(argv: list[str] | None = None) -> int:
                 _, partials = map_graph_distributed(
                     edges.tail, edges.head, num_workers=workers, seq=seq)
                 if proc0:
+                    sig = _tree_sig(seq)
                     for w, f in enumerate(partials):
                         write_tree(f"{output_filename}{w:02d}r0.tre",
-                                   f.parent, f.pst_weight)
+                                   f.parent, f.pst_weight, sig=sig)
                 # -f/-c/-t report worker 0's partial view, like the
                 # reference's rank 0 with its partial graph load.
                 forest = partials[0]
@@ -262,13 +276,14 @@ def main(argv: list[str] | None = None) -> int:
                 a0, b0 = 0, min(shard, len(edges.tail))
             else:
                 forest = None
+                sig = _tree_sig(seq)
                 for w in range(workers):
                     a, b = partial_range(edges.num_edges, w + 1, workers)
                     f = build_forest(edges.tail[a:b], edges.head[a:b], seq,
                                      max_vid=max_vid)
                     if proc0:
                         write_tree(f"{output_filename}{w:02d}r0.tre",
-                                   f.parent, f.pst_weight)
+                                   f.parent, f.pst_weight, sig=sig)
                     if forest is None:
                         forest = f
                         a0, b0 = a, b
@@ -347,7 +362,8 @@ def main(argv: list[str] | None = None) -> int:
     elif output_filename and not map_only and proc0:
         # Serial fast path builds straight into the output file
         # (graph2tree.cpp:185-188); with -r only the leader saves (:217-218).
-        write_tree(output_filename, forest.parent, forest.pst_weight)
+        write_tree(output_filename, forest.parent, forest.pst_weight,
+                   sig=_tree_sig(seq))
 
     # Diagnostics print from process 0 only in multi-host runs (rank-0
     # grammar; every process holds the same replicated result anyway).

@@ -1,16 +1,57 @@
 """merge_trees: associative pairwise merge of two .tre files
 (merge_trees.cpp:37-101).  ``Loaded in: Nms`` / ``Built in: Nms`` grammar.
+
+Integrity (ISSUE 2): inputs are verified on read (sidecar checksums +
+structural hardening, io/trefile.py) and checked for merge COMPATIBILITY
+before any zipping — trees of differing length, or carrying differing
+input signatures in their sidecars (written by graph2tree's map phase),
+come from different builds and merging them would produce a plausible-
+looking but wrong tree.  Both refusals exit nonzero with a typed message.
 """
 
 from __future__ import annotations
 
 import getopt
+import os
 import sys
 
 from ..core.facts import compute_facts
 from ..core.forest import Forest, merge_forests
+from ..integrity.errors import IncompatibleMerge, IntegrityError
+from ..integrity.sidecar import read_sidecar
 from ..io.trefile import read_tree, write_tree
 from .common import PhaseClock, print_phase_ms
+
+
+def check_merge_compatible(paths: list[str],
+                           forests: list[Forest]) -> str | None:
+    """Refuse incompatible merge inputs; returns the shared input
+    signature (to stamp onto the merged output's sidecar), if any."""
+    sizes = {len(f.parent) for f in forests}
+    if len(sizes) > 1:
+        detail = ", ".join(
+            f"{os.path.basename(p)}:{len(f.parent)}"
+            for p, f in zip(paths, forests))
+        raise IncompatibleMerge(
+            f"trees disagree on node count ({detail}) — partial trees "
+            f"must share one sequence; refusing to merge")
+    sigs = {}
+    for p in paths:
+        try:
+            sc = read_sidecar(p)
+        except IntegrityError:
+            continue  # unreadable sidecar already warned at read time
+        if sc and sc.get("sig"):
+            sigs[p] = sc["sig"]
+    distinct = set(sigs.values())
+    if len(distinct) > 1:
+        detail = ", ".join(f"{os.path.basename(p)}:{s[:12]}..."
+                           for p, s in sigs.items())
+        raise IncompatibleMerge(
+            f"trees carry different input signatures ({detail}) — they "
+            f"were built from different graphs/sequences; refusing to "
+            f"merge")
+    return next(iter(distinct), None)
 
 
 def main(argv: list[str] | None = None) -> int:
@@ -46,13 +87,19 @@ def main(argv: list[str] | None = None) -> int:
     # All positional trees merge in one associative pass (the reference
     # takes exactly two, which silently pins the scripts' REDUCTION to 2;
     # accepting k inputs makes any tournament fan-in correct).
-    inputs = [Forest(*read_tree(a)) for a in args]
+    try:
+        inputs = [Forest(*read_tree(a)) for a in args]
+        sig = check_merge_compatible(args, inputs)
+    except IntegrityError as exc:
+        print(f"merge_trees: {exc}", file=sys.stderr)
+        return 1
     if verbose:
         print_phase_ms("Loaded", clock.phase_seconds())
 
     merged = merge_forests(*inputs)
     if output_filename:
-        write_tree(output_filename, merged.parent, merged.pst_weight)
+        write_tree(output_filename, merged.parent, merged.pst_weight,
+                   sig=sig)
     if verbose:
         print_phase_ms("Built", clock.phase_seconds())
 

@@ -8,7 +8,7 @@ from .forest import (
     merge_forests,
 )
 from .facts import Facts, compute_facts
-from .validate import is_valid_forest
+from .validate import check_forest_fast, is_valid_forest
 
 __all__ = [
     "degree_sequence",
@@ -22,5 +22,6 @@ __all__ = [
     "merge_forests",
     "Facts",
     "compute_facts",
+    "check_forest_fast",
     "is_valid_forest",
 ]

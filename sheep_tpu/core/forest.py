@@ -249,10 +249,21 @@ def merge_forests(*forests: Forest) -> Forest:
     Equivalent to the reference's pairwise merge (lib/jnode.cpp:174-201) /
     MPI_Reduce custom op (:203-250): pst_weights add; parent links from all
     inputs are replayed as links in ascending-parent order.
+
+    Merging partial forests is only meaningful over the SAME sequence —
+    trees of different length cannot share one, so a length clash is a
+    typed IncompatibleMerge, not an assert (a stripped ``python -O`` run
+    must not zip mismatched trees silently).
     """
-    assert len(forests) >= 1
+    from ..integrity.errors import IncompatibleMerge
+    if len(forests) < 1:
+        raise IncompatibleMerge("merge of zero forests")
     n = forests[0].n
-    assert all(f.n == n for f in forests)
+    sizes = [f.n for f in forests]
+    if any(s != n for s in sizes):
+        raise IncompatibleMerge(
+            f"cannot merge forests of differing length {sizes} — partial "
+            f"trees must come from the same sequence over the same graph")
     pst = np.zeros(n, dtype=np.uint64)
     los, his = [], []
     for f in forests:

@@ -1,0 +1,45 @@
+"""Defense-in-depth data integrity for every artifact the pipeline
+exchanges (ISSUE 2).
+
+Layers, cheapest first:
+
+  1. sidecar checksums (integrity.sidecar) — every writer seals a
+     ``.sum`` next to its artifact; every reader verifies on read under a
+     strict/repair/trust policy (env ``SHEEP_INTEGRITY``).
+  2. hardened parsers (io/) — malformed input raises a typed
+     :class:`IntegrityError` naming the byte-level lie instead of
+     producing a silently wrong partition.
+  3. fast oracles (core.validate.check_forest_fast) — vectorized O(E)
+     invariants (pst conservation, parent monotonicity) run at chunk /
+     merge / partition boundaries; the exact root-path oracle
+     (core.validate.is_valid_forest) is the slow tier.
+  4. ``sheep fsck`` (integrity.fsck, cli.fsck, bin/fsck) — verify any
+     artifact or trial directory; the shell pipeline runs it before every
+     merge tournament.
+"""
+
+from .errors import (ChecksumMismatch, IncompatibleMerge, IntegrityError,
+                     MalformedArtifact)
+from .fsck import collect_artifacts, fsck_file, fsck_paths
+from .sidecar import (DEFAULT_ALGO, POLICIES, checksummed_write, read_sidecar,
+                      resolve_policy, sidecar_path, verify_bytes, verify_file,
+                      write_sidecar)
+
+__all__ = [
+    "ChecksumMismatch",
+    "IncompatibleMerge",
+    "IntegrityError",
+    "MalformedArtifact",
+    "collect_artifacts",
+    "fsck_file",
+    "fsck_paths",
+    "DEFAULT_ALGO",
+    "POLICIES",
+    "checksummed_write",
+    "read_sidecar",
+    "resolve_policy",
+    "sidecar_path",
+    "verify_bytes",
+    "verify_file",
+    "write_sidecar",
+]

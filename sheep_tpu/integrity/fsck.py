@@ -1,0 +1,136 @@
+"""``sheep fsck`` core: verify any artifact, or a whole trial directory.
+
+One function per artifact class; each returns a human-readable summary on
+success and raises IntegrityError (or OSError) on any corruption.  The
+checks are layered — sidecar checksum first (when one exists), then the
+format's structural invariants, then the cheap semantic invariants the
+merge-associativity property gives us for free (parents strictly later
+than kids, pst totals plausible).  ``sheep fsck`` exits nonzero iff any
+checked artifact fails; the shell pipeline runs it on the worker trees
+before every merge tournament (scripts/horizontal-dist.sh).
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from .errors import IntegrityError, MalformedArtifact
+from .sidecar import read_sidecar, resolve_policy, verify_file
+
+#: suffixes fsck knows how to verify (``.npz`` = runtime snapshots)
+ARTIFACT_SUFFIXES = (".tre", ".seq", ".dat", ".net", ".npz")
+
+
+def _fsck_tre(path: str, mode: str) -> str:
+    from .. import INVALID_JNID
+    from ..io.trefile import read_tree
+
+    parent, pst = read_tree(path, integrity=mode)
+    linked = parent != INVALID_JNID
+    return (f"n={len(parent)} links={int(linked.sum())} "
+            f"pst_total={int(pst.sum())}")
+
+
+def _fsck_seq(path: str, mode: str) -> str:
+    from ..io.seqfile import read_sequence
+
+    seq = read_sequence(path, binary="auto", integrity=mode)
+    if len(seq) and len(np.unique(seq)) != len(seq):
+        raise MalformedArtifact(
+            f"{path}: corrupt sequence — duplicate vids (an elimination "
+            f"order visits each vertex once)")
+    return f"m={len(seq)}"
+
+
+def _fsck_dat(path: str, mode: str) -> str:
+    from ..io.edges import read_dat
+
+    el = read_dat(path, integrity=mode)
+    return f"records={el.num_edges}"
+
+
+def _fsck_net(path: str, mode: str) -> str:
+    from ..io.edges import read_net
+
+    el = read_net(path, integrity=mode)
+    return f"records={el.num_edges}"
+
+
+def _fsck_npz(path: str, mode: str) -> str:
+    from ..runtime.snapshot import load_snapshot
+
+    snap = load_snapshot(path, integrity=mode)
+    return (f"n={snap.n} links={len(snap.lo)} rounds={snap.rounds} "
+            f"rung={snap.rung}")
+
+
+_CHECKERS = {
+    ".tre": _fsck_tre,
+    ".seq": _fsck_seq,
+    ".dat": _fsck_dat,
+    ".net": _fsck_net,
+    ".npz": _fsck_npz,
+}
+
+
+def fsck_file(path: str, mode: str | None = None) -> str:
+    """Verify one artifact; returns a summary string or raises."""
+    mode = resolve_policy(mode)
+    for suffix, checker in _CHECKERS.items():
+        if path.endswith(suffix):
+            detail = checker(path, mode)
+            status = "sum=" + _sidecar_state(path, mode)
+            return f"{detail} {status}"
+    # unknown suffix: the sidecar (if any) is still checkable
+    state = verify_file(path, mode)
+    if state == "no-sidecar":
+        raise MalformedArtifact(
+            f"{path}: not a sheep artifact (want one of "
+            f"{'/'.join(_CHECKERS)}) and no sidecar to verify")
+    return f"opaque bytes sum={state}"
+
+
+def _sidecar_state(path: str, mode: str) -> str:
+    if mode == "trust":
+        return "trusted"
+    try:
+        return "absent" if read_sidecar(path) is None else "verified"
+    except MalformedArtifact:
+        return "unreadable"
+
+
+def collect_artifacts(root: str) -> list[str]:
+    """Every checkable artifact under ``root`` (a file is itself)."""
+    if os.path.isfile(root):
+        return [root]
+    out = []
+    for dirpath, _, names in os.walk(root):
+        for name in sorted(names):
+            if name.endswith(ARTIFACT_SUFFIXES):
+                out.append(os.path.join(dirpath, name))
+    return out
+
+
+def fsck_paths(paths, mode: str | None = None):
+    """Verify every artifact reachable from ``paths``.
+
+    Returns (results, failures): ``results`` is a list of
+    (path, ok, detail) in check order; ``failures`` the failing subset.
+    """
+    mode = resolve_policy(mode)
+    results = []
+    for root in paths:
+        targets = collect_artifacts(root)
+        if not targets:
+            results.append((root, False, "no artifacts found"))
+            continue
+        for path in targets:
+            try:
+                detail = fsck_file(path, mode)
+                results.append((path, True, detail))
+            except (IntegrityError, OSError) as exc:
+                results.append((path, False, str(exc)))
+    failures = [r for r in results if not r[1]]
+    return results, failures

@@ -31,9 +31,14 @@ relies on.
 from __future__ import annotations
 
 import os
+import warnings
 from dataclasses import dataclass, field
 
 import numpy as np
+
+from ..integrity.errors import MalformedArtifact
+from ..integrity.sidecar import (checksummed_write, read_sidecar,
+                                 resolve_policy, verify_bytes)
 
 _XS1_DTYPE = np.dtype(
     [("tail", "<u4"), ("head", "<u4"), ("weight", "<f4")]
@@ -88,13 +93,32 @@ def partial_range(num_records: int, part: int, num_parts: int) -> tuple[int, int
     return start, stop
 
 
-def read_dat(path: str, part: int = 0, num_parts: int = 0) -> EdgeList:
+def read_dat(path: str, part: int = 0, num_parts: int = 0,
+             integrity: str | None = None) -> EdgeList:
+    mode = resolve_policy(integrity)
     nbytes = os.path.getsize(path)
-    num_records = nbytes // _XS1_DTYPE.itemsize
+    rec_size = _XS1_DTYPE.itemsize
+    if nbytes % rec_size:
+        msg = (f"{path}: corrupt .dat — {nbytes} bytes is not a multiple "
+               f"of the {rec_size}-byte XS1 record (torn trailing record)")
+        if mode == "strict":
+            raise MalformedArtifact(msg)
+        if mode == "repair":
+            warnings.warn(msg + "; repair drops the partial record")
+    num_records = nbytes // rec_size
     start, stop = partial_range(num_records, part, num_parts) if num_parts else (0, num_records)
-    with open(path, "rb") as f:
-        f.seek(start * _XS1_DTYPE.itemsize)
-        raw = np.fromfile(f, dtype=_XS1_DTYPE, count=stop - start)
+    if mode != "trust" and read_sidecar(path) is not None:
+        # a sidecar exists: verify the WHOLE file (corruption anywhere
+        # invalidates the load) and slice records from the same bytes
+        with open(path, "rb") as f:
+            data = f.read()
+        verify_bytes(path, data, mode)
+        raw = np.frombuffer(data, dtype=_XS1_DTYPE,
+                            count=num_records)[start:stop]
+    else:
+        with open(path, "rb") as f:
+            f.seek(start * rec_size)
+            raw = np.fromfile(f, dtype=_XS1_DTYPE, count=stop - start)
     return EdgeList(
         tail=np.ascontiguousarray(raw["tail"]),
         head=np.ascontiguousarray(raw["head"]),
@@ -103,24 +127,80 @@ def read_dat(path: str, part: int = 0, num_parts: int = 0) -> EdgeList:
     )
 
 
-def read_net(path: str, part: int = 0, num_parts: int = 0) -> EdgeList:
+def _salvage_net_lines(path: str, data: bytes):
+    """Repair-mode .net parse: keep exactly the well-formed ``tail head``
+    lines, drop (and count) everything else.  Any byte damage can only
+    REMOVE edges from the result, never invent pairings that span lines —
+    which is what makes repair output a subset-or-equal of the clean edge
+    multiset under token-invalidating corruption."""
+    tails, heads, dropped = [], [], 0
+    for ln in data.splitlines():
+        ln = ln.strip()
+        if not ln or ln.startswith(b"#"):
+            continue
+        toks = ln.split()
+        if len(toks) != 2:
+            dropped += 1
+            continue
+        try:
+            t, h = int(toks[0]), int(toks[1])
+        except ValueError:
+            dropped += 1
+            continue
+        if not (0 <= t <= 0xFFFFFFFF and 0 <= h <= 0xFFFFFFFF):
+            dropped += 1
+            continue
+        tails.append(t)
+        heads.append(h)
+    if dropped:
+        warnings.warn(f"{path}: repair dropped {dropped} malformed line(s)")
+    return (np.array(tails, dtype=np.uint32),
+            np.array(heads, dtype=np.uint32))
+
+
+def read_net(path: str, part: int = 0, num_parts: int = 0,
+             integrity: str | None = None) -> EdgeList:
+    mode = resolve_policy(integrity)
     # np.loadtxt is slow for big graphs; use fromstring on the filtered text.
     with open(path, "rb") as f:
         data = f.read()
+    verify_bytes(path, data, mode)
     if b"#" in data:
         lines = [ln for ln in data.splitlines() if not ln.lstrip().startswith(b"#")]
         data = b"\n".join(lines)
-    flat = np.array(data.split(), dtype=np.uint32)
-    if flat.size % 2 != 0:
-        raise ValueError(f"{path}: odd token count {flat.size}")
-    tails = flat[0::2].copy()
-    heads = flat[1::2].copy()
+    if mode == "repair":
+        tails, heads = _salvage_net_lines(path, data)
+    else:
+        toks = data.split()
+        try:
+            flat = np.array(toks, dtype=np.int64) if toks else \
+                np.empty(0, dtype=np.int64)
+        except (ValueError, OverflowError):
+            bad = next((i for i, t in enumerate(toks) if not t.isdigit()),
+                       0)
+            raise MalformedArtifact(
+                f"{path}: corrupt .net — non-integer token "
+                f"{toks[bad][:40]!r} (token {bad}); repair mode would drop "
+                f"the malformed lines")
+        out_of_range = (flat < 0) | (flat > 0xFFFFFFFF)
+        if out_of_range.any():
+            j = int(np.flatnonzero(out_of_range)[0])
+            raise MalformedArtifact(
+                f"{path}: corrupt .net — token {int(flat[j])} (token {j}) "
+                f"is not a uint32 vid")
+        if flat.size % 2 != 0:
+            raise MalformedArtifact(
+                f"{path}: corrupt .net — odd token count {flat.size} "
+                f"(a dangling tail with no head)")
+        tails = flat[0::2].astype(np.uint32)
+        heads = flat[1::2].astype(np.uint32)
     num_records = len(tails)
     if num_parts:
         start, stop = partial_range(num_records, part, num_parts)
         tails, heads = tails[start:stop].copy(), heads[start:stop].copy()
     else:
         start = 0
+        tails, heads = tails.copy(), heads.copy()
     return EdgeList(tail=tails, head=heads, file_edges=num_records, start=start)
 
 
@@ -146,16 +226,17 @@ def dedup_edges(edges: EdgeList) -> EdgeList:
 
 
 def load_edges(path: str, part: int = 0, num_parts: int = 0,
-               dedup: bool = False) -> EdgeList:
+               dedup: bool = False, integrity: str | None = None) -> EdgeList:
     """Suffix-dispatching loader (``.dat`` binary, else SNAP text).
 
     ``dedup`` mirrors DDUP_GRAPH; the CLIs honor SHEEP_DDUP_GRAPH=1 for the
     same effect without recompiling (the reference needs a rebuild).
+    ``integrity``: strict/repair/trust (default: env SHEEP_INTEGRITY).
     """
     if path.endswith(".dat"):
-        el = read_dat(path, part, num_parts)
+        el = read_dat(path, part, num_parts, integrity=integrity)
     else:
-        el = read_net(path, part, num_parts)
+        el = read_net(path, part, num_parts, integrity=integrity)
     if dedup or os.environ.get("SHEEP_DDUP_GRAPH", "") == "1":
         el = dedup_edges(el)
     return el
@@ -169,23 +250,65 @@ def iter_dat_blocks(path: str, block_edges: int, part: int = 0,
 
     Raw records only: SHEEP_DDUP_GRAPH is NOT applied here (block-local
     dedup would differ from load-level dedup); a warning is emitted so the
-    two paths are never silently inconsistent."""
+    two paths are never silently inconsistent.
+
+    Integrity: the record-size check runs up front like :func:`read_dat`;
+    when a sidecar exists and the whole file is streamed (no partial
+    range), the checksum accumulates incrementally across blocks and a
+    mismatch raises AT THE END of the stream — bounded memory is kept, and
+    a corrupted file still fails the run instead of feeding garbage into
+    the fold."""
+    mode = resolve_policy(None)
     if os.environ.get("SHEEP_DDUP_GRAPH", "") == "1":
-        import warnings
         warnings.warn("SHEEP_DDUP_GRAPH is ignored by the streaming block "
                       "reader; dedup the file up front instead")
     nbytes = os.path.getsize(path)
+    if nbytes % _XS1_DTYPE.itemsize and mode != "trust":
+        msg = (f"{path}: corrupt .dat — {nbytes} bytes is not a multiple "
+               f"of the {_XS1_DTYPE.itemsize}-byte XS1 record")
+        if mode == "strict":
+            raise MalformedArtifact(msg)
+        warnings.warn(msg + "; repair drops the partial record")
     num_records = nbytes // _XS1_DTYPE.itemsize
     if num_records == 0:
         return  # an empty file yields no blocks (mmap would reject it)
     start, stop = partial_range(num_records, part, num_parts) if num_parts \
         else (0, num_records)
+    sc = read_sidecar(path) if mode != "trust" else None
+    whole = (start, stop) == (0, num_records)
+    if sc is not None and sc["size"] != nbytes:
+        msg = (f"{path}: checksum mismatch (size {nbytes} != recorded "
+               f"{sc['size']})")
+        if mode == "strict":
+            from ..integrity.errors import ChecksumMismatch
+            raise ChecksumMismatch(msg)
+        warnings.warn(msg)
+        sc = None
+    from ..integrity.sidecar import crc_update
+    crc = 0
     mm = np.memmap(path, dtype=_XS1_DTYPE, mode="r")
     for a in range(start, stop, block_edges):
         b = min(a + block_edges, stop)
         rec = mm[a:b]
+        if sc is not None and whole:
+            crc = crc_update(rec.tobytes(), crc, sc["algo"])
         yield np.ascontiguousarray(rec["tail"]), \
             np.ascontiguousarray(rec["head"])
+    if sc is not None and whole:
+        # trailing torn bytes (if any) are part of the recorded sum
+        tail_bytes = nbytes - num_records * _XS1_DTYPE.itemsize
+        if tail_bytes:
+            with open(path, "rb") as f:
+                f.seek(num_records * _XS1_DTYPE.itemsize)
+                crc = crc_update(f.read(), crc, sc["algo"])
+        if (crc & 0xFFFFFFFF) != sc["sum"]:
+            from ..integrity.errors import ChecksumMismatch
+            msg = (f"{path}: checksum mismatch detected at end of stream "
+                   f"({sc['algo']} {crc & 0xFFFFFFFF:08x} != recorded "
+                   f"{sc['sum']:08x}) — the consumed blocks are suspect")
+            if mode == "strict":
+                raise ChecksumMismatch(msg)
+            warnings.warn(msg)
 
 
 def iter_net_blocks(path: str, block_bytes: int = 1 << 26):
@@ -220,7 +343,7 @@ def iter_net_blocks(path: str, block_bytes: int = 1 << 26):
             if len(toks) % 2:
                 pending = toks.pop()
             if toks:
-                flat = np.array(toks, dtype=np.uint32)
+                flat = _net_tokens(path, toks)
                 yield flat[0::2].copy(), flat[1::2].copy()
     if carry.strip() and not carry.lstrip().startswith(b"#"):
         toks = carry.split()
@@ -228,30 +351,38 @@ def iter_net_blocks(path: str, block_bytes: int = 1 << 26):
             toks.insert(0, pending)
             pending = None
         if len(toks) % 2:
-            raise ValueError(f"{path}: odd token count")
+            raise MalformedArtifact(f"{path}: odd token count")
         if toks:
-            flat = np.array(toks, dtype=np.uint32)
+            flat = _net_tokens(path, toks)
             yield flat[0::2].copy(), flat[1::2].copy()
     elif pending is not None:
-        raise ValueError(f"{path}: odd token count")
+        raise MalformedArtifact(f"{path}: odd token count")
+
+
+def _net_tokens(path: str, toks) -> np.ndarray:
+    """Bulk-parse SNAP text tokens with a typed error on garbage."""
+    try:
+        return np.array(toks, dtype=np.uint32)
+    except (ValueError, OverflowError) as exc:
+        raise MalformedArtifact(
+            f"{path}: corrupt .net — non-integer token in stream ({exc})")
 
 
 def write_dat(path: str, tail: np.ndarray, head: np.ndarray) -> None:
     # Crash-safe like every writer in this package (io/atomic.py): the
     # per-part edge files feed the next pipeline stage through a polling
     # filesystem handoff, so a torn record prefix must be impossible.
-    from .atomic import atomic_write
+    # checksummed_write additionally seals a .sum sidecar next to it.
     rec = np.empty(len(tail), dtype=_XS1_DTYPE)
     rec["tail"] = tail
     rec["head"] = head
     rec["weight"] = 1.0
-    with atomic_write(path, "wb") as f:
+    with checksummed_write(path, "wb") as f:
         f.write(rec.tobytes())
 
 
 def write_net(path: str, tail: np.ndarray, head: np.ndarray) -> None:
-    from .atomic import atomic_write
-    with atomic_write(path, "w") as f:
+    with checksummed_write(path, "w") as f:
         for x, y in zip(tail.tolist(), head.tolist()):
             f.write(f"{x} {y}\n")
 

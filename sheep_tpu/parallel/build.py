@@ -286,6 +286,25 @@ def _single_worker_build(tail, head, n, seq, do_merge):
     return out_seq, parent, pst, n, m, 1
 
 
+def _selfcheck_forest(seq, forest, what: str):
+    """Integrity tier 3 at the build/merge boundary: run the vectorized
+    fast oracle (core.validate.check_forest_fast) on the forest this path
+    is about to hand downstream.  O(n) numpy on host — negligible next to
+    the build — and it turns a sick-backend wrong answer into a typed
+    IntegrityError at the boundary where it happened.  SHEEP_SELFCHECK=0
+    opts out (the oracle itself is exercised by tests either way)."""
+    import os
+    if os.environ.get("SHEEP_SELFCHECK", "1") == "0":
+        return seq, forest
+    from ..core.validate import check_forest_fast
+    from ..integrity.errors import IntegrityError
+    problems = check_forest_fast(forest)
+    if problems:
+        raise IntegrityError(
+            f"{what} produced an invalid forest: " + "; ".join(problems))
+    return seq, forest
+
+
 def build_graph_distributed(tail: np.ndarray, head: np.ndarray,
                             num_vertices: int | None = None,
                             num_workers: int | None = None,
@@ -313,8 +332,9 @@ def build_graph_distributed(tail: np.ndarray, head: np.ndarray,
     mesh = make_mesh(num_workers)
     if mesh.size == 1 and len(tail):
         from ..ops.build import build_graph_hybrid
-        return build_graph_hybrid(tail, head, num_vertices=num_vertices,
-                                  seq=seq)
+        return _selfcheck_forest(
+            *build_graph_hybrid(tail, head, num_vertices=num_vertices,
+                                seq=seq), what="hybrid build")
     if _mesh_kernel() == "chunked":
         # production default: bounded dispatches only — the in-jit
         # while_loop fixpoint below faults on real hardware once its
@@ -322,14 +342,17 @@ def build_graph_distributed(tail: np.ndarray, head: np.ndarray,
         # (PERF_NOTES; SHEEP_MESH_KERNEL=loop selects the
         # single-dispatch twin, which stays the dryrun's compile shape)
         from .chunked import build_graph_chunked_distributed
-        return build_graph_chunked_distributed(
-            tail, head, num_vertices=num_vertices,
-            num_workers=num_workers, seq=seq)
+        return _selfcheck_forest(
+            *build_graph_chunked_distributed(
+                tail, head, num_vertices=num_vertices,
+                num_workers=num_workers, seq=seq),
+            what="chunked mesh build")
     out_seq, parent, pst, n, m, _ = _run_distributed(
         tail, head, num_vertices, num_workers, seq, do_merge=True, mesh=mesh)
     if n == 0:
         return out_seq, Forest(np.empty(0, np.uint32), np.empty(0, np.uint32))
-    return out_seq, _to_forest(parent, pst, n, m)
+    return _selfcheck_forest(out_seq, _to_forest(parent, pst, n, m),
+                             what="mesh build")
 
 
 def map_graph_distributed(tail: np.ndarray, head: np.ndarray,

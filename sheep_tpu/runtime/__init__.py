@@ -17,7 +17,7 @@ from .faults import (BuildKilled, DeadlineExceeded, FaultPlan,
                      InjectedDispatchFault, RetryBudgetExhausted, clear_plan,
                      fault_point, install_plan, reset_counters)
 from .retry import RetryPolicy, run_with_retry
-from .snapshot import Checkpointer, Snapshot, input_signature
+from .snapshot import Checkpointer, Snapshot, input_signature, load_snapshot
 
 __all__ = [
     "BuildKilled",
@@ -35,6 +35,7 @@ __all__ = [
     "fault_point",
     "input_signature",
     "install_plan",
+    "load_snapshot",
     "reset_counters",
     "run_with_retry",
 ]

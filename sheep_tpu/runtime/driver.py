@@ -43,6 +43,8 @@ import numpy as np
 from .. import INVALID_JNID
 from ..core.forest import Forest, build_forest_links, edges_to_positions
 from ..core.sequence import degree_sequence
+from ..integrity.errors import IntegrityError
+from ..integrity.sidecar import resolve_policy
 from .faults import (RetryBudgetExhausted, fault_point, is_retryable,
                      reset_counters)
 from .retry import RetryPolicy, run_with_retry
@@ -63,6 +65,11 @@ class RuntimeConfig:
     backoff_cap_s: float = 2.0
     watchdog_s: float | None = None
     checkpoint_every: int = 1
+    #: integrity policy for checkpoint loads (strict/repair/trust; None =
+    #: env SHEEP_INTEGRITY, default strict).  strict: a corrupt snapshot
+    #: aborts the resume with a typed IntegrityError; repair: it is
+    #: discarded and the build restarts fresh — never resumed into garbage.
+    integrity: str | None = None
     #: degradation ladder, tried in order.  "mesh" is skipped when fewer
     #: than two devices are visible; "host" cannot fail (pure numpy).
     ladder: tuple[str, ...] = ("mesh", "single", "host")
@@ -81,6 +88,7 @@ class RuntimeConfig:
             max_retries=int(env.get("SHEEP_MAX_RETRIES", "3")),
             backoff_base_s=float(env.get("SHEEP_BACKOFF_BASE", "0.05")),
             checkpoint_every=int(env.get("SHEEP_CHECKPOINT_EVERY", "1")),
+            integrity=env.get("SHEEP_INTEGRITY") or None,
         )
         if env.get("SHEEP_WATCHDOG_S"):
             kw["watchdog_s"] = float(env["SHEEP_WATCHDOG_S"])
@@ -236,10 +244,24 @@ def build_graph_resilient(tail, head, num_vertices=None, num_workers=None,
             np.empty(0, np.uint32), np.empty(0, np.uint32))
     sig = input_signature(n, seq_h, tail, head)
 
-    snap = ckpt.load() if (ckpt is not None and config.resume) else None
+    # Resume REJECTS corrupt snapshots instead of resuming into garbage
+    # (ISSUE 2): strict propagates the typed IntegrityError; repair logs
+    # the corruption and restarts fresh — bit-identical output either way
+    # a build completes.
+    snap = None
+    if ckpt is not None and config.resume:
+        try:
+            snap = ckpt.load(integrity=config.integrity)
+            if snap is not None:
+                snap.verify(sig)
+        except IntegrityError as exc:
+            if resolve_policy(config.integrity) != "repair":
+                raise
+            events.append(("corrupt-checkpoint", "resume", str(exc)))
+            snap = None
+            ckpt.boundary = 0  # fresh build: boundary indices restart
     rungs = _ladder_rungs(config, num_workers)
     if snap is not None:
-        snap.verify(sig)
         pst = snap.pst
         lo, hi = snap.lo, snap.hi
         rounds = snap.rounds
@@ -276,10 +298,19 @@ def build_graph_resilient(tail, head, num_vertices=None, num_workers=None,
             events.append(("degrade", rung, rungs[i + 1],
                            f"{type(exc).__name__}: {exc}"))
             if ckpt is not None:
-                # pick up whatever progress the failed rung checkpointed
-                mid = ckpt.load()
+                # Pick up whatever progress the failed rung checkpointed —
+                # but REFUSE a handoff whose checkpoint fails verification
+                # (any policy): the in-memory links are known-good, a
+                # corrupt snapshot is not, so the next rung just redoes
+                # the failed rung's progress.
+                try:
+                    mid = ckpt.load(integrity=config.integrity)
+                    if mid is not None:
+                        mid.verify(sig)
+                except IntegrityError as exc:
+                    events.append(("corrupt-checkpoint", rung, str(exc)))
+                    mid = None
                 if mid is not None:
-                    mid.verify(sig)
                     lo, hi, rounds = mid.lo, mid.hi, mid.rounds
     if parent is None:  # pragma: no cover - host rung cannot fail
         raise RuntimeError("degradation ladder exhausted without a result")
@@ -288,6 +319,19 @@ def build_graph_resilient(tail, head, num_vertices=None, num_workers=None,
     out = np.full(n, INVALID_JNID, dtype=np.uint32)
     live = (pa >= 0) & (pa < n)
     out[live] = pa[live].astype(np.uint32)
+    forest = Forest(out, pst.astype(np.uint32))
+    # Fast-oracle gate (integrity tier 3): O(n) structural invariants on
+    # the result of whatever rung finished.  A rung that "succeeded" with
+    # garbage (flaky interconnect, bad chip) fails HERE, loudly, instead
+    # of partitioning a wrong tree.  Links are not re-checked against pst
+    # — chunk rounds rewrite the live multiset, only the structure is
+    # invariant at this point.
+    from ..core.validate import check_forest_fast
+    problems = check_forest_fast(forest)
+    if problems:
+        raise IntegrityError(
+            "resilient build produced an invalid forest: "
+            + "; ".join(problems))
     if ckpt is not None:
         ckpt.clear()  # build complete: a later --resume starts fresh
-    return seq_h, Forest(out, pst.astype(np.uint32))
+    return seq_h, forest

@@ -23,16 +23,26 @@ name is always a complete, self-consistent checkpoint — a kill mid-write
 leaves the previous checkpoint in place.  An ``input_sig`` (sha256 over
 the vertex count, sequence, and edge bytes) guards against resuming
 someone else's build: a mismatch is an error, not a silent wrong tree.
+
+Integrity (ISSUE 2): every snapshot is sealed with a ``.sum`` sidecar
+(integrity.sidecar) and loads through :func:`load_snapshot`, which layers
+sidecar checksum -> zip member CRCs -> schema -> structural invariants
+(Snapshot.validate).  A corrupt snapshot is NEVER partially salvaged —
+under the repair policy the driver discards it and rebuilds fresh.
 """
 
 from __future__ import annotations
 
 import hashlib
 import os
+import zipfile
 from dataclasses import dataclass
 
 import numpy as np
 
+from ..integrity.errors import IntegrityError, MalformedArtifact
+from ..integrity.sidecar import (resolve_policy, sidecar_path, verify_file,
+                                 write_sidecar)
 from ..io.atomic import atomic_write
 
 SNAPSHOT_NAME = "sheep-ckpt.npz"
@@ -71,10 +81,40 @@ class Snapshot:
 
     def verify(self, input_sig: str | None) -> None:
         if input_sig is not None and input_sig != self.input_sig:
-            raise ValueError(
+            raise IntegrityError(
                 "checkpoint does not belong to this input graph/sequence "
                 f"(snapshot sig {self.input_sig[:12]}..., "
                 f"input sig {input_sig[:12]}...) — refusing to resume")
+
+    def validate(self) -> None:
+        """Structural invariants a well-formed snapshot always satisfies;
+        violation means the file was corrupted (or written by a sick rung)
+        and resuming from it would build a silently wrong tree."""
+        problems = []
+        if self.n < 0:
+            problems.append(f"negative n {self.n}")
+        if len(self.seq) != self.n:
+            problems.append(f"len(seq)={len(self.seq)} != n={self.n}")
+        if len(self.pst) != self.n:
+            problems.append(f"len(pst)={len(self.pst)} != n={self.n}")
+        if len(self.lo) != len(self.hi):
+            problems.append(
+                f"link arrays disagree: {len(self.lo)} lo vs "
+                f"{len(self.hi)} hi")
+        else:
+            lo = np.asarray(self.lo, dtype=np.int64)
+            hi = np.asarray(self.hi, dtype=np.int64)
+            if len(lo) and not bool(((lo >= 0) & (lo < hi)
+                                     & (hi < self.n)).all()):
+                problems.append(
+                    "live links violate 0 <= lo < hi < n")
+        if self.rounds < 0 or self.boundary < 0:
+            problems.append(
+                f"negative counters (rounds={self.rounds}, "
+                f"boundary={self.boundary})")
+        if problems:
+            raise MalformedArtifact(
+                "corrupt snapshot — " + "; ".join(problems))
 
 
 class Checkpointer:
@@ -110,9 +150,12 @@ class Checkpointer:
 
     def save(self, snap: Snapshot) -> None:
         """Persist ``snap`` at the current boundary and advance the
-        counter (callers gate on :meth:`want` first)."""
+        counter (callers gate on :meth:`want` first).  Snapshot writes
+        guard themselves: structurally invalid state (a sick rung handing
+        over garbage links) is refused BEFORE it becomes durable."""
         snap.boundary = self.boundary
         self.boundary += 1
+        snap.validate()
         with atomic_write(self.path, "wb") as f:
             np.savez(
                 f,
@@ -127,30 +170,64 @@ class Checkpointer:
                 rung=np.str_(snap.rung),
                 input_sig=np.str_(snap.input_sig),
             )
+        # The npz writer seeks (zip local headers), so the sidecar sums
+        # the sealed file by read-back rather than a write-through tee.
+        write_sidecar(self.path)
         return True
 
-    def load(self) -> Snapshot | None:
-        """The last persisted snapshot, or None when there is none."""
+    def load(self, integrity: str | None = None) -> Snapshot | None:
+        """The last persisted snapshot, or None when there is none.
+        Raises IntegrityError when the snapshot exists but is corrupt —
+        resuming into garbage is never an option (the driver decides
+        whether to fall back to a fresh build, per policy)."""
         if not os.path.exists(self.path):
             return None
-        with np.load(self.path) as z:
+        snap = load_snapshot(self.path, integrity=integrity)
+        # resume continues counting boundaries where the dead build stopped
+        self.boundary = snap.boundary + 1
+        return snap
+
+    def clear(self) -> None:
+        """Remove the snapshot and its sidecar (the build completed; a
+        later --resume must start fresh rather than replay a finished
+        state)."""
+        for path in (self.path, sidecar_path(self.path)):
+            try:
+                os.unlink(path)
+            except FileNotFoundError:
+                pass
+
+
+def load_snapshot(path: str, integrity: str | None = None) -> Snapshot:
+    """Load + fully verify one snapshot file: sidecar checksum, zip-member
+    CRCs (np.load's zipfile layer), schema, and structural invariants.
+    Every corruption class surfaces as a typed IntegrityError — this is
+    also the ``sheep fsck`` checker for ``.npz`` artifacts."""
+    mode = resolve_policy(integrity)
+    # A snapshot is never partially salvageable — resuming from bytes that
+    # "mostly parse" builds a wrong tree.  So the checksum check is strict
+    # even under the repair policy; repair's graceful path lives in the
+    # DRIVER, which catches the IntegrityError and rebuilds from scratch.
+    if mode != "trust":
+        verify_file(path, "strict")
+    try:
+        with np.load(path) as z:
             if int(z["version"]) != _VERSION:
-                raise ValueError(
-                    f"{self.path}: snapshot version {int(z['version'])} "
+                raise MalformedArtifact(
+                    f"{path}: snapshot version {int(z['version'])} "
                     f"!= supported {_VERSION}")
             snap = Snapshot(
                 n=int(z["n"]), seq=z["seq"].copy(), pst=z["pst"].copy(),
                 lo=z["lo"].copy(), hi=z["hi"].copy(),
                 rounds=int(z["rounds"]), boundary=int(z["boundary"]),
                 rung=str(z["rung"]), input_sig=str(z["input_sig"]))
-        # resume continues counting boundaries where the dead build stopped
-        self.boundary = snap.boundary + 1
-        return snap
-
-    def clear(self) -> None:
-        """Remove the snapshot (the build completed; a later --resume must
-        start fresh rather than replay a finished state)."""
-        try:
-            os.unlink(self.path)
-        except FileNotFoundError:
-            pass
+    except IntegrityError:
+        raise
+    except (zipfile.BadZipFile, KeyError, OSError, ValueError,
+            EOFError) as exc:
+        # np.load surfaces member bit-flips as BadZipFile ("Bad CRC-32"),
+        # missing members as KeyError, torn files as OSError/EOFError
+        raise MalformedArtifact(
+            f"{path}: corrupt snapshot ({type(exc).__name__}: {exc})")
+    snap.validate()
+    return snap

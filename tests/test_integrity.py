@@ -1,0 +1,589 @@
+"""Integrity-layer tests (ISSUE 2): sidecar checksums, hardened readers,
+corruption fuzzing over every artifact class, repair-mode salvage, the
+tiered validation oracles, merge-compatibility guards, the `sheep fsck`
+CLI, and the corrupt-at-every-boundary runtime property.
+
+The fuzz discipline: for each artifact class, corrupt every byte-region
+class (header, record body, sidecar, npz member) and assert a typed
+IntegrityError — NEVER silent acceptance of changed bytes.
+"""
+
+import os
+import subprocess
+import sys
+import warnings
+
+import numpy as np
+import pytest
+
+from sheep_tpu import INVALID_JNID
+from sheep_tpu.core.forest import Forest, build_forest, merge_forests
+from sheep_tpu.core.sequence import degree_sequence
+from sheep_tpu.core.validate import check_forest_fast, is_valid_forest
+from sheep_tpu.integrity import (ChecksumMismatch, IncompatibleMerge,
+                                 IntegrityError, MalformedArtifact,
+                                 fsck_paths, read_sidecar, sidecar_path,
+                                 verify_bytes, write_sidecar)
+from sheep_tpu.io import (load_edges, read_sequence, read_tree, write_edges,
+                          write_sequence, write_tree)
+from sheep_tpu.utils.synth import rmat_edges
+
+pytestmark = [pytest.mark.faults, pytest.mark.fuzz]
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _flip(path, offset, xor=0xFF):
+    b = bytearray(open(path, "rb").read())
+    b[offset % len(b)] ^= xor
+    open(path, "wb").write(bytes(b))
+
+
+def _truncate(path, nbytes):
+    b = open(path, "rb").read()
+    open(path, "wb").write(b[: max(0, len(b) - nbytes)])
+
+
+@pytest.fixture
+def small_forest():
+    tail, head = rmat_edges(6, 4 << 6, seed=3)
+    seq = degree_sequence(tail, head)
+    forest = build_forest(tail, head, seq)
+    return tail, head, seq, forest
+
+
+# ---------------------------------------------------------------------------
+# sidecar unit behavior
+# ---------------------------------------------------------------------------
+
+
+def test_sidecar_roundtrip_and_fields(tmp_path):
+    p = str(tmp_path / "x.tre")
+    write_tree(p, np.array([1, INVALID_JNID], np.uint32),
+               np.array([0, 2], np.uint32), sig="f00d")
+    sc = read_sidecar(p)
+    assert sc is not None
+    assert sc["version"] == 1
+    assert sc["algo"] in ("crc32", "crc32c")
+    assert sc["size"] == os.path.getsize(p)
+    assert sc["sig"] == "f00d"
+    assert verify_bytes(p, open(p, "rb").read()) == "ok"
+
+
+def test_missing_sidecar_is_accepted_but_reported(tmp_path):
+    # foreign files carry no sidecars; strict must still read them
+    p = str(tmp_path / "foreign.tre")
+    write_tree(p, np.array([INVALID_JNID], np.uint32),
+               np.array([1], np.uint32))
+    os.unlink(sidecar_path(p))
+    read_tree(p)  # no raise
+    assert verify_bytes(p, open(p, "rb").read()) == "no-sidecar"
+
+
+def test_corrupt_sidecar_never_silently_vouches(tmp_path):
+    p = str(tmp_path / "x.seq")
+    write_sequence(np.arange(9, dtype=np.uint32), p)
+    with open(sidecar_path(p), "wb") as f:
+        f.write(b"\x00\xffgarbage not a sidecar")
+    with pytest.raises(MalformedArtifact, match="sidecar"):
+        read_sequence(p)
+    # repair degrades to structural-only checks with a warning
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        got = read_sequence(p, integrity="repair")
+    np.testing.assert_array_equal(got, np.arange(9))
+    assert any("sidecar" in str(x.message) for x in w)
+
+
+def test_trust_mode_skips_checksums(tmp_path):
+    p = str(tmp_path / "x.seq")
+    write_sequence(np.array([3, 1, 2], np.uint32), p)
+    # poison the sidecar: trust mode must not even look at it
+    with open(sidecar_path(p), "w") as f:
+        f.write("sheep-sum 1\nalgo crc32\nsize 1\nsum 00000000\n")
+    with pytest.raises(ChecksumMismatch):
+        read_sequence(p)
+    np.testing.assert_array_equal(read_sequence(p, integrity="trust"),
+                                  [3, 1, 2])
+
+
+# ---------------------------------------------------------------------------
+# corruption fuzz: every artifact class x every byte-region class
+# ---------------------------------------------------------------------------
+
+
+def _write_artifacts(d, tail, head, seq, forest):
+    paths = {}
+    paths[".tre"] = str(d / "a.tre")
+    write_tree(paths[".tre"], forest.parent, forest.pst_weight, sig="s1")
+    paths[".seq"] = str(d / "a.seq")
+    write_sequence(seq, paths[".seq"])
+    paths[".seqb"] = str(d / "b.seq")
+    write_sequence(seq, paths[".seqb"], binary=True)
+    paths[".dat"] = str(d / "a.dat")
+    write_edges(paths[".dat"], tail, head)
+    paths[".net"] = str(d / "a.net")
+    write_edges(paths[".net"], tail, head)
+    return paths
+
+
+def _read_artifact(suffix, path):
+    if suffix == ".tre":
+        return read_tree(path)
+    if suffix == ".seq":
+        return read_sequence(path)
+    if suffix == ".seqb":
+        return read_sequence(path, binary=True)
+    return load_edges(path)
+
+
+def _corrupt_sidecar_sum(p):
+    """Deterministically flip one hex digit of the recorded checksum."""
+    sc = sidecar_path(p)
+    lines = open(sc).read().splitlines()
+    for i, ln in enumerate(lines):
+        if ln.startswith("sum "):
+            digit = ln[4]
+            lines[i] = "sum " + ("0" if digit != "0" else "1") + ln[5:]
+            break
+    open(sc, "w").write("\n".join(lines) + "\n")
+
+
+@pytest.mark.parametrize("suffix", [".tre", ".seq", ".seqb", ".dat", ".net"])
+@pytest.mark.parametrize("region", ["header", "body", "tail-truncate",
+                                    "sidecar"])
+def test_fuzz_corruption_is_always_detected(tmp_path, small_forest,
+                                            suffix, region):
+    """Flip/truncate each byte-region class of each artifact class and
+    assert strict mode raises a typed IntegrityError — never silent
+    acceptance of changed bytes."""
+    tail, head, seq, forest = small_forest
+    paths = _write_artifacts(tmp_path, tail, head, seq, forest)
+    p = paths[suffix]
+    _read_artifact(suffix, p)  # clean read passes
+    if region == "header":
+        _flip(p, 1)
+    elif region == "body":
+        _flip(p, os.path.getsize(p) // 2)
+    elif region == "tail-truncate":
+        _truncate(p, 3)
+    elif region == "sidecar":
+        _corrupt_sidecar_sum(p)
+    with pytest.raises(IntegrityError):
+        _read_artifact(suffix, p)
+
+
+@pytest.mark.parametrize("member_byte", [30, 200, 999])
+def test_fuzz_snapshot_member_corruption_detected(tmp_path, member_byte):
+    from sheep_tpu.runtime.snapshot import (Checkpointer, Snapshot,
+                                            input_signature, load_snapshot)
+
+    seq = np.arange(32, dtype=np.uint32)
+    sig = input_signature(32, seq)
+    ck = Checkpointer(str(tmp_path))
+    ck.save(Snapshot(n=32, seq=seq, pst=np.ones(32, np.uint32),
+                     lo=np.arange(8, dtype=np.int32),
+                     hi=np.arange(8, 16, dtype=np.int32),
+                     rounds=2, boundary=0, rung="single", input_sig=sig))
+    load_snapshot(ck.path)  # clean loads
+    _flip(ck.path, member_byte)
+    with pytest.raises(IntegrityError):
+        load_snapshot(ck.path)
+    # even WITHOUT the sidecar, the zip/structural layers must catch it
+    os.unlink(sidecar_path(ck.path))
+    with pytest.raises(IntegrityError):
+        load_snapshot(ck.path)
+
+
+def test_snapshot_missing_member_detected(tmp_path):
+    import zipfile
+
+    from sheep_tpu.runtime.snapshot import load_snapshot
+
+    p = str(tmp_path / "sheep-ckpt.npz")
+    with open(p, "wb") as f:
+        np.savez(f, version=np.int64(1), n=np.int64(4))  # most members gone
+    with pytest.raises(MalformedArtifact, match="corrupt snapshot"):
+        load_snapshot(p)
+
+
+def test_snapshot_structural_lies_detected(tmp_path):
+    from sheep_tpu.runtime.snapshot import Checkpointer, Snapshot
+
+    seq = np.arange(8, dtype=np.uint32)
+    bad = Snapshot(n=8, seq=seq, pst=np.ones(8, np.uint32),
+                   lo=np.array([5], np.int32), hi=np.array([3], np.int32),
+                   rounds=0, boundary=0, rung="single", input_sig="x")
+    with pytest.raises(MalformedArtifact, match="lo < hi"):
+        Checkpointer(str(tmp_path)).save(bad)  # refused BEFORE durable
+
+
+# ---------------------------------------------------------------------------
+# hardened parsers: the specific lies named in the issue
+# ---------------------------------------------------------------------------
+
+
+def test_tre_end_id_lies(tmp_path, small_forest):
+    _, _, _, forest = small_forest
+    p = str(tmp_path / "t.tre")
+    write_tree(p, forest.parent, forest.pst_weight)
+    raw = bytearray(open(p, "rb").read())
+    raw[0:4] = np.uint32(len(forest.parent) + 9).tobytes()  # claim more
+    open(p, "wb").write(bytes(raw))
+    os.unlink(sidecar_path(p))  # force the structural layer to catch it
+    with pytest.raises(MalformedArtifact, match="end_id"):
+        read_tree(p)
+
+
+def test_tre_non_monotone_parent_rejected(tmp_path):
+    p = str(tmp_path / "t.tre")
+    # node 2 claims parent 1 (earlier) — a cycle-capable corruption that
+    # stays in range, so only the monotonicity check can see it
+    write_tree(p, np.array([2, 2, 1], np.uint32), np.zeros(3, np.uint32))
+    with pytest.raises(MalformedArtifact, match="strictly later"):
+        read_tree(p)
+
+
+def test_dat_length_not_multiple_of_12(tmp_path):
+    p = str(tmp_path / "g.dat")
+    write_edges(p, np.array([1], np.uint32), np.array([2], np.uint32))
+    os.unlink(sidecar_path(p))
+    with open(p, "ab") as f:
+        f.write(b"\x01\x02\x03")
+    with pytest.raises(MalformedArtifact, match="multiple"):
+        load_edges(p)
+    with warnings.catch_warnings(record=True):
+        warnings.simplefilter("always")
+        el = load_edges(p, integrity="repair")  # drops the torn record
+    assert el.num_edges == 1
+
+
+def test_net_non_integer_tokens(tmp_path):
+    p = str(tmp_path / "g.net")
+    p_ = open(p, "w")
+    p_.write("1 2\n3 four\n5 6\n")
+    p_.close()
+    with pytest.raises(MalformedArtifact, match="non-integer"):
+        load_edges(p)
+    with warnings.catch_warnings(record=True):
+        warnings.simplefilter("always")
+        el = load_edges(p, integrity="repair")
+    np.testing.assert_array_equal(el.tail, [1, 5])
+    np.testing.assert_array_equal(el.head, [2, 6])
+
+
+def test_net_out_of_range_vid(tmp_path):
+    p = str(tmp_path / "g.net")
+    with open(p, "w") as f:
+        f.write(f"1 {1 << 33}\n")
+    with pytest.raises(MalformedArtifact, match="uint32"):
+        load_edges(p)
+
+
+def test_seq_binary_text_confusion(tmp_path):
+    seq = np.array([7, 0, 3, 1], np.uint32)
+    pt = str(tmp_path / "t.seq")
+    pb = str(tmp_path / "b.seq")
+    write_sequence(seq, pt, binary=False)
+    write_sequence(seq, pb, binary=True)
+    with pytest.raises(MalformedArtifact, match="BINARY"):
+        read_sequence(pb, binary=False)
+    with pytest.raises(MalformedArtifact, match="TEXT"):
+        read_sequence(pt, binary=True)
+    # auto sniff reads both correctly (the fsck path)
+    np.testing.assert_array_equal(read_sequence(pt, binary="auto"), seq)
+    np.testing.assert_array_equal(read_sequence(pb, binary="auto"), seq)
+
+
+def test_repair_net_yields_subset_of_clean_multiset(tmp_path):
+    """Property (seeded trials, no hypothesis in this container): under
+    token-invalidating byte damage, repair-mode .net parsing yields a
+    sub-multiset of the clean edge multiset — corruption can only REMOVE
+    edges, never invent or rewire them."""
+    rng = np.random.default_rng(42)
+    tail = rng.integers(0, 97, 300).astype(np.uint32)
+    head = rng.integers(0, 97, 300).astype(np.uint32)
+    p = str(tmp_path / "g.net")
+    write_edges(p, tail, head)
+    clean_bytes = open(p, "rb").read()
+
+    def multiset(t, h):
+        from collections import Counter
+        return Counter(zip(t.tolist(), h.tolist()))
+
+    clean = multiset(tail, head)
+    garbage = np.frombuffer(b"@!x#\xff\x00ZQ~", dtype=np.uint8)
+    for trial in range(12):
+        raw = bytearray(clean_bytes)
+        for _ in range(int(rng.integers(1, 8))):
+            at = int(rng.integers(0, len(raw)))
+            span = int(rng.integers(1, 6))
+            for i in range(at, min(at + span, len(raw))):
+                raw[i] = int(garbage[int(rng.integers(0, len(garbage)))])
+        open(p, "wb").write(bytes(raw))
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            el = load_edges(p, integrity="repair")
+        got = multiset(el.tail, el.head)
+        assert not got - clean, \
+            f"trial {trial}: repair invented edges {got - clean}"
+
+
+# ---------------------------------------------------------------------------
+# tiered oracles
+# ---------------------------------------------------------------------------
+
+
+def test_fast_oracle_accepts_valid_and_names_problems(small_forest):
+    tail, head, seq, forest = small_forest
+    from sheep_tpu.core.forest import edges_to_positions
+    lo, hi = edges_to_positions(tail, head, seq)
+    assert check_forest_fast(forest, lo, hi) == []
+
+    bad = forest.copy()
+    bad.parent[5] = 2  # earlier than 5: monotonicity
+    assert any("strictly later" in p for p in check_forest_fast(bad))
+
+    bad = forest.copy()
+    bad.parent[0] = len(bad.parent) + 7  # out of range
+    assert any("out of range" in p for p in check_forest_fast(bad))
+
+    bad = forest.copy()
+    bad.pst_weight = bad.pst_weight.copy()
+    bad.pst_weight[1] += 1  # breaks conservation + histogram
+    assert check_forest_fast(bad, lo, hi)
+
+
+@pytest.mark.parametrize("corrupt", [False, True])
+def test_exact_oracle_lifted_agrees_with_loop(small_forest, corrupt):
+    tail, head, seq, forest = small_forest
+    f = forest.copy()
+    if corrupt:
+        # sever one link: the edge that CREATED parent[j] loses its root
+        # path (paths are unique in a forest), so the forest is provably
+        # invalid while every fast-tier invariant still holds — only the
+        # exact walk can see it
+        linked = np.flatnonzero(f.parent != INVALID_JNID)
+        j = int(linked[len(linked) // 2])
+        f.parent[j] = INVALID_JNID
+    got_lifted = is_valid_forest(f, tail, head, seq, exact="lifted")
+    got_loop = is_valid_forest(f, tail, head, seq, exact="loop")
+    assert got_lifted == got_loop
+    assert got_lifted == (not corrupt)
+
+
+def test_exact_oracle_randomized_agreement():
+    rng = np.random.default_rng(7)
+    for trial in range(6):
+        tail, head = rmat_edges(7, 3 << 7, seed=100 + trial)
+        seq = degree_sequence(tail, head)
+        forest = build_forest(tail, head, seq)
+        assert is_valid_forest(forest, tail, head, seq, exact="lifted")
+        assert is_valid_forest(forest, tail, head, seq, exact="loop")
+        # random single-pointer corruption: both walkers must agree
+        f = forest.copy()
+        linked = np.flatnonzero(f.parent != INVALID_JNID)
+        if len(linked):
+            j = int(rng.choice(linked))
+            new_parent = int(rng.integers(j + 1, f.n))
+            f.parent[j] = new_parent
+            assert is_valid_forest(f, tail, head, seq, exact="lifted") == \
+                is_valid_forest(f, tail, head, seq, exact="loop"), trial
+
+
+def test_validate_loop_env_flag(small_forest, monkeypatch):
+    tail, head, seq, forest = small_forest
+    monkeypatch.setenv("SHEEP_VALIDATE_LOOP", "1")
+    assert is_valid_forest(forest, tail, head, seq)
+
+
+# ---------------------------------------------------------------------------
+# merge-compatibility guards
+# ---------------------------------------------------------------------------
+
+
+def test_merge_forests_refuses_length_mismatch():
+    a = Forest(np.array([INVALID_JNID], np.uint32), np.zeros(1, np.uint32))
+    b = Forest(np.full(2, INVALID_JNID, np.uint32), np.zeros(2, np.uint32))
+    with pytest.raises(IncompatibleMerge, match="differing length"):
+        merge_forests(a, b)
+
+
+def _run_cli(mod, *args, env_extra=None):
+    env = dict(os.environ, PYTHONPATH=REPO + os.pathsep
+               + os.environ.get("PYTHONPATH", ""), JAX_PLATFORMS="cpu")
+    env.update(env_extra or {})
+    return subprocess.run([sys.executable, "-m", mod] + list(args),
+                          capture_output=True, text=True, env=env,
+                          cwd=REPO, timeout=300)
+
+
+def test_merge_trees_cli_refuses_mismatched_inputs(tmp_path, small_forest):
+    _, _, _, forest = small_forest
+    a = str(tmp_path / "a.tre")
+    b = str(tmp_path / "b.tre")
+    write_tree(a, forest.parent, forest.pst_weight, sig="sig-one")
+    # a VALID tree of a different length (the guard, not the parser,
+    # must be what refuses it)
+    write_tree(b, np.array([1, INVALID_JNID], np.uint32),
+               np.array([1, 0], np.uint32))
+    out = str(tmp_path / "m.tre")
+    r = _run_cli("sheep_tpu.cli.merge_trees", a, b, "-o", out)
+    assert r.returncode == 1
+    assert "differing" in r.stderr or "node count" in r.stderr
+    assert not os.path.exists(out)
+
+    # same length, clashing sidecar signatures
+    c = str(tmp_path / "c.tre")
+    write_tree(c, forest.parent, forest.pst_weight, sig="sig-two")
+    r = _run_cli("sheep_tpu.cli.merge_trees", a, c, "-o", out)
+    assert r.returncode == 1
+    assert "signature" in r.stderr
+    assert not os.path.exists(out)
+
+    # matching signatures merge fine and stamp the sig onward
+    d = str(tmp_path / "d.tre")
+    write_tree(d, forest.parent, forest.pst_weight, sig="sig-one")
+    r = _run_cli("sheep_tpu.cli.merge_trees", a, d, "-o", out)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert read_sidecar(out)["sig"] == "sig-one"
+
+
+# ---------------------------------------------------------------------------
+# sheep fsck
+# ---------------------------------------------------------------------------
+
+
+def test_fsck_clean_dir_exits_zero(tmp_path, small_forest):
+    tail, head, seq, forest = small_forest
+    _write_artifacts(tmp_path, tail, head, seq, forest)
+    r = _run_cli("sheep_tpu.cli.fsck", str(tmp_path))
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "0 bad" in r.stdout
+
+
+@pytest.mark.parametrize("victim", [".tre", ".seq", ".seqb", ".dat", ".net"])
+def test_fsck_detects_each_fuzzed_class(tmp_path, small_forest, victim):
+    tail, head, seq, forest = small_forest
+    paths = _write_artifacts(tmp_path, tail, head, seq, forest)
+    _flip(paths[victim], os.path.getsize(paths[victim]) // 2)
+    r = _run_cli("sheep_tpu.cli.fsck", "-q", str(tmp_path))
+    assert r.returncode == 1, r.stdout + r.stderr
+    assert "FAIL" in r.stdout
+    assert os.path.basename(paths[victim]) in r.stdout
+
+
+def test_fsck_snapshot_and_usage(tmp_path):
+    from sheep_tpu.runtime.snapshot import (Checkpointer, Snapshot,
+                                            input_signature)
+
+    seq = np.arange(16, dtype=np.uint32)
+    ck = Checkpointer(str(tmp_path))
+    ck.save(Snapshot(n=16, seq=seq, pst=np.zeros(16, np.uint32),
+                     lo=np.empty(0, np.int32), hi=np.empty(0, np.int32),
+                     rounds=0, boundary=0, rung="host",
+                     input_sig=input_signature(16, seq)))
+    r = _run_cli("sheep_tpu.cli.fsck", ck.path)
+    assert r.returncode == 0, r.stdout + r.stderr
+    _flip(ck.path, 77)
+    r = _run_cli("sheep_tpu.cli.fsck", ck.path)
+    assert r.returncode == 1
+    r = _run_cli("sheep_tpu.cli.fsck")
+    assert r.returncode == 2  # usage
+    r = _run_cli("sheep_tpu.cli.fsck", "-m", "bogus", ck.path)
+    assert r.returncode == 2
+
+
+def test_fsck_seed_data_artifacts_clean():
+    """Acceptance: fsck exits zero on the repo's own seed artifacts
+    (no sidecars there — structural checks only)."""
+    r = _run_cli("sheep_tpu.cli.fsck", "-q",
+                 os.path.join(REPO, "data", "hep-th.dat"))
+    assert r.returncode == 0, r.stdout + r.stderr
+
+
+# ---------------------------------------------------------------------------
+# runtime: corrupt-at-every-boundary (the acceptance property)
+# ---------------------------------------------------------------------------
+
+
+def test_corrupt_snapshot_at_every_boundary(tmp_path):
+    """Kill the build at EVERY chunk boundary, bit-flip the snapshot it
+    left, then resume: strict policy rejects with a typed IntegrityError;
+    repair policy discards the corrupt checkpoint, rebuilds fresh, and
+    the final tree is bit-identical with identical ECV(down)."""
+    from sheep_tpu.runtime import (BuildKilled, FaultPlan, RuntimeConfig,
+                                   build_graph_resilient, clear_plan,
+                                   install_plan)
+    from sheep_tpu.runtime.snapshot import SNAPSHOT_NAME
+
+    tail, head = rmat_edges(9, 4 << 9, seed=11)
+
+    def _build(d, resume=False, integrity=None):
+        cfg = RuntimeConfig(checkpoint_dir=d, resume=resume,
+                            ladder=("single", "host"), backoff_base_s=0.0,
+                            integrity=integrity)
+        seq, forest = build_graph_resilient(tail, head, config=cfg)
+        return seq, forest, cfg
+
+    def _ecv(seq, forest):
+        from sheep_tpu.partition.evaluate import evaluate_partition
+        from sheep_tpu.partition.partition import Partition
+        p = Partition.from_forest(seq, forest, 2)
+        return evaluate_partition(p.parts, tail, head, seq,
+                                  p.num_parts).ecv_down
+
+    seq0, forest0, cfg0 = _build(str(tmp_path / "base"))
+    ecv0 = _ecv(seq0, forest0)
+    boundaries = [e for e in cfg0.events if e[0] == "checkpoint"]
+    assert len(boundaries) >= 3
+
+    for k in range(len(boundaries)):
+        d = str(tmp_path / f"cor{k}")
+        install_plan(FaultPlan(site="boundary", at=k, kind="kill"))
+        with pytest.raises(BuildKilled):
+            _build(d)
+        clear_plan()
+        snap_path = os.path.join(d, SNAPSHOT_NAME)
+        assert os.path.exists(snap_path), k
+        _flip(snap_path, 64 + 13 * k)
+
+        # strict: detected, refused
+        with pytest.raises(IntegrityError):
+            _build(d, resume=True, integrity="strict")
+
+        # repair: detected, discarded, rebuilt fresh — bit-identical
+        seq1, forest1, cfg1 = _build(d, resume=True, integrity="repair")
+        assert any(e[0] == "corrupt-checkpoint" for e in cfg1.events), k
+        np.testing.assert_array_equal(seq1, seq0, err_msg=str(k))
+        np.testing.assert_array_equal(forest1.parent, forest0.parent,
+                                      err_msg=f"corrupt at boundary {k}")
+        np.testing.assert_array_equal(forest1.pst_weight,
+                                      forest0.pst_weight,
+                                      err_msg=f"corrupt at boundary {k}")
+        assert _ecv(seq1, forest1) == ecv0, k
+
+
+def test_checkpoint_clear_removes_sidecar(tmp_path):
+    from sheep_tpu.runtime.snapshot import (Checkpointer, Snapshot,
+                                            input_signature)
+
+    seq = np.arange(4, dtype=np.uint32)
+    ck = Checkpointer(str(tmp_path))
+    ck.save(Snapshot(n=4, seq=seq, pst=np.zeros(4, np.uint32),
+                     lo=np.empty(0, np.int32), hi=np.empty(0, np.int32),
+                     rounds=0, boundary=0, rung="host",
+                     input_sig=input_signature(4, seq)))
+    assert os.path.exists(sidecar_path(ck.path))
+    ck.clear()
+    assert os.listdir(tmp_path) == []
+
+
+def test_fsck_paths_api(tmp_path, small_forest):
+    tail, head, seq, forest = small_forest
+    paths = _write_artifacts(tmp_path, tail, head, seq, forest)
+    results, failures = fsck_paths([str(tmp_path)])
+    assert len(results) == len(paths) and not failures
+    _truncate(paths[".tre"], 5)
+    results, failures = fsck_paths([str(tmp_path)])
+    assert len(failures) == 1 and failures[0][0] == paths[".tre"]

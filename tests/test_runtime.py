@@ -109,7 +109,9 @@ def test_tree_and_sequence_writers_are_atomic(tmp_path, monkeypatch):
     seqp = tmp_path / "s.seq"
     write_sequence(np.array([3, 1, 2], np.uint32), str(seqp))
     np.testing.assert_array_equal(read_sequence(str(seqp)), [3, 1, 2])
-    assert sorted(os.listdir(tmp_path)) == ["s.seq", "t.tre"]
+    # no temp litter — just the artifacts and their checksum sidecars
+    assert sorted(os.listdir(tmp_path)) == \
+        ["s.seq", "s.seq.sum", "t.tre", "t.tre.sum"]
 
 
 # ---------------------------------------------------------------------------

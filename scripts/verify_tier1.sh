@@ -49,4 +49,45 @@ else
 fi
 # -------------------------------------------------------------------------
 
+# --- chaos smoke (tournament supervisor, ISSUE 3) ------------------------
+# One kill round + one corrupt round through the supervised tournament on
+# a tiny synthetic graph; the final tree must be bit-identical to the
+# fault-free supervised run.  Seconds of work (in-process legs); a
+# regression in the supervisor's recovery paths fails the gate before
+# pytest even runs.
+if ! env JAX_PLATFORMS=cpu python - <<'EOF'
+import tempfile
+from sheep_tpu.supervisor import (InlineRunner, SupervisorConfig,
+                                  parse_fault_plan, run_supervised)
+from sheep_tpu.io.edges import write_net
+from sheep_tpu.utils.synth import rmat_edges
+
+d = tempfile.mkdtemp()
+tail, head = rmat_edges(6, 4 << 6, seed=5)
+graph = d + "/g.net"
+write_net(graph, tail, head)
+
+def run(name, chaos=None):
+    cfg = SupervisorConfig(workers=2, poll_s=0.01, backoff_base_s=0.0,
+                           chaos=chaos, grammar=False)
+    m = run_supervised(graph, f"{d}/{name}", cfg, runner=InlineRunner(0.05))
+    with open(m.final_tree, "rb") as f:
+        data = f.read()
+    return data, m
+
+base, _ = run("base")
+hurt, m = run("chaos", parse_fault_plan("kill@0:0,corrupt@1:0"))
+assert hurt == base, "chaos run diverged from the fault-free tree"
+counts = {leg.key: leg.dispatches for leg in m.legs}
+assert counts["r0.00"] == 2 and counts["r1.00"] == 2, counts
+assert all(n == 1 for k, n in counts.items()
+           if k not in ("r0.00", "r1.00")), counts
+EOF
+then
+  echo "CHAOS SMOKE FAILED: supervised recovery did not reproduce the" \
+       "fault-free tree" >&2
+  exit 1
+fi
+# -------------------------------------------------------------------------
+
 set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=${PIPESTATUS[0]}; echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c); exit $rc

@@ -13,6 +13,15 @@ import time
 import numpy as np
 
 
+def maybe_start_heartbeat():
+    """Start the worker heartbeat when a supervisor launched this process
+    with SHEEP_HEARTBEAT_FILE in the environment (supervisor/heartbeat.py).
+    Returns the writer (kept alive for the process lifetime) or None —
+    unsupervised invocations are unaffected."""
+    from ..supervisor.heartbeat import maybe_start_from_env
+    return maybe_start_from_env()
+
+
 def ensure_jax_platform() -> None:
     """Honor JAX_PLATFORMS even when a sitecustomize force-registered a
     hardware plugin and initialized the backend programmatically (in which

@@ -45,6 +45,8 @@ def _streamed_sequence(path: str) -> np.ndarray:
 
 def main(argv: list[str] | None = None) -> int:
     argv = sys.argv[1:] if argv is None else argv
+    from .common import maybe_start_heartbeat
+    _hb = maybe_start_heartbeat()  # noqa: F841 — beats while we stream
     if len(argv) != 2:
         print("USAGE: degree_sequence graph_file output_file", end="")
         return 1

@@ -10,8 +10,15 @@ flaky disk or interrupted copy may have touched:
     bin/fsck trial-dir/                      # every artifact underneath
     bin/fsck graph.dat out.tre ckpt/sheep-ckpt.npz
     bin/fsck -m repair damaged.net           # report what repair would keep
+    bin/fsck -R copied.tre                   # reseal a lost/wrong sidecar
 
-Exit codes: 0 all clean, 1 corruption found, 2 usage error.
+``-R`` / ``--repair-sidecar`` reseals the ``.sum`` sidecar of every
+artifact that structurally verifies but whose sidecar is lost or wrong
+(integrity.fsck.repair_sidecar) — the recovery for a foreign copy or the
+crash window between the artifact and sidecar renames.  Artifacts that
+fail their structural checks are still reported FAIL, never resealed.
+
+Exit codes: 0 all clean (or resealed), 1 corruption found, 2 usage error.
 """
 
 from __future__ import annotations
@@ -19,22 +26,60 @@ from __future__ import annotations
 import getopt
 import sys
 
-from ..integrity.fsck import fsck_paths
-from ..integrity.sidecar import POLICIES
+from ..integrity.errors import IntegrityError
+from ..integrity.fsck import (collect_artifacts, fsck_file, fsck_paths,
+                              repair_sidecar)
+from ..integrity.sidecar import POLICIES, read_sidecar
 
-USAGE = "USAGE: fsck [-q] [-m strict|repair|trust] path [path ...]"
+USAGE = ("USAGE: fsck [-q] [-m strict|repair|trust] [-R|--repair-sidecar] "
+         "path [path ...]")
+
+
+def _repair_run(args: list[str], quiet: bool) -> int:
+    """The --repair-sidecar pass: verify strictly; on any failure (or a
+    clean artifact with no sidecar to vouch for it) attempt a structural
+    reseal.  Only artifacts that refuse to parse stay FAIL."""
+    resealed = failures = checked = 0
+    for root in args:
+        targets = collect_artifacts(root)
+        if not targets:
+            print(f"FAIL {root}: no artifacts found")
+            failures += 1
+            continue
+        for path in targets:
+            checked += 1
+            try:
+                detail = fsck_file(path, "strict")
+                missing = read_sidecar(path) is None
+            except (IntegrityError, OSError):
+                detail, missing = None, True
+            if detail is not None and not missing:
+                if not quiet:
+                    print(f"OK   {path}: {detail}")
+                continue
+            try:
+                summary = repair_sidecar(path)
+                resealed += 1
+                print(f"SEAL {path}: {summary} (sidecar resealed)")
+            except (IntegrityError, OSError) as exc:
+                failures += 1
+                print(f"FAIL {path}: {exc}")
+    print(f"fsck: {checked} artifact(s) checked, {resealed} resealed, "
+          f"{failures} bad")
+    return 1 if failures else 0
 
 
 def main(argv: list[str] | None = None) -> int:
     argv = sys.argv[1:] if argv is None else argv
     try:
-        opts, args = getopt.gnu_getopt(argv, "qm:v")
+        opts, args = getopt.gnu_getopt(argv, "qm:vR", ["repair-sidecar"])
     except getopt.GetoptError as exc:
         print(f"Unknown option character '{(exc.opt or '?')[:1]}'.")
         return 2
 
     quiet = False
     mode = None
+    reseal = False
     for o, a in opts:
         if o == "-q":
             quiet = True
@@ -45,6 +90,8 @@ def main(argv: list[str] | None = None) -> int:
             mode = a
         elif o == "-v":
             quiet = False
+        elif o in ("-R", "--repair-sidecar"):
+            reseal = True
 
     if not args:
         print(USAGE)
@@ -54,6 +101,8 @@ def main(argv: list[str] | None = None) -> int:
     with warnings.catch_warnings():
         # repair-mode salvage warnings become part of the report lines
         warnings.simplefilter("ignore")
+        if reseal:
+            return _repair_run(args, quiet)
         results, failures = fsck_paths(args, mode)
     for path, ok, detail in results:
         if ok and not quiet:

@@ -74,6 +74,8 @@ def _finish_sort(seq, use_mesh_sort, sequence_filename, clock,
 
 def main(argv: list[str] | None = None) -> int:
     argv = sys.argv[1:] if argv is None else argv
+    from .common import maybe_start_heartbeat
+    _hb = maybe_start_heartbeat()  # noqa: F841 — beats while we build
     try:
         # Long options are the fault-tolerance surface (sheep_tpu.runtime):
         # they have no reference counterpart, so they take GNU spellings

@@ -56,10 +56,19 @@ def check_merge_compatible(paths: list[str],
 
 def main(argv: list[str] | None = None) -> int:
     argv = sys.argv[1:] if argv is None else argv
+    from .common import maybe_start_heartbeat
+    _hb = maybe_start_heartbeat()  # noqa: F841 — beats while we merge
     try:
-        opts, args = getopt.gnu_getopt(argv, "o:vkf")
+        # --expect-sig: the tournament supervisor pins every merge to the
+        # manifest's input signature, so a stale artifact from a different
+        # build (or a speculative loser that raced a resume) can never be
+        # zipped in even if its own sidecars agree with each other.
+        opts, args = getopt.gnu_getopt(argv, "o:vkf", ["expect-sig="])
     except getopt.GetoptError as exc:
         o = (exc.opt or "?")[:1]
+        if (exc.opt or "").startswith("expect-sig"):
+            print(f"Option --{exc.opt}: {exc.msg}.")
+            return 1
         if o == "o":
             print(f"Option -{o} requires a string.")
         else:
@@ -69,6 +78,7 @@ def main(argv: list[str] | None = None) -> int:
     output_filename = ""
     verbose = False
     do_faqs = False
+    expect_sig = None
     for o, a in opts:
         if o == "-o":
             output_filename = a
@@ -78,6 +88,8 @@ def main(argv: list[str] | None = None) -> int:
             pass  # make_kids: kids are always derivable from parents here
         elif o == "-f":
             do_faqs = not do_faqs
+        elif o == "--expect-sig":
+            expect_sig = a
 
     if len(args) < 2:
         print("USAGE: merge_trees [options ...] first.tree second.tree")
@@ -90,6 +102,11 @@ def main(argv: list[str] | None = None) -> int:
     try:
         inputs = [Forest(*read_tree(a)) for a in args]
         sig = check_merge_compatible(args, inputs)
+        if expect_sig is not None and sig is not None and sig != expect_sig:
+            raise IncompatibleMerge(
+                f"inputs carry signature {sig[:12]}... but the caller "
+                f"expects {expect_sig[:12]}... — these trees belong to a "
+                f"different build; refusing to merge")
     except IntegrityError as exc:
         print(f"merge_trees: {exc}", file=sys.stderr)
         return 1

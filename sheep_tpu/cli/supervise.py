@@ -1,0 +1,109 @@
+"""sheep supervise: the chaos-hardened distributed tournament driver.
+
+No reference counterpart — the reference's file path is fire-and-forget
+bash; this tool is the operational face of sheep_tpu.supervisor.  It owns
+the sort -> map -> merge-tournament lifecycle of the distributed file
+path and survives any single-point failure (dead/hung/straggling workers,
+corrupted artifacts, its own death — rerun with the same -d to resume):
+
+    bin/supervise graph.dat -w 8 -d state/ -o graph.tre
+    bin/supervise graph.dat -w 8 -d state/ -o graph.tre   # resumes
+
+scripts/horizontal-dist.sh delegates to this under dist-partition.sh -S.
+
+Options:
+  -d DIR   state dir: manifest + intermediate artifacts + worker logs
+           (default: <graph>.supervisor).  Rerunning with the same dir
+           fscks the surviving artifacts and re-dispatches only the
+           dirty/missing legs.
+  -w N     tournament width (map workers; default SHEEP_WORKERS or 2)
+  -r N     tournament fan-in (default REDUCTION or 2)
+  -s SEQ   existing sequence file to build over (skip the sort phase)
+  -o OUT   final tree path (default <state-dir>/<base>.tre)
+  -t SEC   heartbeat deadline (default SHEEP_DEADLINE_S or 30)
+  -v       echo the event trace as it happens
+
+Exit codes: 0 tournament complete, 1 failure (budget spent / bad state
+dir), 2 usage error.  SHEEP_FAULT_PLAN (see supervisor/chaos.py) injects
+deterministic faults — operators can rehearse a recovery before trusting
+a multi-hour run to it.
+"""
+
+from __future__ import annotations
+
+import getopt
+import sys
+
+from ..integrity.errors import IntegrityError
+from ..supervisor import (SupervisionFailed, SupervisorConfig,
+                          SupervisorKilled, run_supervised)
+
+USAGE = ("USAGE: supervise graph [-d state_dir] [-w workers] [-r reduction]"
+         " [-s seq_file] [-o out_tree] [-t deadline_s] [-v]")
+
+
+def main(argv: list[str] | None = None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    try:
+        opts, args = getopt.gnu_getopt(argv, "d:w:r:s:o:t:v")
+    except getopt.GetoptError as exc:
+        print(f"Unknown option character '{(exc.opt or '?')[:1]}'.")
+        return 2
+
+    state_dir = None
+    seq_file = None
+    out_file = None
+    verbose = False
+    overrides: dict = {}
+    for o, a in opts:
+        if o == "-d":
+            state_dir = a
+        elif o == "-w":
+            overrides["workers"] = int(a)
+        elif o == "-r":
+            overrides["reduction"] = int(a)
+        elif o == "-s":
+            seq_file = a
+        elif o == "-o":
+            out_file = a
+        elif o == "-t":
+            overrides["deadline_s"] = float(a)
+        elif o == "-v":
+            verbose = True
+
+    if len(args) != 1:
+        print(USAGE)
+        return 2
+    graph = args[0]
+    state_dir = state_dir or graph + ".supervisor"
+
+    try:
+        config = SupervisorConfig.from_env(**overrides)
+    except ValueError as exc:
+        print(f"supervise: {exc}", file=sys.stderr)
+        return 2
+
+    if verbose:
+        class _Echo(list):
+            def append(self, item):
+                print(f"supervise: {' '.join(str(x) for x in item)}",
+                      flush=True)
+                super().append(item)
+        config.events = _Echo()
+
+    try:
+        manifest = run_supervised(graph, state_dir, config,
+                                  seq_file=seq_file, out_file=out_file)
+    except (SupervisionFailed, SupervisorKilled, IntegrityError,
+            OSError) as exc:
+        print(f"supervise: {exc}", file=sys.stderr)
+        return 1
+    dispatches = sum(leg.dispatches for leg in manifest.legs)
+    print(f"supervise: {len(manifest.legs)} leg(s) complete in "
+          f"{dispatches} dispatch(es); tree at "
+          f"{out_file or manifest.final_tree}", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -113,6 +113,42 @@ def collect_artifacts(root: str) -> list[str]:
     return out
 
 
+def repair_sidecar(path: str) -> str:
+    """Reseal ``path``'s ``.sum`` sidecar after verifying the artifact
+    STRUCTURALLY (``sheep fsck --repair-sidecar``).
+
+    The operation covers exactly two legitimate states: a sidecar that was
+    LOST (a foreign copy, an interrupted ``cp`` that moved the artifact
+    but not its sidecar) and a sidecar that is WRONG for bytes that still
+    parse (the crash window between the artifact rename and the sidecar
+    rename, sidecar.py module docstring).  Every format/semantic check the
+    artifact class has still runs — only the checksum layer is skipped —
+    so garbage is refused, never vouched for; but note the honest limit:
+    a corruption that keeps the artifact structurally valid is
+    indistinguishable from a legitimate reseal, which is why this is an
+    explicit operator command and never an automatic fsck response.
+
+    The old sidecar's ``sig`` is deliberately DROPPED: the signature ties
+    an artifact to the build input that produced it, and bytes that no
+    longer match their sidecar can no longer prove that tie.  A resealed
+    tree therefore re-enters merges as a foreign (sig-less) input.
+
+    Returns the check summary; raises IntegrityError when the artifact
+    does not verify.
+    """
+    for suffix, checker in _CHECKERS.items():
+        if path.endswith(suffix):
+            detail = checker(path, "trust")
+            break
+    else:
+        raise MalformedArtifact(
+            f"{path}: not a sheep artifact (want one of "
+            f"{'/'.join(_CHECKERS)}) — nothing to reseal")
+    from .sidecar import write_sidecar
+    write_sidecar(path)
+    return detail
+
+
 def fsck_paths(paths, mode: str | None = None):
     """Verify every artifact reachable from ``paths``.
 

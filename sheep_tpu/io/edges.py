@@ -311,7 +311,8 @@ def iter_dat_blocks(path: str, block_edges: int, part: int = 0,
             warnings.warn(msg)
 
 
-def iter_net_blocks(path: str, block_bytes: int = 1 << 26):
+def iter_net_blocks(path: str, block_bytes: int = 1 << 26,
+                    integrity: str | None = None):
     """Stream a SNAP ``.net`` text file as (tail, head) uint32 blocks.
 
     The reference's fileSequence streams text files record by record
@@ -319,7 +320,28 @@ def iter_net_blocks(path: str, block_bytes: int = 1 << 26):
     the last newline, comment lines dropped, and the tokens parsed in bulk.
     A trailing half-record (odd token count in the whole file) raises like
     :func:`read_net`.
+
+    Integrity: stream-verified block-wise like the ``.dat`` path
+    (:func:`iter_dat_blocks`) — when a sidecar exists, its recorded size is
+    checked up front and the checksum accumulates over the raw chunks as
+    they are read, raising AT THE END of the stream on a mismatch: bounded
+    memory is kept, and a corrupted file still fails the run instead of
+    feeding garbage into the fold.  (An abandoned generator never reaches
+    the end-of-stream check; the consumed prefix was parseable but
+    unvouched — same contract as the ``.dat`` streamer.)
     """
+    mode = resolve_policy(integrity)
+    sc = read_sidecar(path) if mode != "trust" else None
+    if sc is not None and sc["size"] != os.path.getsize(path):
+        msg = (f"{path}: checksum mismatch (size {os.path.getsize(path)} "
+               f"!= recorded {sc['size']})")
+        if mode == "strict":
+            from ..integrity.errors import ChecksumMismatch
+            raise ChecksumMismatch(msg)
+        warnings.warn(msg)
+        sc = None
+    from ..integrity.sidecar import crc_update
+    crc = 0
     carry = b""
     pending = None  # a dangling tail token whose head is in the next chunk
     with open(path, "rb") as f:
@@ -327,6 +349,8 @@ def iter_net_blocks(path: str, block_bytes: int = 1 << 26):
             chunk = f.read(block_bytes)
             if not chunk:
                 break
+            if sc is not None:
+                crc = crc_update(chunk, crc, sc["algo"])
             buf = carry + chunk
             cut = buf.rfind(b"\n")
             if cut < 0:
@@ -357,6 +381,14 @@ def iter_net_blocks(path: str, block_bytes: int = 1 << 26):
             yield flat[0::2].copy(), flat[1::2].copy()
     elif pending is not None:
         raise MalformedArtifact(f"{path}: odd token count")
+    if sc is not None and (crc & 0xFFFFFFFF) != sc["sum"]:
+        msg = (f"{path}: checksum mismatch detected at end of stream "
+               f"({sc['algo']} {crc & 0xFFFFFFFF:08x} != recorded "
+               f"{sc['sum']:08x}) — the consumed blocks are suspect")
+        if mode == "strict":
+            from ..integrity.errors import ChecksumMismatch
+            raise ChecksumMismatch(msg)
+        warnings.warn(msg)
 
 
 def _net_tokens(path: str, toks) -> np.ndarray:

@@ -64,7 +64,14 @@ class RuntimeConfig:
     backoff_base_s: float = 0.05
     backoff_cap_s: float = 2.0
     watchdog_s: float | None = None
+    #: checkpoint cadence: persist every k-th boundary; 0 = auto-tune from
+    #: measured snapshot cost vs chunk compute time (snapshot.Checkpointer)
     checkpoint_every: int = 1
+    #: after this many consecutive healthy (retry-free) dispatches, a rung
+    #: is promoted back to the fast pipelined dispatch path — no watchdog
+    #: thread, no per-dispatch block_until_ready — and demoted to the FT
+    #: wrapper again on the first fault (0 disables promotion)
+    promote_after: int = 16
     #: integrity policy for checkpoint loads (strict/repair/trust; None =
     #: env SHEEP_INTEGRITY, default strict).  strict: a corrupt snapshot
     #: aborts the resume with a typed IntegrityError; repair: it is
@@ -82,12 +89,14 @@ class RuntimeConfig:
     @classmethod
     def from_env(cls, **overrides) -> "RuntimeConfig":
         env = os.environ
+        every_s = env.get("SHEEP_CHECKPOINT_EVERY", "1")
         kw: dict = dict(
             checkpoint_dir=env.get("SHEEP_CHECKPOINT_DIR") or None,
             resume=env.get("SHEEP_RESUME", "") == "1",
             max_retries=int(env.get("SHEEP_MAX_RETRIES", "3")),
             backoff_base_s=float(env.get("SHEEP_BACKOFF_BASE", "0.05")),
-            checkpoint_every=int(env.get("SHEEP_CHECKPOINT_EVERY", "1")),
+            checkpoint_every=0 if every_s == "auto" else int(every_s),
+            promote_after=int(env.get("SHEEP_PROMOTE_AFTER", "16")),
             integrity=env.get("SHEEP_INTEGRITY") or None,
         )
         if env.get("SHEEP_WATCHDOG_S"):
@@ -115,7 +124,8 @@ class ChunkRuntime:
 
     def __init__(self, policy: RetryPolicy, checkpointer: Checkpointer | None,
                  events: list, rung: str, n: int, seq: np.ndarray,
-                 pst: np.ndarray, input_sig: str, rounds_base: int = 0):
+                 pst: np.ndarray, input_sig: str, rounds_base: int = 0,
+                 promote_after: int = 0):
         self.policy = policy
         self.ckpt = checkpointer
         self.events = events
@@ -125,13 +135,52 @@ class ChunkRuntime:
         self.pst = pst
         self.input_sig = input_sig
         self.rounds_base = rounds_base
+        #: promotion back to the fast pipelined path (ROADMAP PR-1
+        #: follow-up): after ``promote_after`` consecutive retry-free
+        #: dispatches the FT wrapper (watchdog thread + per-dispatch
+        #: block_until_ready) is dropped, letting dispatches pipeline
+        #: again; the first fault demotes back and retries under the
+        #: full policy.  0 disables.
+        self.promote_after = promote_after
+        self._healthy = 0
+        self._promoted = False
+        import time
+        self._clock = time.perf_counter
+        self._last_boundary_t = self._clock()
 
     def dispatch(self, site: str, fn, j: int | None = None):
-        """Run dispatch ``fn(j)`` under the retry policy.  Returns
-        (outputs, j_used) — ``j_used`` may have shrunk."""
+        """Run dispatch ``fn(j)`` under the retry policy (or, once
+        promoted, the bare pipelined path).  Returns (outputs, j_used) —
+        ``j_used`` may have shrunk."""
+        if self._promoted:
+            try:
+                fault_point(site)
+                # no watchdog, no block_until_ready: the dispatch queues
+                # asynchronously and overlaps the host loop.  An async
+                # backend fault surfaces at the caller's next sync and is
+                # handled by the degradation ladder; a synchronous one
+                # demotes right here and retries under the full policy.
+                return fn(j), j
+            except BaseException as exc:
+                if not is_retryable(exc):
+                    raise
+                self._promoted = False
+                self._healthy = 0
+                self.events.append(("demote", self.rung, site))
+
+        retried = {"n": 0}
+
         def on_retry(s, attempt, jj):
+            retried["n"] = attempt
             self.events.append(("retry", s, attempt, jj))
-        return run_with_retry(self.policy, site, fn, j, on_retry)
+
+        out = run_with_retry(self.policy, site, fn, j, on_retry)
+        self._healthy = 0 if retried["n"] else self._healthy + 1
+        if self.promote_after and self._healthy >= self.promote_after \
+                and not self._promoted:
+            self._promoted = True
+            self.events.append(("promote", self.rung, site))
+        return out
 
     def boundary(self, rounds: int, links_fn) -> None:
         """One completed chunk boundary.  ``links_fn() -> (lo, hi)`` host
@@ -140,6 +189,8 @@ class ChunkRuntime:
         fetch or an all_gather)."""
         if self.ckpt is None:
             return
+        now = self._clock()
+        chunk_s = now - self._last_boundary_t
         if self.ckpt.want():
             lo, hi = links_fn()
             self.ckpt.save(Snapshot(
@@ -149,8 +200,15 @@ class ChunkRuntime:
                 rung=self.rung, input_sig=self.input_sig))
             self.events.append(("checkpoint", self.rung,
                                 self.ckpt.boundary - 1))
+            # auto-cadence (SHEEP_CHECKPOINT_EVERY=auto): scale the
+            # persistence interval from this boundary's measured snapshot
+            # cost vs the compute time since the last boundary
+            new = self.ckpt.observe(self._clock() - now, chunk_s)
+            if new is not None:
+                self.events.append(("cadence", self.rung, new))
         else:
             self.ckpt.skip()
+        self._last_boundary_t = self._clock()
         # the deterministic kill point: "died between chunks"
         fault_point("boundary")
 
@@ -282,7 +340,8 @@ def build_graph_resilient(tail, head, num_vertices=None, num_workers=None,
     parent = None
     for i, rung in enumerate(rungs):
         rt = ChunkRuntime(policy, ckpt, events, rung, n, seq_h, pst, sig,
-                          rounds_base=rounds)
+                          rounds_base=rounds,
+                          promote_after=config.promote_after)
         if snap is None and i == 0:
             # boundary 0 = "prep complete": a kill during the first chunk
             # resumes without re-running the degree sort / link mapping

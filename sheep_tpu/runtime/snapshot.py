@@ -117,6 +117,13 @@ class Snapshot:
                 "corrupt snapshot — " + "; ".join(problems))
 
 
+#: auto-cadence targets: snapshot overhead <= this fraction of compute
+#: time between persisted boundaries, with the cadence capped so a crash
+#: never loses more than _AUTO_MAX chunks of progress.
+_AUTO_OVERHEAD = 0.1
+_AUTO_MAX = 64
+
+
 class Checkpointer:
     """Owns the snapshot file of one build: save at chunk boundaries,
     load at resume, clear on success.
@@ -125,15 +132,41 @@ class Checkpointer:
     host sync; on the tunneled backend a coarser cadence may be wanted).
     Boundaries are still COUNTED every time so fault-injection indices
     stay stable regardless of cadence.
+
+    ``every=0`` selects AUTO cadence (env ``SHEEP_CHECKPOINT_EVERY=auto``):
+    start persisting every boundary, then retune from measurement — the
+    driver reports each persisted snapshot's cost and the chunk compute
+    time since the previous boundary (:meth:`observe`), and the cadence is
+    scaled so snapshot overhead stays under ~10% of compute.  A fast
+    local-disk run keeps every=1 (cheap snapshots, maximum resumability);
+    a run whose checkpoints cost an all_gather over a tunneled mesh backs
+    off automatically instead of making the operator guess a number.
     """
 
     def __init__(self, directory: str, every: int = 1):
-        if every < 1:
-            raise ValueError(f"checkpoint every={every} must be >= 1")
+        if every < 0:
+            raise ValueError(f"checkpoint every={every} must be >= 0 "
+                             f"(0 = auto-tune)")
         self.directory = directory
-        self.every = every
+        self.auto = every == 0
+        self.every = 1 if self.auto else every
         self.boundary = 0
         os.makedirs(directory, exist_ok=True)
+
+    def observe(self, save_s: float, chunk_s: float) -> int | None:
+        """Feed one (snapshot cost, chunk compute time) measurement; in
+        auto mode retunes ``every`` and returns the new cadence when it
+        changed (None otherwise).  Deterministic given the measurements —
+        the property tests drive it with synthetic costs."""
+        if not self.auto or chunk_s <= 0 or save_s < 0:
+            return None
+        import math
+        want = save_s / (_AUTO_OVERHEAD * chunk_s)
+        new = int(min(_AUTO_MAX, max(1, math.ceil(want))))
+        if new == self.every:
+            return None
+        self.every = new
+        return new
 
     @property
     def path(self) -> str:

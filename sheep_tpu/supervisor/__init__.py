@@ -1,0 +1,55 @@
+"""Chaos-hardened tournament supervisor for the distributed file path.
+
+Four modules, layered bottom-up:
+
+  heartbeat.py  worker liveness: heartbeat files + deadlines (mtime-based,
+                so shell and Python workers implement one protocol)
+  manifest.py   the durable tournament manifest: planned bracket +
+                per-leg state, atomic + checksummed — what makes a
+                crashed run resumable
+  chaos.py      deterministic fault injection (SHEEP_FAULT_PLAN grammar:
+                kill/corrupt/hang a leg, stop the supervisor)
+  supervise.py  the orchestrator: dispatch, fsck-gated publish,
+                retry/backoff, deadline relaunch, speculative
+                re-execution, fsck-driven resume
+
+See supervise.py's docstring for the failure model; the acceptance
+property (a fault at EVERY tournament round yields a bit-identical final
+tree, re-dispatching only the faulted leg) lives in
+tests/test_supervisor.py.
+"""
+
+from .chaos import (ChaosFault, ChaosPlan, SupervisorKilled, parse_fault_plan,
+                    plan_from_env)
+from .heartbeat import HeartbeatWriter, beat, is_stale, last_beat_s
+from .manifest import (Leg, Manifest, load_manifest, manifest_path,
+                       plan_tournament, save_manifest, tournament_rounds)
+from .supervise import (InlineRunner, SubprocessRunner, SupervisionFailed,
+                        SupervisorConfig, TournamentSupervisor, reconcile,
+                        run_supervised)
+
+__all__ = [
+    "ChaosFault",
+    "ChaosPlan",
+    "HeartbeatWriter",
+    "InlineRunner",
+    "Leg",
+    "Manifest",
+    "SubprocessRunner",
+    "SupervisionFailed",
+    "SupervisorConfig",
+    "SupervisorKilled",
+    "TournamentSupervisor",
+    "beat",
+    "is_stale",
+    "last_beat_s",
+    "load_manifest",
+    "manifest_path",
+    "parse_fault_plan",
+    "plan_from_env",
+    "plan_tournament",
+    "reconcile",
+    "run_supervised",
+    "save_manifest",
+    "tournament_rounds",
+]

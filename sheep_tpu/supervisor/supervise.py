@@ -1,0 +1,664 @@
+"""The tournament supervisor: heartbeat-supervised, fsck-gated, resumable.
+
+This is the orchestration layer the ROADMAP flags as the remaining
+single point of failure in the distributed file path: the bash tournament
+(scripts/horizontal-dist.sh) is fire-and-forget — one dead, hung, or
+corrupted worker forces a full re-run.  The supervisor owns the
+sort -> map -> merge-tournament lifecycle end to end and survives any
+single-point failure:
+
+  worker dies       the attempt's exit status (or vanished process) fails
+                    the leg; it is re-dispatched with the PR-1
+                    retry/backoff policy (runtime/retry.RetryPolicy).
+  worker hangs      every attempt owns a heartbeat file
+                    (supervisor/heartbeat.py); a beat stale past the
+                    deadline declares the attempt dead and the leg is
+                    re-dispatched.  A straggler that still beats can be
+                    speculatively re-executed (``speculate_after_s``):
+                    first finisher publishes, the loser's artifact is
+                    discarded (sig-checked, never merged).
+  artifact corrupt  every attempt writes to a private temp name; the
+                    supervisor fscks the temp artifact (sidecar checksum
+                    + structural checks, integrity/fsck.py) and checks
+                    its input signature against the manifest BEFORE the
+                    atomic publish — a bad artifact is a failed attempt,
+                    never a tournament input.
+  supervisor dies   all durable state lives in the checksummed manifest
+                    (supervisor/manifest.py), rewritten atomically after
+                    every dispatch and publish.  A new supervisor resumes
+                    by fsck-ing the artifacts the manifest claims done
+                    and re-dispatching ONLY the dirty/missing legs — a
+                    clean ``NNr0.tre`` is never re-mapped.
+
+Publish protocol (the same ordering as scripts/lib.sh sheep_mv_artifact):
+sidecar first, artifact second, both via atomic rename — a consumer that
+sees an artifact under its final name also sees its matching checksum.
+
+Everything above is property-tested by deterministic chaos
+(supervisor/chaos.py, ``SHEEP_FAULT_PLAN``): a kill, corrupt, or hang
+injected at EVERY tournament round must yield a final tree bit-identical
+to the fault-free run, re-dispatching only the faulted leg.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import sys
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Callable
+
+from ..integrity.errors import IntegrityError
+from ..integrity.sidecar import read_sidecar, resolve_policy
+from ..runtime.retry import RetryPolicy
+from .chaos import ChaosPlan, SupervisorKilled, plan_from_env
+from .heartbeat import (HEARTBEAT_FILE_ENV, HEARTBEAT_INTERVAL_ENV,
+                        HeartbeatWriter, beat, is_stale)
+from .manifest import (DONE, PENDING, Leg, Manifest, load_manifest,
+                       manifest_path, plan_tournament, save_manifest)
+
+
+class SupervisionFailed(RuntimeError):
+    """The tournament cannot make progress (a leg exhausted its dispatch
+    budget, or an input can never appear).  The manifest stays on disk —
+    the condition may be transient (full disk, sick node) and a later
+    ``sheep supervise`` of the same state dir resumes where this one
+    stopped."""
+
+
+@dataclass
+class SupervisorConfig:
+    """One supervised tournament's knobs (env: SHEEP_WORKERS / REDUCTION /
+    SHEEP_DEADLINE_S / SHEEP_HEARTBEAT_S / SHEEP_SPECULATE_S /
+    SHEEP_MAX_RETRIES / SHEEP_BACKOFF_BASE / SHEEP_INTEGRITY /
+    SHEEP_FAULT_PLAN)."""
+
+    workers: int = 2
+    reduction: int = 2
+    #: a worker whose heartbeat is older than this is dead
+    deadline_s: float = 30.0
+    #: how often workers beat (exported to subprocess workers)
+    heartbeat_s: float = 1.0
+    #: age at which a still-beating attempt gets a speculative twin
+    #: (None = speculation off)
+    speculate_after_s: float | None = None
+    max_retries: int = 3
+    backoff_base_s: float = 0.05
+    backoff_cap_s: float = 2.0
+    poll_s: float = 0.05
+    #: max concurrent attempts (0 = unthrottled; the bash driver's CORES)
+    cores: int = 0
+    integrity: str | None = None
+    #: print the reference phase grammar ("Mapped in N seconds.") that
+    #: data/make-parallel.sh greps
+    grammar: bool = True
+    chaos: ChaosPlan | None = None
+    # injectable for tests
+    sleep: Callable[[float], None] = field(default=time.sleep)
+    #: observable trace: ("dispatch", key, n), ("publish", key),
+    #: ("leg-failed", key, reason), ("stale", key), ("speculate", key),
+    #: ("discard", key, why), ("resume", clean, dirty), ("complete",)
+    events: list = field(default_factory=list)
+
+    @classmethod
+    def from_env(cls, **overrides) -> "SupervisorConfig":
+        env = os.environ
+        kw: dict = dict(
+            workers=int(env.get("SHEEP_WORKERS", "2") or 2),
+            reduction=int(env.get("REDUCTION", "2") or 2),
+            deadline_s=float(env.get("SHEEP_DEADLINE_S", "30")),
+            heartbeat_s=float(env.get("SHEEP_HEARTBEAT_S", "1")),
+            max_retries=int(env.get("SHEEP_MAX_RETRIES", "3")),
+            backoff_base_s=float(env.get("SHEEP_BACKOFF_BASE", "0.05")),
+            integrity=env.get("SHEEP_INTEGRITY") or None,
+            chaos=plan_from_env(),
+        )
+        if env.get("SHEEP_SPECULATE_S"):
+            kw["speculate_after_s"] = float(env["SHEEP_SPECULATE_S"])
+        kw.update(overrides)
+        return cls(**kw)
+
+    @property
+    def max_dispatches(self) -> int:
+        return self.max_retries + 1
+
+    def policy(self) -> RetryPolicy:
+        return RetryPolicy(max_retries=self.max_retries,
+                           backoff_base_s=self.backoff_base_s,
+                           backoff_cap_s=self.backoff_cap_s)
+
+
+# ---------------------------------------------------------------------------
+# Attempt handles + runners.  A runner turns a leg's argv into a running
+# attempt; the supervisor only ever sees the handle (poll / cancel), so
+# the inline (thread) and subprocess runners — and the chaos fakes — are
+# interchangeable and the recovery logic cannot fork between them.
+# ---------------------------------------------------------------------------
+
+
+class _ThreadHandle:
+    """An attempt running on a thread (inline runner + internal copies)."""
+
+    def __init__(self, target: Callable[[], int]):
+        self._rc: int | None = None
+        self._done = threading.Event()
+
+        def run():
+            try:
+                self._rc = int(target() or 0)
+            except BaseException:  # the supervisor retries; never crashes
+                self._rc = 1
+            finally:
+                self._done.set()
+
+        self._thread = threading.Thread(target=run, daemon=True)
+        self._thread.start()
+
+    def poll(self) -> int | None:
+        return self._rc if self._done.is_set() else None
+
+    def cancel(self) -> None:
+        # threads cannot be interrupted; the attempt is abandoned (daemon)
+        # and its temp output ignored — exactly how a real orphan behaves
+        pass
+
+
+class _DeadHandle:
+    """Chaos "kill": the worker died immediately (rc 137)."""
+
+    def poll(self) -> int | None:
+        return 137
+
+    def cancel(self) -> None:
+        pass
+
+
+class _HangHandle:
+    """Chaos "hang": the worker never completes and never beats again."""
+
+    def poll(self) -> int | None:
+        return None
+
+    def cancel(self) -> None:
+        pass
+
+
+class _SubprocessHandle:
+    def __init__(self, proc, log_f):
+        self._proc = proc
+        self._log_f = log_f
+
+    def poll(self) -> int | None:
+        rc = self._proc.poll()
+        if rc is not None and self._log_f is not None:
+            self._log_f.close()
+            self._log_f = None
+        return rc
+
+    def cancel(self) -> None:
+        if self._proc.poll() is None:
+            self._proc.kill()
+            try:
+                self._proc.wait(timeout=10)
+            except Exception:
+                pass
+        if self._log_f is not None:
+            self._log_f.close()
+            self._log_f = None
+
+
+class InlineRunner:
+    """Run legs in-process on threads — the fast path for tests and the
+    chaos smoke (no interpreter start-up per leg).  Workers heartbeat via
+    a HeartbeatWriter wrapped around the CLI main."""
+
+    def __init__(self, interval_s: float = 0.1):
+        self.interval_s = interval_s
+
+    def start(self, argv: list[str], hb_path: str, log_path: str):
+        import importlib
+
+        def target() -> int:
+            hb = HeartbeatWriter(hb_path, self.interval_s).start()
+            try:
+                mod = importlib.import_module(f"sheep_tpu.cli.{argv[0]}")
+                return int(mod.main(argv[1:]) or 0)
+            except SystemExit as exc:
+                return int(exc.code or 0)
+            except BaseException as exc:
+                try:
+                    with open(log_path, "a") as f:
+                        f.write(f"{type(exc).__name__}: {exc}\n")
+                except OSError:
+                    pass
+                return 1
+            finally:
+                hb.stop()
+
+        return _ThreadHandle(target)
+
+
+class SubprocessRunner:
+    """Run legs as real CLI subprocesses — the production path.  Each
+    child gets SHEEP_HEARTBEAT_FILE pointing at its attempt's heartbeat
+    (cli/common.maybe_start_heartbeat) and logs to the state dir."""
+
+    def __init__(self, interval_s: float = 1.0, env: dict | None = None):
+        self.interval_s = interval_s
+        self.env = env
+
+    def start(self, argv: list[str], hb_path: str, log_path: str):
+        import subprocess
+
+        import sheep_tpu
+        repo = os.path.dirname(os.path.dirname(
+            os.path.abspath(sheep_tpu.__file__)))
+        env = dict(self.env if self.env is not None else os.environ)
+        env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+        env[HEARTBEAT_FILE_ENV] = hb_path
+        env[HEARTBEAT_INTERVAL_ENV] = str(self.interval_s)
+        log_f = open(log_path, "ab")
+        proc = subprocess.Popen(
+            [sys.executable, "-m", f"sheep_tpu.cli.{argv[0]}"] + argv[1:],
+            stdout=log_f, stderr=subprocess.STDOUT, env=env)
+        return _SubprocessHandle(proc, log_f)
+
+
+@dataclass
+class _Attempt:
+    leg: Leg
+    number: int          # this leg's dispatch ordinal (1-based)
+    tmp: str
+    hb: str
+    handle: object
+    started: float
+    corrupt_on_success: bool = False
+    cancelled: bool = False
+
+
+# ---------------------------------------------------------------------------
+# Validation + publish
+# ---------------------------------------------------------------------------
+
+
+def _artifact_checker(final_path: str):
+    from ..integrity.fsck import _CHECKERS
+    for suffix, checker in _CHECKERS.items():
+        if final_path.endswith(suffix):
+            return checker
+    raise SupervisionFailed(f"{final_path}: not a checkable artifact class")
+
+
+def _validate_artifact(tmp: str, final_path: str, mode: str) -> str | None:
+    """fsck the temp artifact as its final class would be checked; returns
+    the sidecar's input signature (if any).  Raises IntegrityError."""
+    _artifact_checker(final_path)(tmp, mode)
+    sc = read_sidecar(tmp) if mode != "trust" else None
+    return sc.get("sig") if sc else None
+
+
+def _discard(*paths: str) -> None:
+    for p in paths:
+        try:
+            os.unlink(p)
+        except OSError:
+            pass
+
+
+def _corrupt_bytes(path: str) -> None:
+    """Chaos "corrupt": flip one payload byte under the unchanged sidecar
+    (bit rot after a successful write — exactly what fsck exists for)."""
+    with open(path, "r+b") as f:
+        f.seek(5 if os.path.getsize(path) > 5 else 0)
+        b = f.read(1)
+        f.seek(-1, 1)
+        f.write(bytes([b[0] ^ 0xFF]))
+
+
+# ---------------------------------------------------------------------------
+# The supervisor proper
+# ---------------------------------------------------------------------------
+
+
+class TournamentSupervisor:
+    def __init__(self, manifest: Manifest, state_dir: str,
+                 config: SupervisorConfig, runner=None):
+        self.manifest = manifest
+        self.state_dir = state_dir
+        self.config = config
+        self.runner = runner if runner is not None \
+            else SubprocessRunner(interval_s=config.heartbeat_s)
+        self.policy = config.policy()
+        self.mode = resolve_policy(config.integrity)
+        self.events = config.events
+        self.log_dir = os.path.join(state_dir, "logs")
+        os.makedirs(self.log_dir, exist_ok=True)
+        self._running: dict[str, list[_Attempt]] = {}
+        self._backoff_until: dict[str, float] = {}
+        #: artifact path -> the leg that produces it; a consumer is ready
+        #: only when its producers are DONE, not merely when bytes exist
+        #: under the input name (a resume may have marked the producer
+        #: dirty while its corrupt artifact still sits on disk)
+        self._producer: dict[str, Leg] = {
+            leg.output: leg for leg in manifest.legs}
+        #: dispatches this supervisor LIFE launched per leg — the retry
+        #: budget is per-life so a many-times-resumed run is never
+        #: permanently bricked by its history
+        self._life: dict[str, int] = {}
+
+    # -- dispatch ----------------------------------------------------------
+
+    def _leg_argv(self, leg: Leg) -> list[str]:
+        m = self.manifest
+        if leg.kind == "sort":
+            return ["degree_sequence", m.graph, "@OUT@"]
+        if leg.kind == "map":
+            return ["graph2tree", m.graph,
+                    "-l", f"{leg.index + 1}/{m.workers}",
+                    "-s", m.seq_file, "-o", "@OUT@"]
+        if leg.kind == "merge":
+            argv = ["merge_trees"] + list(leg.inputs) + ["-o", "@OUT@"]
+            if m.sig:
+                argv += ["--expect-sig", m.sig]
+            return argv
+        raise SupervisionFailed(f"{leg.key}: unknown leg kind {leg.kind!r}")
+
+    def _start_copy(self, leg: Leg, tmp: str, hb_path: str):
+        src = leg.inputs[0]
+
+        def target() -> int:
+            beat(hb_path)
+            if os.path.exists(src + ".sum"):
+                shutil.copyfile(src + ".sum", tmp + ".sum")
+            shutil.copyfile(src, tmp)
+            return 0
+
+        return _ThreadHandle(target)
+
+    def _launch(self, leg: Leg, now: float, speculative: bool = False):
+        leg.dispatches += 1
+        self._life[leg.key] = self._life.get(leg.key, 0) + 1
+        n = leg.dispatches
+        tmp = f"{leg.output}.a{n}"
+        hb = tmp + ".hb"
+        log = os.path.join(self.log_dir, f"{leg.key}.a{n}.log")
+        _discard(tmp, tmp + ".sum", hb)
+
+        fault = None
+        if self.config.chaos is not None and not speculative:
+            fault = self.config.chaos.take_dispatch(leg.round, leg.index)
+        if fault == "kill":
+            # died mid-write: a torn, sidecar-less partial at the temp name
+            with open(tmp, "wb") as f:
+                f.write(b"\x00" * 3)
+            handle = _DeadHandle()
+        elif fault == "hang":
+            beat(hb)  # one beat at launch, then silence forever
+            handle = _HangHandle()
+        elif leg.kind == "copy":
+            handle = self._start_copy(leg, tmp, hb)
+        else:
+            argv = [a.replace("@OUT@", tmp) for a in self._leg_argv(leg)]
+            handle = self.runner.start(argv, hb, log)
+        att = _Attempt(leg=leg, number=n, tmp=tmp, hb=hb, handle=handle,
+                       started=now, corrupt_on_success=(fault == "corrupt"))
+        self._running.setdefault(leg.key, []).append(att)
+        self.events.append(("dispatch", leg.key, n)
+                           if not speculative else ("speculate", leg.key, n))
+        save_manifest(self.manifest, self.state_dir)
+
+    # -- completion --------------------------------------------------------
+
+    def _publish(self, att: _Attempt) -> None:
+        leg = att.leg
+        if os.path.exists(att.tmp + ".sum"):
+            os.replace(att.tmp + ".sum", leg.output + ".sum")
+        os.replace(att.tmp, leg.output)
+        _discard(att.hb)
+        leg.state = DONE
+        self.events.append(("publish", leg.key))
+        save_manifest(self.manifest, self.state_dir)
+        # siblings (speculative twins) lost the race: cancel + discard
+        for other in self._running.get(leg.key, []):
+            if other is not att:
+                other.cancelled = True
+                other.handle.cancel()
+                _discard(other.tmp, other.tmp + ".sum", other.hb)
+                self.events.append(("discard", leg.key, "lost-race"))
+        self._running.pop(leg.key, None)
+
+    def _complete(self, att: _Attempt) -> None:
+        leg = att.leg
+        if leg.state == DONE:
+            # a speculative loser finishing after the publish
+            _discard(att.tmp, att.tmp + ".sum", att.hb)
+            self.events.append(("discard", leg.key, "lost-race"))
+            return
+        if att.corrupt_on_success:
+            _corrupt_bytes(att.tmp)
+        try:
+            sig = _validate_artifact(att.tmp, leg.output, self.mode)
+        except (IntegrityError, OSError) as exc:
+            self._failed(att, f"fsck: {exc}")
+            return
+        if leg.output.endswith(".tre") and sig:
+            if self.manifest.sig is None:
+                self.manifest.sig = sig
+            elif sig != self.manifest.sig:
+                # an artifact from a DIFFERENT build (stale file, foreign
+                # speculation loser): never merged, always a failed attempt
+                self._failed(att, f"sig {sig[:12]}... != manifest "
+                                  f"{self.manifest.sig[:12]}...")
+                return
+        self._publish(att)
+
+    def _failed(self, att: _Attempt, reason: str) -> None:
+        leg = att.leg
+        _discard(att.tmp, att.tmp + ".sum", att.hb)
+        self.events.append(("leg-failed", leg.key, reason))
+        self._running[leg.key] = [
+            a for a in self._running.get(leg.key, []) if a is not att]
+        if self._running[leg.key]:
+            return  # a twin is still in flight; it may still win
+        self._running.pop(leg.key, None)
+        life = self._life.get(leg.key, 0)
+        if life >= self.config.max_dispatches:
+            raise SupervisionFailed(
+                f"{leg.key}: {life} dispatch(es) failed this run "
+                f"(last: {reason}) — budget {self.config.max_dispatches} "
+                f"spent; state kept in {self.state_dir} for a later resume")
+        self._backoff_until[leg.key] = \
+            time.time() + self.policy.backoff(life - 1)
+
+    # -- the loop ----------------------------------------------------------
+
+    def _poll_attempts(self, now: float) -> None:
+        for key in list(self._running):
+            for att in list(self._running.get(key, [])):
+                if att.cancelled:
+                    continue
+                rc = att.handle.poll()
+                if rc is None:
+                    if is_stale(att.hb, att.started,
+                                self.config.deadline_s, now):
+                        att.cancelled = True
+                        att.handle.cancel()
+                        self.events.append(("stale", key, att.number))
+                        self._failed(att, "heartbeat deadline exceeded")
+                    elif (self.config.speculate_after_s is not None
+                          and now - att.started
+                          > self.config.speculate_after_s
+                          and len(self._running.get(key, [])) == 1
+                          and self._life.get(key, 0)
+                          < self.config.max_dispatches):
+                        self._launch(att.leg, now, speculative=True)
+                elif rc == 0:
+                    self._complete(att)
+                else:
+                    self._failed(att, f"exit status {rc}")
+                if att.leg.state == DONE and self.config.chaos is not None \
+                        and self.config.chaos.take_stop(att.leg.round,
+                                                        att.leg.index):
+                    self._die(att.leg)
+
+    def _die(self, leg: Leg) -> None:
+        """Chaos "stop": this supervisor is dead.  Real death would orphan
+        the children; the simulation cancels them so tests do not leak."""
+        for atts in self._running.values():
+            for att in atts:
+                att.handle.cancel()
+        self.events.append(("supervisor-killed", leg.key))
+        raise SupervisorKilled(
+            f"injected supervisor death after {leg.key} published")
+
+    def _launch_ready(self, now: float) -> int:
+        launched = 0
+        for leg in sorted(self.manifest.pending(),
+                          key=lambda l: (l.round, l.index)):
+            if leg.key in self._running:
+                continue
+            if self._backoff_until.get(leg.key, 0) > now:
+                continue
+            if not all(os.path.exists(p) for p in leg.inputs):
+                continue
+            if any(p in self._producer and self._producer[p].state != DONE
+                   for p in leg.inputs):
+                continue
+            if self.config.cores and sum(
+                    len(a) for a in self._running.values()) \
+                    >= self.config.cores:
+                break
+            self._launch(leg, now)
+            launched += 1
+        return launched
+
+    def run(self) -> Manifest:
+        cfg = self.config
+        t0 = time.time()
+        phase_done = {-1: False, 0: False}
+        while not self.manifest.done():
+            now = time.time()
+            launched = self._launch_ready(now)
+            self._poll_attempts(now)
+            if cfg.grammar:
+                self._phase_grammar(phase_done, t0)
+            if not self._running and not launched \
+                    and not self.manifest.done():
+                future = [t for t in self._backoff_until.values() if t > now]
+                if not future and not self._launch_ready(time.time()):
+                    missing = sorted({
+                        p for leg in self.manifest.pending()
+                        for p in leg.inputs if not os.path.exists(p)})
+                    raise SupervisionFailed(
+                        "tournament cannot make progress — missing "
+                        "inputs with no producer: " + ", ".join(missing))
+            cfg.sleep(cfg.poll_s)
+        if cfg.grammar:
+            self._phase_grammar(phase_done, t0)
+            print(f"Reduced in {time.time() - t0:.8f} seconds.", flush=True)
+        self.events.append(("complete",))
+        return self.manifest
+
+    def _phase_grammar(self, phase_done: dict, t0: float) -> None:
+        """The reference phase lines data/make-parallel.sh greps, emitted
+        when a phase's last leg publishes."""
+        rounds = self.manifest.rounds()
+        for rnd, label in ((-1, "Sorted"), (0, "Mapped")):
+            legs = rounds.get(rnd, [])
+            if legs and not phase_done[rnd] \
+                    and all(leg.state == DONE for leg in legs):
+                phase_done[rnd] = True
+                print(f"{label} in {time.time() - t0:.8f} seconds.",
+                      flush=True)
+
+
+# ---------------------------------------------------------------------------
+# Resume: fsck the surviving artifacts, keep the clean legs
+# ---------------------------------------------------------------------------
+
+
+def _artifact_clean(path: str, mode: str, expect_sig: str | None) -> bool:
+    if not os.path.exists(path):
+        return False
+    try:
+        sig = _validate_artifact(path, path, mode)
+    except (IntegrityError, OSError, SupervisionFailed):
+        return False
+    if expect_sig and sig and sig != expect_sig:
+        return False
+    return True
+
+
+def reconcile(manifest: Manifest, mode: str) -> tuple[int, int]:
+    """Mark dirty/missing done-legs pending again; returns
+    (clean_kept, redispatched).  Only artifacts still NEEDED are checked —
+    a corrupt intermediate whose consumers all finished costs nothing."""
+    cache: dict[str, bool] = {}
+
+    def clean(path: str) -> bool:
+        if path not in cache:
+            cache[path] = _artifact_clean(path, mode, manifest.sig)
+        return cache[path]
+
+    changed = True
+    while changed:
+        changed = False
+        required = {manifest.final_tree}
+        required.update(p for leg in manifest.legs
+                        if leg.state != DONE for p in leg.inputs)
+        for leg in manifest.legs:
+            if leg.state == DONE and leg.output in required \
+                    and not clean(leg.output):
+                leg.state = PENDING
+                changed = True
+    dirty = sum(1 for leg in manifest.legs if leg.state != DONE)
+    return len(manifest.legs) - dirty, dirty
+
+
+def run_supervised(graph: str, state_dir: str,
+                   config: SupervisorConfig | None = None, runner=None,
+                   seq_file: str | None = None,
+                   out_file: str | None = None) -> Manifest:
+    """Run (or resume) one supervised tournament; returns the completed
+    manifest.  ``state_dir`` holds the manifest, ALL tournament artifacts
+    (including the final tree — so a resume never depends on a caller's
+    possibly-cleaned trial dir), and worker logs; rerunning with the same
+    dir resumes off the fsck'd survivors.  ``seq_file``: an existing
+    sequence (skip the sort leg).  ``out_file``: where to export a copy
+    of the final tree (+ sidecar) after completion — an export, not the
+    durable copy, so reruns and multi-trial drivers can point it anywhere.
+    """
+    config = config or SupervisorConfig.from_env()
+    os.makedirs(state_dir, exist_ok=True)
+    base = os.path.basename(graph)
+    for suffix in (".dat", ".net"):
+        if base.endswith(suffix):
+            base = base[: -len(suffix)]
+    prefix = os.path.join(state_dir, base)
+    final = prefix + ".tre"
+
+    if os.path.exists(manifest_path(state_dir)):
+        manifest = load_manifest(state_dir, config.integrity)
+        size = os.path.getsize(graph) if os.path.exists(graph) else -1
+        if manifest.graph != graph or manifest.graph_bytes != size:
+            raise SupervisionFailed(
+                f"{state_dir}: manifest belongs to a different build "
+                f"({manifest.graph}, {manifest.graph_bytes} bytes; this "
+                f"run: {graph}, {size} bytes) — refusing to resume; use "
+                f"a fresh state dir")
+        clean, dirty = reconcile(manifest, resolve_policy(config.integrity))
+        config.events.append(("resume", clean, dirty))
+    else:
+        manifest = plan_tournament(graph, prefix, final, config.workers,
+                                   config.reduction, seq_file)
+    save_manifest(manifest, state_dir)
+    manifest = TournamentSupervisor(manifest, state_dir, config,
+                                    runner).run()
+    if out_file and out_file != manifest.final_tree:
+        # export copy, sidecar first (the sheep_mv_artifact ordering)
+        if os.path.exists(manifest.final_tree + ".sum"):
+            shutil.copyfile(manifest.final_tree + ".sum", out_file + ".sum")
+        shutil.copyfile(manifest.final_tree, out_file)
+    return manifest

@@ -587,3 +587,158 @@ def test_fsck_paths_api(tmp_path, small_forest):
     _truncate(paths[".tre"], 5)
     results, failures = fsck_paths([str(tmp_path)])
     assert len(failures) == 1 and failures[0][0] == paths[".tre"]
+
+
+# ---------------------------------------------------------------------------
+# fsck --repair-sidecar (ISSUE 3 satellite): reseal lost/wrong sidecars
+# ---------------------------------------------------------------------------
+
+
+def test_repair_sidecar_lost(tmp_path, small_forest):
+    from sheep_tpu.cli.fsck import main as fsck_main
+    from sheep_tpu.integrity.sidecar import verify_file
+
+    tail, head, seq, forest = small_forest
+    p = str(tmp_path / "t.tre")
+    write_tree(p, forest.parent, forest.pst_weight, sig="feedc0de")
+    os.unlink(sidecar_path(p))
+    assert fsck_main(["-R", p]) == 0
+    sc = read_sidecar(p)
+    assert sc is not None
+    assert verify_file(p, "strict") == "ok"
+    # a reseal can never re-derive the build tie: sig is dropped
+    assert "sig" not in sc
+
+
+def test_repair_sidecar_wrong(tmp_path, small_forest):
+    from sheep_tpu.cli.fsck import main as fsck_main
+    from sheep_tpu.integrity.sidecar import verify_file
+
+    tail, head, seq, forest = small_forest
+    p = str(tmp_path / "t.tre")
+    write_tree(p, forest.parent, forest.pst_weight)
+    # the crash window: artifact renamed, stale sidecar left behind
+    import re as _re
+    txt = open(sidecar_path(p)).read()
+    open(sidecar_path(p), "w").write(
+        _re.sub(r"^sum .*$", "sum 00000001", txt, flags=_re.M))
+    assert fsck_main([p]) == 1          # plain fsck refuses
+    assert fsck_main(["-R", p]) == 0    # reseal verifies + reseals
+    assert verify_file(p, "strict") == "ok"
+    assert fsck_main([p]) == 0
+
+
+def test_repair_sidecar_refuses_garbage(tmp_path):
+    from sheep_tpu.cli.fsck import main as fsck_main
+    from sheep_tpu.integrity.fsck import repair_sidecar
+
+    p = str(tmp_path / "t.tre")
+    with open(p, "wb") as f:
+        f.write(b"\x01\x02")  # too short for the end_id header
+    assert fsck_main(["-R", p]) == 1
+    assert not os.path.exists(sidecar_path(p))  # never vouches for garbage
+    with pytest.raises(IntegrityError):
+        repair_sidecar(p)
+
+
+def test_repair_sidecar_unknown_class(tmp_path):
+    from sheep_tpu.integrity.fsck import repair_sidecar
+
+    p = str(tmp_path / "t.xyz")
+    with open(p, "wb") as f:
+        f.write(b"bytes")
+    with pytest.raises(MalformedArtifact, match="nothing to reseal"):
+        repair_sidecar(p)
+
+
+def test_repair_sidecar_resealed_tree_still_merges(tmp_path, small_forest):
+    # a resealed tree re-enters merges as a foreign (sig-less) input —
+    # merge compatibility must accept it against a signed partner
+    from sheep_tpu.cli.fsck import main as fsck_main
+    from sheep_tpu.cli.merge_trees import main as merge_main
+
+    tail, head, seq, forest = small_forest
+    half = len(tail) // 2
+    f1 = build_forest(tail[:half], head[:half], seq)
+    f2 = build_forest(tail[half:], head[half:], seq)
+    p1, p2 = str(tmp_path / "a.tre"), str(tmp_path / "b.tre")
+    write_tree(p1, f1.parent, f1.pst_weight, sig="s1")
+    write_tree(p2, f2.parent, f2.pst_weight, sig="s1")
+    os.unlink(sidecar_path(p2))
+    assert fsck_main(["-R", "-q", p2]) == 0
+    out = str(tmp_path / "m.tre")
+    assert merge_main([p1, p2, "-o", out]) == 0
+    merged = Forest(*read_tree(out))
+    want = merge_forests(f1, f2)
+    np.testing.assert_array_equal(merged.parent, want.parent)
+
+
+# ---------------------------------------------------------------------------
+# .net block-stream verification (ISSUE 3 satellite): like the .dat path
+# ---------------------------------------------------------------------------
+
+
+def _net_blocks_all(path, **kw):
+    from sheep_tpu.io.edges import iter_net_blocks
+
+    pairs = list(iter_net_blocks(path, **kw))
+    if not pairs:
+        return (np.empty(0, np.uint32),) * 2
+    return (np.concatenate([t for t, _ in pairs]),
+            np.concatenate([h for _, h in pairs]))
+
+
+def test_net_stream_verify_clean(tmp_path):
+    from sheep_tpu.io.edges import write_net
+
+    p = str(tmp_path / "g.net")
+    t = np.arange(200, dtype=np.uint32)
+    h = (t * 7 + 1) % 301
+    write_net(p, t, h.astype(np.uint32))
+    tt, hh = _net_blocks_all(p, block_bytes=32)  # tiny blocks: carry path
+    np.testing.assert_array_equal(tt, t)
+    np.testing.assert_array_equal(hh, h)
+
+
+def test_net_stream_verify_detects_flip_at_end(tmp_path):
+    from sheep_tpu.io.edges import write_net
+
+    p = str(tmp_path / "g.net")
+    t = np.arange(200, dtype=np.uint32)
+    write_net(p, t, (t + 1).astype(np.uint32))
+    # flip a digit to another digit: every block still PARSES, only the
+    # end-of-stream checksum can catch it
+    with open(p, "r+b") as f:
+        b = f.read(1)
+        f.seek(0)
+        f.write(b"7" if b != b"7" else b"8")
+    with pytest.raises(ChecksumMismatch, match="end of stream"):
+        _net_blocks_all(p, block_bytes=32)
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        _net_blocks_all(p, block_bytes=32, integrity="repair")
+    assert any("checksum mismatch" in str(x.message) for x in w)
+    _net_blocks_all(p, block_bytes=32, integrity="trust")  # no raise
+
+
+def test_net_stream_verify_size_mismatch_up_front(tmp_path):
+    from sheep_tpu.io.edges import iter_net_blocks, write_net
+
+    p = str(tmp_path / "g.net")
+    t = np.arange(50, dtype=np.uint32)
+    write_net(p, t, (t + 1).astype(np.uint32))
+    with open(p, "r+b") as f:
+        f.truncate(os.path.getsize(p) - 4)
+    with pytest.raises(ChecksumMismatch, match="size"):
+        next(iter_net_blocks(p, block_bytes=32))
+
+
+def test_net_stream_no_sidecar_still_parses(tmp_path):
+    from sheep_tpu.io.edges import write_net
+
+    p = str(tmp_path / "g.net")
+    t = np.arange(50, dtype=np.uint32)
+    write_net(p, t, (t + 1).astype(np.uint32))
+    os.unlink(sidecar_path(p))  # foreign file: no sidecar, no verification
+    tt, hh = _net_blocks_all(p, block_bytes=32)
+    np.testing.assert_array_equal(tt, t)

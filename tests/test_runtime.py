@@ -415,3 +415,114 @@ def test_graph2tree_checkpoint_flags(tmp_path, small_graph):
     r = cli("-o", str(tmp_path / "x.tre"), "--resume")
     assert r.returncode != 0
     assert "checkpoint-dir" in r.stdout + r.stderr
+
+
+# ---------------------------------------------------------------------------
+# checkpoint cadence auto-tuning (ISSUE 3 satellite)
+# ---------------------------------------------------------------------------
+
+
+def test_auto_cadence_retunes_from_measurement(tmp_path):
+    from sheep_tpu.runtime.snapshot import Checkpointer
+
+    ck = Checkpointer(str(tmp_path), every=0)
+    assert ck.auto and ck.every == 1
+    # snapshots as expensive as a chunk -> persist every 10th boundary
+    # (10% overhead target)
+    assert ck.observe(1.0, 1.0) == 10
+    # cheap snapshots -> back to every boundary
+    assert ck.observe(0.001, 1.0) == 1
+    # pathological cost is capped (bounded progress loss on a crash)
+    assert ck.observe(100.0, 0.1) == 64
+    assert ck.observe(100.0, 0.1) is None  # unchanged -> no event
+    # degenerate measurements never retune
+    assert ck.observe(1.0, 0.0) is None
+    assert ck.observe(-1.0, 1.0) is None
+
+
+def test_fixed_cadence_ignores_observations(tmp_path):
+    from sheep_tpu.runtime.snapshot import Checkpointer
+
+    ck = Checkpointer(str(tmp_path), every=3)
+    assert not ck.auto
+    assert ck.observe(9.0, 0.1) is None
+    assert ck.every == 3
+    with pytest.raises(ValueError):
+        Checkpointer(str(tmp_path), every=-1)
+
+
+def test_auto_cadence_env_spelling(monkeypatch):
+    monkeypatch.setenv("SHEEP_CHECKPOINT_EVERY", "auto")
+    assert RuntimeConfig.from_env().checkpoint_every == 0
+    monkeypatch.setenv("SHEEP_CHECKPOINT_EVERY", "4")
+    assert RuntimeConfig.from_env().checkpoint_every == 4
+
+
+def test_auto_cadence_build_matches_oracle(small_graph, tmp_path):
+    tail, head, _, want = small_graph
+    cfg = RuntimeConfig(checkpoint_dir=str(tmp_path), checkpoint_every=0,
+                        ladder=("single", "host"))
+    _, forest = build_graph_resilient(tail, head, config=cfg)
+    _assert_matches(forest, want)
+    assert any(e[0] == "checkpoint" for e in cfg.events)
+
+
+def test_auto_cadence_resume_still_bit_identical(small_graph, tmp_path):
+    # kill at the first persisted boundary of an auto-cadence build; the
+    # resume must stay bit-identical (cadence only changes WHICH
+    # boundaries persist, never what a snapshot means)
+    tail, head, _, want = small_graph
+    d = str(tmp_path)
+    install_plan(FaultPlan(site="boundary", at=1, kind="kill"))
+    with pytest.raises(BuildKilled):
+        build_graph_resilient(tail, head, config=RuntimeConfig(
+            checkpoint_dir=d, checkpoint_every=0, ladder=("single", "host")))
+    clear_plan()
+    cfg = RuntimeConfig(checkpoint_dir=d, checkpoint_every=0, resume=True,
+                        ladder=("single", "host"))
+    _, forest = build_graph_resilient(tail, head, config=cfg)
+    _assert_matches(forest, want)
+
+
+# ---------------------------------------------------------------------------
+# mesh-rung promotion back to the pipelined path (ISSUE 3 satellite)
+# ---------------------------------------------------------------------------
+
+
+def test_promotion_after_healthy_streak(small_graph):
+    tail, head, _, want = small_graph
+    cfg = RuntimeConfig(ladder=("single", "host"), promote_after=2)
+    _, forest = build_graph_resilient(tail, head, config=cfg)
+    _assert_matches(forest, want)
+    assert any(e[0] == "promote" for e in cfg.events), cfg.events
+
+
+def test_promotion_disabled_by_zero(small_graph):
+    tail, head, _, want = small_graph
+    cfg = RuntimeConfig(ladder=("single", "host"), promote_after=0)
+    _, forest = build_graph_resilient(tail, head, config=cfg)
+    _assert_matches(forest, want)
+    assert not any(e[0] == "promote" for e in cfg.events)
+
+
+def test_promotion_demotes_on_fault_and_recovers(small_graph):
+    # fault a dispatch AFTER promotion: the runtime must demote back to
+    # the FT wrapper, retry under the full policy, and still match
+    tail, head, _, want = small_graph
+    cfg = RuntimeConfig(ladder=("single", "host"), promote_after=1,
+                        backoff_base_s=0.0)
+    install_plan(FaultPlan(site="chunk", at=3, kind="xla", times=1))
+    _, forest = build_graph_resilient(tail, head, config=cfg)
+    clear_plan()
+    _assert_matches(forest, want)
+    kinds = [e[0] for e in cfg.events]
+    assert "promote" in kinds and "demote" in kinds, cfg.events
+    # the post-demotion retry actually ran
+    assert kinds.index("demote") < len(kinds)
+
+
+def test_promotion_env_knob(monkeypatch):
+    monkeypatch.setenv("SHEEP_PROMOTE_AFTER", "0")
+    assert RuntimeConfig.from_env().promote_after == 0
+    monkeypatch.setenv("SHEEP_PROMOTE_AFTER", "5")
+    assert RuntimeConfig.from_env().promote_after == 5

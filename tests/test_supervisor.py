@@ -1,0 +1,492 @@
+"""Chaos-hardened tournament supervisor tests (ISSUE 3).
+
+The acceptance property: a kill, corrupt, or hang injected at EVERY
+tournament round (SHEEP_FAULT_PLAN grammar) must yield a final tree
+bit-identical to the fault-free run — equal ECV(down) included — while
+re-dispatching ONLY the faulted leg (dispatch-count assertion); and a
+supervisor killed after any leg resumes off the fsck'd manifest,
+re-dispatching only the legs that are dirty/missing AND still needed.
+
+All legs run in-process (InlineRunner) so the property sweep is seconds,
+not minutes; one subprocess smoke pins the production runner and one
+dist-partition.sh -S run pins the shell integration.
+"""
+
+import os
+import re
+import subprocess
+import time
+
+import numpy as np
+import pytest
+
+from sheep_tpu.core.forest import build_forest
+from sheep_tpu.core.sequence import degree_sequence
+from sheep_tpu.io.edges import write_net
+from sheep_tpu.io.trefile import read_tree
+from sheep_tpu.supervisor import (InlineRunner, SupervisionFailed,
+                                  SupervisorConfig, SupervisorKilled,
+                                  load_manifest, parse_fault_plan,
+                                  plan_tournament, run_supervised,
+                                  save_manifest, tournament_rounds)
+from sheep_tpu.supervisor.chaos import SORT_ROUND
+from sheep_tpu.supervisor.heartbeat import HeartbeatWriter, is_stale
+from sheep_tpu.utils.synth import rmat_edges
+
+pytestmark = pytest.mark.chaos
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+WORKERS = 4
+
+
+@pytest.fixture(scope="module")
+def small_graph(tmp_path_factory):
+    d = tmp_path_factory.mktemp("supervised")
+    tail, head = rmat_edges(7, 4 << 7, seed=11)
+    graph = str(d / "g.net")
+    write_net(graph, tail, head)
+    seq = degree_sequence(tail, head)
+    want = build_forest(tail, head, seq)
+    return graph, tail, head, seq, want
+
+
+def _config(**overrides) -> SupervisorConfig:
+    kw = dict(workers=WORKERS, deadline_s=10.0, poll_s=0.01,
+              backoff_base_s=0.0, heartbeat_s=0.05)
+    kw.update(overrides)
+    return SupervisorConfig(**kw)
+
+
+def _run(graph, state_dir, **overrides):
+    cfg = _config(**overrides)
+    manifest = run_supervised(graph, str(state_dir), cfg,
+                              runner=InlineRunner(0.05))
+    return manifest, cfg
+
+
+def _ecv_down(tail, head, seq, forest, parts=2):
+    from sheep_tpu.partition.evaluate import evaluate_partition
+    from sheep_tpu.partition.partition import Partition
+
+    p = Partition.from_forest(seq, forest, parts)
+    rep = evaluate_partition(p.parts, tail, head, seq, p.num_parts)
+    return rep.ecv_down
+
+
+def _final(manifest):
+    with open(manifest.final_tree, "rb") as f:
+        return f.read()
+
+
+def _all_legs():
+    """(round, index) of every leg in the WORKERS-wide tournament,
+    sort included."""
+    legs = [(SORT_ROUND, 0)] + [(0, i) for i in range(WORKERS)]
+    for s, slots in enumerate(tournament_rounds(WORKERS, 2)):
+        legs += [(s + 1, i) for i in range(len(slots))]
+    return legs
+
+
+# ---------------------------------------------------------------------------
+# units: heartbeat
+# ---------------------------------------------------------------------------
+
+
+def test_heartbeat_writer_beats(tmp_path):
+    hb = str(tmp_path / "w.hb")
+    with HeartbeatWriter(hb, interval_s=0.05):
+        time.sleep(0.02)
+        assert os.path.exists(hb)  # first beat lands at start()
+        m1 = os.path.getmtime(hb)
+        time.sleep(0.2)
+        assert os.path.getmtime(hb) > m1
+    m2 = os.path.getmtime(hb)
+    time.sleep(0.15)
+    assert os.path.getmtime(hb) == m2  # stopped means silent
+
+
+def test_heartbeat_staleness(tmp_path):
+    hb = str(tmp_path / "w.hb")
+    t0 = time.time()
+    # never beat: stale once the deadline from launch passes
+    assert not is_stale(hb, launched_at=t0, deadline_s=10, now=t0 + 5)
+    assert is_stale(hb, launched_at=t0, deadline_s=10, now=t0 + 11)
+    with open(hb, "w") as f:
+        f.write("beat")
+    assert not is_stale(hb, launched_at=t0, deadline_s=10)
+    assert is_stale(hb, launched_at=t0, deadline_s=10,
+                    now=os.path.getmtime(hb) + 11)
+
+
+# ---------------------------------------------------------------------------
+# units: manifest planning + durability
+# ---------------------------------------------------------------------------
+
+
+def test_tournament_bracket_matches_shell_arithmetic():
+    # W=4 R=2: two rounds, slot i of the first merge round owning
+    # {i, i+2} — the exact horizontal-dist.sh STEP_SIZE/WORKERS loop
+    assert tournament_rounds(4, 2) == [[[0, 2], [1, 3]], [[0, 1]]]
+    assert tournament_rounds(2, 2) == [[[0, 1]]]
+    # odd widths leave a single-input slot (a rename in the shell driver)
+    assert tournament_rounds(3, 2) == [[[0, 2], [1]], [[0, 1]]]
+    # reduction >= width collapses to one merge
+    assert tournament_rounds(4, 4) == [[[0, 1, 2, 3]]]
+
+
+def test_plan_tournament_legs(tmp_path):
+    m = plan_tournament("g.net", str(tmp_path / "g"),
+                        str(tmp_path / "g.tre"), 4, 2)
+    keys = [leg.key for leg in m.legs]
+    assert keys == ["sort", "r0.00", "r0.01", "r0.02", "r0.03",
+                    "r1.00", "r1.01", "r2.00"]
+    assert m.leg("r1.00").inputs == [str(tmp_path / "g00r0.tre"),
+                                     str(tmp_path / "g02r0.tre")]
+    assert m.leg("r2.00").output == str(tmp_path / "g.tre")
+    copy = plan_tournament("g.net", str(tmp_path / "h"),
+                           str(tmp_path / "h.tre"), 3, 2)
+    assert copy.leg("r1.01").kind == "copy"
+
+
+def test_manifest_roundtrip_and_corruption(tmp_path):
+    from sheep_tpu.integrity.errors import IntegrityError
+
+    m = plan_tournament("g.net", str(tmp_path / "g"),
+                        str(tmp_path / "g.tre"), 4, 2)
+    m.leg("r0.01").state = "done"
+    m.sig = "abc123"
+    save_manifest(m, str(tmp_path))
+    back = load_manifest(str(tmp_path))
+    assert back.sig == "abc123"
+    assert back.leg("r0.01").state == "done"
+    assert [leg.key for leg in back.legs] == [leg.key for leg in m.legs]
+    # flip one byte: the sealed manifest must refuse to load
+    p = str(tmp_path / "manifest.json")
+    with open(p, "r+b") as f:
+        f.seek(10)
+        b = f.read(1)
+        f.seek(-1, 1)
+        f.write(bytes([b[0] ^ 0x01]))
+    with pytest.raises(IntegrityError):
+        load_manifest(str(tmp_path))
+
+
+# ---------------------------------------------------------------------------
+# units: chaos grammar
+# ---------------------------------------------------------------------------
+
+
+def test_fault_plan_grammar():
+    plan = parse_fault_plan("kill@0:2,corrupt@1:0,hang@2:0,stop@sort:0")
+    assert [(f.kind, f.round, f.leg) for f in plan.faults] == \
+        [("kill", 0, 2), ("corrupt", 1, 0), ("hang", 2, 0),
+         ("stop", SORT_ROUND, 0)]
+    # entries fire exactly once
+    assert plan.take_dispatch(0, 2) == "kill"
+    assert plan.take_dispatch(0, 2) is None
+    assert not plan.take_stop(0, 2)
+    assert plan.take_stop(SORT_ROUND, 0)
+    with pytest.raises(ValueError):
+        parse_fault_plan("nuke@0:0")
+    with pytest.raises(ValueError):
+        parse_fault_plan("kill@0")
+
+
+def test_fault_plan_from_env(monkeypatch):
+    from sheep_tpu.supervisor import plan_from_env
+
+    monkeypatch.delenv("SHEEP_FAULT_PLAN", raising=False)
+    assert plan_from_env() is None
+    monkeypatch.setenv("SHEEP_FAULT_PLAN", "kill@0:1")
+    plan = plan_from_env()
+    assert plan is not None and plan.faults[0].kind == "kill"
+
+
+# ---------------------------------------------------------------------------
+# the fault-free supervised run equals the oracle
+# ---------------------------------------------------------------------------
+
+
+def test_supervised_matches_oracle(small_graph, tmp_path, capsys):
+    graph, tail, head, seq, want = small_graph
+    manifest, cfg = _run(graph, tmp_path / "s")
+    parent, pst = read_tree(manifest.final_tree)
+    np.testing.assert_array_equal(parent, want.parent)
+    np.testing.assert_array_equal(pst, want.pst_weight)
+    assert manifest.sig, "map legs must stamp the shared input signature"
+    assert all(leg.dispatches == 1 for leg in manifest.legs)
+    out = capsys.readouterr().out
+    # the reference phase grammar survives supervision (make-parallel greps)
+    assert re.search(r"Mapped in [0-9.]+ seconds\.", out)
+    assert re.search(r"Reduced in [0-9.]+ seconds\.", out)
+
+
+def test_supervised_with_given_sequence(small_graph, tmp_path):
+    from sheep_tpu.io.seqfile import write_sequence
+
+    graph, tail, head, seq, want = small_graph
+    seq_path = str(tmp_path / "given.seq")
+    write_sequence(seq, seq_path)
+    cfg = _config()
+    manifest = run_supervised(graph, str(tmp_path / "s"), cfg,
+                              runner=InlineRunner(0.05), seq_file=seq_path)
+    assert all(leg.kind != "sort" for leg in manifest.legs)
+    parent, _ = read_tree(manifest.final_tree)
+    np.testing.assert_array_equal(parent, want.parent)
+
+
+def test_supervised_exports_out_file(small_graph, tmp_path):
+    graph, tail, head, seq, want = small_graph
+    out = str(tmp_path / "exported.tre")
+    cfg = _config()
+    run_supervised(graph, str(tmp_path / "s"), cfg,
+                   runner=InlineRunner(0.05), out_file=out)
+    parent, _ = read_tree(out)  # sidecar exported too: strict read passes
+    np.testing.assert_array_equal(parent, want.parent)
+
+
+# ---------------------------------------------------------------------------
+# THE acceptance property: kill/corrupt/hang at every tournament round
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("kind", ["kill", "corrupt", "hang"])
+def test_fault_at_every_leg_is_bit_identical(small_graph, tmp_path, kind):
+    graph, tail, head, seq, want = small_graph
+    base_manifest, _ = _run(graph, tmp_path / "base")
+    base_bytes = _final(base_manifest)
+    ecv0 = _ecv_down(tail, head, seq, want)
+
+    for rnd, leg in _all_legs():
+        spec = f"{kind}@{'sort' if rnd == SORT_ROUND else rnd}:{leg}"
+        manifest, cfg = _run(
+            graph, tmp_path / f"{kind}-{rnd}-{leg}",
+            chaos=parse_fault_plan(spec),
+            # hang legs are declared dead by deadline, not by exit status
+            deadline_s=0.4 if kind == "hang" else 10.0)
+        assert _final(manifest) == base_bytes, spec
+        parent, pst = read_tree(manifest.final_tree)
+        from sheep_tpu.core.forest import Forest
+        assert _ecv_down(tail, head, seq, Forest(parent, pst)) == ecv0, spec
+        # ONLY the faulted leg re-dispatched
+        for m_leg in manifest.legs:
+            expect = 2 if (m_leg.round, m_leg.index) == (rnd, leg) else 1
+            assert m_leg.dispatches == expect, (spec, m_leg.key)
+        if kind == "corrupt":
+            assert any(e[0] == "leg-failed" and "fsck" in e[2]
+                       for e in cfg.events), spec
+        if kind == "hang":
+            assert any(e[0] == "stale" for e in cfg.events), spec
+
+
+# ---------------------------------------------------------------------------
+# supervisor death + resume: only fsck-dirty legs re-dispatch
+# ---------------------------------------------------------------------------
+
+
+def test_supervisor_killed_at_every_leg_resumes(small_graph, tmp_path):
+    graph, tail, head, seq, want = small_graph
+    base_manifest, _ = _run(graph, tmp_path / "base")
+    base_bytes = _final(base_manifest)
+
+    for rnd, leg in _all_legs():
+        sd = tmp_path / f"stop-{rnd}-{leg}"
+        spec = f"stop@{'sort' if rnd == SORT_ROUND else rnd}:{leg}"
+        with pytest.raises(SupervisorKilled):
+            _run(graph, sd, chaos=parse_fault_plan(spec))
+        pre = {m_leg.key: (m_leg.state, m_leg.dispatches)
+               for m_leg in load_manifest(str(sd)).legs}
+        assert pre[f"r{rnd}.{leg:02d}" if rnd != SORT_ROUND
+                   else "sort"][0] == "done"
+        manifest, cfg = _run(graph, sd)
+        assert _final(manifest) == base_bytes, spec
+        assert any(e[0] == "resume" for e in cfg.events)
+        # a new supervisor re-dispatches exactly the legs that were not
+        # provably complete — never a clean, fsck-passing survivor
+        redone = {m_leg.key for m_leg in manifest.legs
+                  if m_leg.dispatches > pre[m_leg.key][1]}
+        not_done = {key for key, (state, _) in pre.items()
+                    if state != "done"}
+        assert redone == not_done, spec
+
+
+def test_resume_redispatches_corrupt_survivor(small_graph, tmp_path):
+    """The fsck-driven recovery criterion: after a supervisor crash, a
+    corrupted surviving artifact is re-dispatched; every clean survivor
+    is not."""
+    graph, tail, head, seq, want = small_graph
+    sd = tmp_path / "s"
+    with pytest.raises(SupervisorKilled):
+        _run(graph, sd, chaos=parse_fault_plan("stop@0:2"))
+    mm = load_manifest(str(sd))
+    victim = mm.leg("r0.00")
+    assert victim.state == "done"
+    with open(victim.output, "r+b") as f:
+        f.seek(6)
+        b = f.read(1)
+        f.seek(-1, 1)
+        f.write(bytes([b[0] ^ 0xFF]))
+    pre = {leg.key: (leg.state, leg.dispatches) for leg in mm.legs}
+
+    manifest, cfg = _run(graph, sd)
+    parent, _ = read_tree(manifest.final_tree)
+    np.testing.assert_array_equal(parent, want.parent)
+    redone = {leg.key for leg in manifest.legs
+              if leg.dispatches > pre[leg.key][1]}
+    not_done = {key for key, (state, _) in pre.items() if state != "done"}
+    assert redone == not_done | {"r0.00"}
+    resume = [e for e in cfg.events if e[0] == "resume"]
+    assert resume and resume[0][2] == len(not_done | {"r0.00"})
+
+
+def test_resume_skips_corrupt_artifact_nobody_needs(small_graph, tmp_path):
+    """Corrupting a survivor whose consumers all finished must NOT trigger
+    a re-map — the artifact is dead weight, not a dependency."""
+    graph, tail, head, seq, want = small_graph
+    sd = tmp_path / "s"
+    with pytest.raises(SupervisorKilled):
+        _run(graph, sd, chaos=parse_fault_plan("stop@1:0"))
+    mm = load_manifest(str(sd))
+    assert mm.leg("r1.00").state == "done"
+    victim = mm.leg("r0.00")  # consumed by the already-done r1.00 only
+    with open(victim.output, "r+b") as f:
+        f.seek(6)
+        b = f.read(1)
+        f.seek(-1, 1)
+        f.write(bytes([b[0] ^ 0xFF]))
+    pre = {leg.key: leg.dispatches for leg in mm.legs}
+    manifest, _ = _run(graph, sd)
+    parent, _ = read_tree(manifest.final_tree)
+    np.testing.assert_array_equal(parent, want.parent)
+    assert manifest.leg("r0.00").dispatches == pre["r0.00"]
+
+
+def test_resume_refuses_foreign_state_dir(small_graph, tmp_path):
+    graph, tail, head, seq, want = small_graph
+    sd = tmp_path / "s"
+    _run(graph, sd)
+    other = str(tmp_path / "other.net")
+    t2, h2 = rmat_edges(6, 4 << 6, seed=99)
+    write_net(other, t2, h2)
+    with pytest.raises(SupervisionFailed, match="refusing to resume"):
+        _run(other, sd)
+
+
+# ---------------------------------------------------------------------------
+# retry budget + speculation
+# ---------------------------------------------------------------------------
+
+
+def test_budget_exhaustion_fails_loudly(small_graph, tmp_path):
+    graph, *_ = small_graph
+    # kill the same leg on every dispatch: budget 1+1 spent -> loud failure
+    chaos = parse_fault_plan(",".join(["kill@0:1"] * 2))
+    with pytest.raises(SupervisionFailed, match="budget"):
+        _run(graph, tmp_path / "s", chaos=chaos, max_retries=1)
+    # the state dir survives for a later resume
+    assert os.path.exists(str(tmp_path / "s" / "manifest.json"))
+
+
+def test_speculation_first_finisher_wins(small_graph, tmp_path):
+    """A straggler that still beats gets a speculative twin; the twin
+    publishes, the straggler's late artifact is discarded."""
+    graph, tail, head, seq, want = small_graph
+
+    class StragglerRunner(InlineRunner):
+        def start(self, argv, hb_path, log_path):
+            if "1/4" in argv and any(a.endswith(".a1") for a in argv):
+                # first dispatch of map leg 0: beats but never finishes
+                # within the speculation threshold
+                from sheep_tpu.supervisor.supervise import _ThreadHandle
+
+                def target():
+                    with HeartbeatWriter(hb_path, 0.02):
+                        time.sleep(1.2)
+                    return 1
+                return _ThreadHandle(target)
+            return super().start(argv, hb_path, log_path)
+
+    cfg = _config(speculate_after_s=0.15, deadline_s=10.0)
+    manifest = run_supervised(graph, str(tmp_path / "s"), cfg,
+                              runner=StragglerRunner(0.05))
+    parent, _ = read_tree(manifest.final_tree)
+    np.testing.assert_array_equal(parent, want.parent)
+    assert manifest.leg("r0.00").dispatches == 2
+    kinds = [e[0] for e in cfg.events]
+    assert "speculate" in kinds
+    assert ("discard", "r0.00", "lost-race") in cfg.events
+
+
+# ---------------------------------------------------------------------------
+# production runner + shell integration smokes
+# ---------------------------------------------------------------------------
+
+
+def _cli_env():
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    return env
+
+
+def test_supervise_cli_subprocess_runner(small_graph, tmp_path):
+    graph, tail, head, seq, want = small_graph
+    out = str(tmp_path / "g.tre")
+    proc = subprocess.run(
+        ["python", "-m", "sheep_tpu.cli.supervise", graph, "-w", "2",
+         "-d", str(tmp_path / "state"), "-o", out],
+        capture_output=True, text=True, timeout=300, env=_cli_env(),
+        cwd=REPO)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "leg(s) complete" in proc.stdout
+    parent, _ = read_tree(out)
+    np.testing.assert_array_equal(parent, want.parent)
+    # worker logs land in the state dir (operator surface)
+    assert os.listdir(str(tmp_path / "state" / "logs"))
+
+
+HEP = os.path.join(REPO, "data", "hep-th.dat")
+
+
+@pytest.mark.skipif(not os.path.exists(HEP), reason="hep-th.dat not bundled")
+def test_dist_partition_supervised_golden():
+    """dist-partition.sh -S routes the file path through the supervisor
+    and must reproduce the golden hep-th quality numbers."""
+    proc = subprocess.run(
+        ["bash", os.path.join(REPO, "scripts", "dist-partition.sh"),
+         "-S", "-w", "2", "data/hep-th.dat", "2"],
+        capture_output=True, text=True, timeout=600, env=_cli_env(),
+        cwd=REPO)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "ECV(down): 521" in proc.stdout
+    assert "leg(s) complete" in proc.stdout
+    # the supervisor's phase grammar keeps the harness contract
+    assert "Mapped in" in proc.stdout and "Reduced in" in proc.stdout
+
+
+# ---------------------------------------------------------------------------
+# bracket edge shapes: copy legs (odd widths) and the 1-worker degenerate
+# ---------------------------------------------------------------------------
+
+
+def test_odd_width_copy_leg_survives_corruption(small_graph, tmp_path):
+    # W=3 R=2 leaves a single-input slot (a rename in the shell driver,
+    # a "copy" leg here); corrupt its output — the supervisor must fsck,
+    # discard, and re-copy, and the final tree must still match W=4's.
+    graph, tail, head, seq, want = small_graph
+    manifest, cfg = _run(graph, tmp_path / "s", workers=3,
+                         chaos=parse_fault_plan("corrupt@1:1"))
+    assert manifest.leg("r1.01").kind == "copy"
+    assert manifest.leg("r1.01").dispatches == 2
+    parent, pst = read_tree(manifest.final_tree)
+    np.testing.assert_array_equal(parent, want.parent)
+    np.testing.assert_array_equal(pst, want.pst_weight)
+
+
+def test_single_worker_degenerates_to_one_map(small_graph, tmp_path):
+    graph, tail, head, seq, want = small_graph
+    manifest, _ = _run(graph, tmp_path / "s", workers=1)
+    assert [leg.key for leg in manifest.legs] == ["sort", "r0.00"]
+    assert manifest.leg("r0.00").output == manifest.final_tree
+    parent, _ = read_tree(manifest.final_tree)
+    np.testing.assert_array_equal(parent, want.parent)

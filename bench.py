@@ -305,8 +305,9 @@ def _run_one(log_n: int) -> dict:
         he = (tail, head) if platform != "cpu" else None
         return build_graph_hybrid(t, h, n, host_edges=he, perf=perf)
 
+    from sheep_tpu.utils.envinfo import env_capture
     rec = {"log_n": log_n, "edges": e, "platform": platform,
-           "h2d_s": round(h2d_s, 4)}
+           "h2d_s": round(h2d_s, 4), "env": env_capture(platform)}
 
     wanted = _wanted_paths(platform)
 
@@ -558,6 +559,7 @@ def main() -> None:
             rec["last_onchip"] = last_onchip
         print(json.dumps(rec))
         sys.exit(1)
+    from sheep_tpu.utils.envinfo import env_capture
     top = max(sweep, key=lambda r: r["log_n"])
     out = {
         "metric": (f"device_build_edges_per_sec_rmat_n2^{top['log_n']}"
@@ -565,9 +567,14 @@ def main() -> None:
         "value": top["edges_per_sec"],
         "unit": "edges/sec",
         "vs_baseline": top["vs_baseline"],
+        # parent-side capture: per-size records carry their own child
+        # capture; this one attributes the sweep-level conditions (the
+        # VERDICT r05 item-5 driver-vs-clean attribution)
+        "env": env_capture("cpu" if not on_accel else None),
         "sweep": [{k: r[k] for k in
                    ("log_n", "edges_per_sec", "rounds", "best_s", "path",
-                    "h2d_s", "partial", "hybrid", "device", "host_native")
+                    "h2d_s", "partial", "hybrid", "device", "host_native",
+                    "env")
                    if k in r}
                   for r in sweep],
     }

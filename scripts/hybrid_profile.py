@@ -80,8 +80,14 @@ def main() -> None:
             # live count — don't compare it against older records
             record["immediate_handoff"] = rounds == 0 and kind == "host"
             record["reduce"] = perf.get("loop_s")
+            # packing mode + overlap + actual handed-off link count
+            # ride along so A/B arms are auditable from the artifact
+            # alone (ADVICE r05: the ab_pack_off arm could not prove
+            # the knob toggled)
             record.update({k: v for k, v in perf.items()
-                           if k == "overlap" or k.startswith("spec_")})
+                           if k in ("overlap", "packed_handoff",
+                                    "handoff_links")
+                           or k.startswith("spec_")})
         t0 = time.perf_counter()
         if kind == "device":  # converged: links already form the forest
             lo_h, hi_h, _ = fetch_links_host(a, b, live, n)
@@ -106,11 +112,12 @@ def main() -> None:
     # deltas are weakly attributable; the record keeps every rep's total
     # and reports the best rep's phase breakdown
     reps = max(1, int(os.environ.get("SHEEP_PROFILE_REPS", "2")))
+    from sheep_tpu.utils.envinfo import env_capture
     best_rec = None
     totals = []
     for _ in range(reps):
         rec = {"op": "hybrid_profile", "log_n": log_n, "platform": platform,
-               "handoff_factor": factor}
+               "handoff_factor": factor, "env": env_capture(platform)}
         t0 = time.perf_counter()
         one(rec)
         rec["total"] = round(time.perf_counter() - t0, 4)

@@ -19,14 +19,25 @@ accelerator backend it uses however many real devices exist.
 Round 5 adds the collective-volume model per variant (the VERDICT r04
 item-4 evidence): per-worker logical payload bytes and a ring-model
 wire-bytes estimate, plus ``collective_reduction_vs_nogather`` — the
-gather-tail's cut vs the round-4 all-rounds-pmin shape.  Honesty note:
-on the VIRTUAL mesh the gather arm's ``total_s`` at W>1 reads slower
-because one core computes the replicated tail W times; on real hardware
-that tail is parallel wall-time while each avoided pmin round saves a
-real dispatch + all-reduce.  The bytes columns are exact on both.
+gather-tail's cut vs the round-4 all-rounds-pmin shape.
+
+Round 6 adds the SHARDED tail (SHEEP_MESH_TAIL_SHARD, the VERDICT r05
+item-3 fix: the round-5 tail was replicated, so W-1 chips re-derived the
+identical plateau collapse) and its per-chip work model: ``unified`` now
+runs the sharded tail, ``unified_noshard`` is the round-5 replicated
+shape, and each arm carries ``tail_per_chip_link_rounds`` — live links
+times rounds actually processed per chip in its tail (window share *
+local rounds + replicated finish) — the column the item-3 gate reads:
+it must fall with W under the shard and is constant in W without it.
+
+Honesty note: on the VIRTUAL mesh any arm's ``total_s`` at W>1 reads
+slower because one core computes every worker's share serially (and the
+replicated tail W times); on real hardware the sharded local rounds are
+parallel wall-time while each avoided pmin round saves a real dispatch
++ all-reduce.  The bytes/rounds/per-chip-work columns are exact on both.
 
 Usage: python scripts/mesh_bench.py [log_n] [edge_factor] [workers_csv]
-Defaults: 2^18, 8, "1,2,4,8".  Writes MESHBENCH_r05.json at the repo root
+Defaults: 2^18, 8, "1,2,4,8".  Writes MESHBENCH_r06.json at the repo root
 when run at the default size or larger (smaller runs only print).
 """
 
@@ -68,11 +79,14 @@ def main() -> None:
     from sheep_tpu.parallel.mesh import make_mesh
     from scripts.tpu_diag import edges  # cached R-MAT
 
+    from sheep_tpu.utils.envinfo import env_capture
+
     n = 1 << log_n
     e = factor << log_n
     tail, head = edges(log_n, factor)
     rec = {"log_n": log_n, "edges": e, "platform": platform,
-           "devices": ndev, "reps": reps, "curve": []}
+           "devices": ndev, "reps": reps, "env": env_capture(platform),
+           "curve": []}
     print(f"mesh_bench: platform={platform} ndev={ndev} n=2^{log_n} "
           f"edges={e}", file=sys.stderr)
 
@@ -81,16 +95,19 @@ def main() -> None:
         t2d, h2d = stage_edges_2d(tail, head, n, mesh)
         jax.block_until_ready((t2d, h2d))
         row = {"workers": w}
-        # unified (gather-tail default ON, the round-5 production path) /
-        # unified_nogather (the round-4 all-rounds-pmin shape, the comm
+        # unified (gather-tail + sharded tail, the round-6 production
+        # path) / unified_noshard (round-5: gather-tail, replicated
+        # tail) / unified_nogather (round-4 all-rounds-pmin, the comm
         # model's baseline) / split (the reference's transportable-
         # partials shape)
-        # gather_tail pinned explicitly on BOTH unified arms: an
-        # inherited SHEEP_MESH_GATHER_TAIL=0 would otherwise silently
-        # turn the comparison into nogather-vs-nogather
-        variants = (("unified", True, True), ("unified_nogather", True,
-                                              False), ("split", False, None))
-        for label, unified, gt in variants:
+        # gather_tail/tail_shard pinned explicitly on every unified arm:
+        # inherited SHEEP_MESH_GATHER_TAIL=0 / SHEEP_MESH_TAIL_SHARD=0
+        # would otherwise silently collapse the comparison arms
+        variants = (("unified", True, True, True),
+                    ("unified_noshard", True, True, False),
+                    ("unified_nogather", True, False, False),
+                    ("split", False, None, None))
+        for label, unified, gt, tsh in variants:
             best = None
             for _ in range(reps + 1):  # +1 warmup/compile
                 tm = {}
@@ -98,7 +115,7 @@ def main() -> None:
                 t0 = time.perf_counter()
                 _, _, _, parent, _ = build_links_chunked_sharded(
                     t2d, h2d, n, mesh, timings=tm, unified=unified,
-                    gather_tail=gt, comm=comm)
+                    gather_tail=gt, tail_shard=tsh, comm=comm)
                 total = time.perf_counter() - t0
                 tm["total_s"] = total
                 tm["comm"] = comm
@@ -120,10 +137,25 @@ def main() -> None:
             # for the compute-normalized story VERDICT r04 item 3 asks
             # for) plus a per-collective dispatch floor
             ici_gbps = float(os.environ.get("SHEEP_ICI_GBPS", "45"))
-            n_colls = (comm.get("sharded_global_rounds", 0)
-                       + (1 if comm.get("gather_payload_bytes", 0) else 0))
+            n_gathers = 0
+            if comm.get("gather_payload_bytes", 0):
+                n_gathers = 2 if comm.get("tail_shard_rounds", 0) else 1
+            n_colls = comm.get("sharded_global_rounds", 0) + n_gathers
             coll_s = wire / (max(w, 1) * ici_gbps * 1e9) \
                 + n_colls * 5e-6
+            # per-chip tail work (links x rounds actually processed per
+            # chip): sharded = this chip's window share through the
+            # local rounds + the (replicated, small) finish; replicated
+            # = every chip grinds the whole gathered set every round
+            gather_live = comm.get("tail_gather_live", 0)
+            if comm.get("tail_shard_rounds", 0) > 0:
+                row_live = comm.get("tail_shard_row_live") or [0]
+                per_chip_tail = (max(row_live)
+                                 * comm.get("tail_shard_rounds", 0)
+                                 + comm.get("tail_finish_live", 0)
+                                 * comm.get("tail_rounds", 0))
+            else:
+                per_chip_tail = gather_live * comm.get("tail_rounds", 0)
             row[label] = {
                 "map_s": round(best["map_s"], 4),
                 "reduce_s": round(best["reduce_s"], 4),
@@ -133,6 +165,11 @@ def main() -> None:
                 "reduce_rounds": best["reduce_rounds"],
                 "sharded_global_rounds": comm.get("sharded_global_rounds"),
                 "tail_rounds": comm.get("tail_rounds"),
+                "tail_shard_rounds": comm.get("tail_shard_rounds"),
+                "tail_shard_row_live": comm.get("tail_shard_row_live"),
+                "tail_gather_live": comm.get("tail_gather_live"),
+                "tail_finish_live": comm.get("tail_finish_live"),
+                "tail_per_chip_link_rounds": per_chip_tail,
                 "pmin_payload_bytes": comm.get("pmin_payload_bytes"),
                 "gather_payload_bytes": comm.get("gather_payload_bytes"),
                 "collective_payload_bytes": payload,
@@ -154,7 +191,12 @@ def main() -> None:
         print(f"mesh_bench: W={w} unified "
               f"{row['unified']['total_s']}s "
               f"({row['unified']['sharded_global_rounds']} pmin r + "
+              f"{row['unified']['tail_shard_rounds']} shard r + "
               f"{row['unified']['tail_rounds']} tail r, "
+              f"per-chip tail "
+              f"{row['unified']['tail_per_chip_link_rounds'] / 1e6:.2f}M "
+              f"link-rounds vs noshard "
+              f"{row['unified_noshard']['tail_per_chip_link_rounds'] / 1e6:.2f}M, "
               f"{ours / 1e6:.1f}MB payload) vs nogather "
               f"{row['unified_nogather']['total_s']}s "
               f"({base / 1e6:.1f}MB) = "
@@ -164,7 +206,7 @@ def main() -> None:
 
     if log_n >= 18:
         out = os.path.join(os.path.dirname(os.path.dirname(
-            os.path.abspath(__file__))), "MESHBENCH_r05.json")
+            os.path.abspath(__file__))), "MESHBENCH_r06.json")
         with open(out, "w") as f:
             f.write(json.dumps(rec) + "\n")
     print(json.dumps(rec))

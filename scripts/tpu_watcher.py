@@ -30,7 +30,7 @@ import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 PY = sys.executable
-ROUND = "r05"
+ROUND = "r06"
 
 
 def log(msg: str) -> None:
@@ -369,6 +369,36 @@ def build_queue() -> list[Step]:
              sidecar="bench_progress.json",
              done_check=lambda rec: any(
                  s.get("log_n", 0) >= 22 for s in rec.get("sweep", []))),
+        # 10. round-6 plateau scheduler A/B on the pure-device path: the
+        # default arm (adapt on) is devbench_20 above; this is the off
+        # arm, so the first window prices the straggler assist's host
+        # round trips against the plateau rounds it removes ON the
+        # tunnel (cpu measured 34->13 rounds @2^20, 90->13 @2^22).
+        Step("devbench_20_plateau_off", [PY, "bench.py"],
+             f"TPU_DEVBENCH_PLATEAU_OFF_{ROUND}.json", 4500,
+             env={"SHEEP_BENCH_PATHS": "device",
+                  "SHEEP_BENCH_SIZES": "20",
+                  "SHEEP_BENCH_TIMEOUT": "2400",
+                  "SHEEP_PLATEAU_ADAPT": "0"},
+             sidecar="bench_progress.json"),
+        # 11. round-6 cache-blocked native kernel A/B, measured on the
+        # TUNNEL HOST's cpu (the same record shape as the committed
+        # CPUBENCH arms; host_native rides in the sweep record).  The
+        # 1-core bench host's 260MB L3 absorbs most of the random
+        # scatter, so the blocked win there is modest — this prices it
+        # on a second microarchitecture for free.
+        # (The sharded mesh tail has no on-chip arm yet: the tunnel
+        # serves ONE chip, and the virtual-mesh wall-clock is not
+        # evidence — its bytes/rounds model is committed in
+        # MESHBENCH_r06.json instead.)
+        Step("ab_native_blocked_off", [PY, "bench.py"],
+             f"TPU_AB_NATIVE_{ROUND}.json", 4000,
+             env={"SHEEP_BENCH_PATHS": "hybrid,host",
+                  "SHEEP_BENCH_SIZES": "22",
+                  "SHEEP_BENCH_TIMEOUT": "2400",
+                  "SHEEP_BENCH_LOG_N": "",
+                  "SHEEP_NATIVE_BLOCKED": "0"},
+             sidecar="bench_progress.json"),
     ]
     return q
 

@@ -90,4 +90,44 @@ then
 fi
 # -------------------------------------------------------------------------
 
+# --- plateau + tail-shard smoke (reduce core, ISSUE 4) -------------------
+# One forced-assist device build and one sharded-tail mesh build on a
+# small R-MAT, both asserted bit-identical to the oracle.  Seconds of
+# work; a regression in the round-6 reduce-core machinery fails the gate
+# before pytest even runs.
+if ! env JAX_PLATFORMS=cpu SHEEP_PLATEAU_FORCE=1 \
+     XLA_FLAGS="--xla_force_host_platform_device_count=8" python - <<'EOF'
+import numpy as np
+import jax.numpy as jnp
+from sheep_tpu.core import build_forest, degree_sequence
+from sheep_tpu.ops.build import prepare_links
+from sheep_tpu.ops.forest import forest_fixpoint_hosted
+from sheep_tpu.parallel import build_graph_chunked_distributed
+from sheep_tpu.utils.synth import rmat_edges
+
+n = 1 << 11
+tail, head = rmat_edges(11, 4 * n, seed=17)
+want_seq = degree_sequence(tail, head)
+want = build_forest(tail, head, want_seq)
+m = len(want_seq)
+wantp = np.where(want.parent == 0xFFFFFFFF, n, want.parent.astype(np.int64))
+
+# plateau scheduler (assist forced on from round one)
+_, _, m_d, lo, hi, _ = prepare_links(jnp.asarray(tail, jnp.int32),
+                                     jnp.asarray(head, jnp.int32), n)
+parent, _ = forest_fixpoint_hosted(lo, hi, n)
+np.testing.assert_array_equal(np.asarray(parent)[:m].astype(np.int64), wantp)
+
+# sharded gather-tail over the virtual mesh
+seq2, forest2 = build_graph_chunked_distributed(tail, head, num_workers=8)
+np.testing.assert_array_equal(seq2, want_seq)
+np.testing.assert_array_equal(forest2.parent[:m], want.parent)
+EOF
+then
+  echo "PLATEAU/TAIL-SHARD SMOKE FAILED: round-6 reduce core diverged" \
+       "from the oracle" >&2
+  exit 1
+fi
+# -------------------------------------------------------------------------
+
 set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=${PIPESTATUS[0]}; echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c); exit $rc

@@ -190,6 +190,12 @@ def build_forest(tail: np.ndarray, head: np.ndarray, seq: np.ndarray,
     native = native_or_none(impl)
     if native is not None:
         pos = sequence_positions(seq, max_vid)
+        if native.blocked_enabled():
+            # fused round-6 kernel: records group straight into the
+            # cache-blocked union-find; the intermediate link arrays
+            # (~0.5GB of stream traffic at 2^23) never materialize
+            p, w = native.build_forest_edges(tail, head, pos, len(seq))
+            return Forest(p, w)
         lo, hi = native.edges_to_links(tail, head, pos)
         p, w = native.build_forest_links(lo, hi, len(seq))
         return Forest(p, w)

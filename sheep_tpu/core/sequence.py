@@ -50,6 +50,13 @@ def degree_sequence(tail: np.ndarray, head: np.ndarray,
     n = num_vertices
     if n is None:
         n = int(max(tail.max(initial=0), head.max(initial=0))) + 1 if len(tail) else 0
+    from .. import native
+    if native.available() and native.blocked_enabled():
+        # fused round-6 kernel (uint32 histogram + counting sort in one
+        # native call); None = range outgrew its buckets, fall through
+        seq = native.degree_sequence_from_edges(tail, head, n)
+        if seq is not None:
+            return seq
     return degree_sequence_from_degrees(host_degree_histogram(tail, head, n))
 
 

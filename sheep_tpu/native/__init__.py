@@ -73,6 +73,10 @@ def _bind(lib: ctypes.CDLL) -> None:
     lib.sheep_edges_to_links.restype = ctypes.c_int64
     lib.sheep_edges_to_links.argtypes = [
         _u32p, _u32p, ctypes.c_int64, _u32p, ctypes.c_int64, _u32p, _u32p]
+    lib.sheep_build_forest_edges.restype = ctypes.c_int
+    lib.sheep_build_forest_edges.argtypes = [
+        _u32p, _u32p, ctypes.c_int64, _u32p, ctypes.c_int64,
+        ctypes.c_int64, _u32p, _u32p, ctypes.c_void_p]
     lib.sheep_forward_partition.restype = ctypes.c_int64
     lib.sheep_forward_partition.argtypes = [
         _u32p, _i64p, ctypes.c_int64, ctypes.c_int64, _i32p]
@@ -82,6 +86,9 @@ def _bind(lib: ctypes.CDLL) -> None:
     lib.sheep_degree_sequence.restype = ctypes.c_int64
     lib.sheep_degree_sequence.argtypes = [
         _i64p, ctypes.c_int64, _u32p]
+    lib.sheep_degree_sequence_edges.restype = ctypes.c_int64
+    lib.sheep_degree_sequence_edges.argtypes = [
+        _u32p, _u32p, ctypes.c_int64, ctypes.c_int64, _u32p]
     lib.sheep_jxn_build.restype = ctypes.c_int64
     lib.sheep_jxn_build.argtypes = [
         _u32p, _u32p, ctypes.c_int64, _u32p, ctypes.c_int64,
@@ -128,6 +135,38 @@ def build_forest_links(lo: np.ndarray, hi: np.ndarray, n: int,
                                 pre_ptr)
     if rc != 0:
         raise RuntimeError(f"sheep_build_forest failed rc={rc}")
+    if compute_pre:
+        return parent, pst_out, pre_out
+    return parent, pst_out
+
+
+def blocked_enabled() -> bool:
+    """The cache-blocked kernel gate (SHEEP_NATIVE_BLOCKED, default on).
+    Read per call so A/B arms can flip it without reloading the library;
+    the C++ side reads the same variable for its internal dispatch."""
+    return os.environ.get("SHEEP_NATIVE_BLOCKED", "1") != "0"
+
+
+def build_forest_edges(tail: np.ndarray, head: np.ndarray, pos: np.ndarray,
+                       n: int, compute_pre: bool = False):
+    """Fused edge->forest build (round-6): maps records through the
+    position table and groups into the cache-blocked union-find without
+    materializing the intermediate link arrays.  Returns (parent, pst)
+    uint32 [n] (+ pre when ``compute_pre``), bit-identical to
+    edges_to_links + build_forest_links."""
+    lib = _load()
+    assert lib is not None
+    tail = np.ascontiguousarray(tail, dtype=np.uint32)
+    head = np.ascontiguousarray(head, dtype=np.uint32)
+    pos = np.ascontiguousarray(pos, dtype=np.uint32)
+    parent = np.empty(n, dtype=np.uint32)
+    pst_out = np.empty(n, dtype=np.uint32)
+    pre_out = np.empty(n, dtype=np.uint32) if compute_pre else None
+    pre_ptr = pre_out.ctypes.data_as(ctypes.c_void_p) if compute_pre else None
+    rc = lib.sheep_build_forest_edges(tail, head, len(tail), pos, len(pos),
+                                      n, parent, pst_out, pre_ptr)
+    if rc != 0:
+        raise RuntimeError(f"sheep_build_forest_edges failed rc={rc}")
     if compute_pre:
         return parent, pst_out, pre_out
     return parent, pst_out
@@ -248,6 +287,26 @@ def fennel_edges(tail: np.ndarray, head: np.ndarray, n_vid: int,
     if rc != 0:
         raise ValueError(f"sheep_fennel_edges failed rc={rc}")
     return eparts
+
+
+def degree_sequence_from_edges(tail: np.ndarray, head: np.ndarray,
+                               n: int) -> np.ndarray | None:
+    """Fused histogram + counting-sort degree sequence (round-6): one
+    call, uint32 histogram internally.  Returns None when the record or
+    degree range outgrows the fused kernel's buckets (callers fall back
+    to the two-call path), raises on out-of-range vids."""
+    lib = _load()
+    assert lib is not None
+    tail = np.ascontiguousarray(tail, dtype=np.uint32)
+    head = np.ascontiguousarray(head, dtype=np.uint32)
+    seq = np.empty(n, dtype=np.uint32)
+    k = lib.sheep_degree_sequence_edges(tail, head, len(tail), n, seq)
+    if k == -3:
+        raise ValueError(
+            f"corrupt edge records: a vid is out of range for n={n}")
+    if k < 0:
+        return None
+    return seq[:k].copy()
 
 
 def degree_sequence_from_degrees(deg: np.ndarray) -> np.ndarray | None:

@@ -11,25 +11,267 @@
 // (ctypes-friendly; no pybind11 in this toolchain).  All functions return 0
 // on success, negative on error.
 
+#include <chrono>
 #include <cmath>
 #include <cstdint>
 #include <cstdio>
+#include <cstdlib>
 #include <cstring>
 #include <algorithm>
+#include <memory>
 #include <vector>
 
 namespace {
 constexpr uint32_t kInvalid = 0xFFFFFFFFu;
 
-// Path-halving find over a flat uint32 union-find array whose representative
-// is the *max-position* element of each component (the later-in-sequence
-// vertex survives, mirroring lib/unionfind.h:82-102 unify(lesser, greater)).
-static inline uint32_t uf_find(uint32_t* uf, uint32_t x) {
-  while (uf[x] != x) {
-    uf[x] = uf[uf[x]];
-    x = uf[x];
+// SHEEP_NATIVE_TIME=1: per-phase stderr timings for the hot kernels
+// (dev observability; costs two getenv + clock reads per call when off).
+static inline bool time_enabled() {
+  const char* v = std::getenv("SHEEP_NATIVE_TIME");
+  return v && v[0] == '1';
+}
+
+struct PhaseTimer {
+  bool on;
+  std::chrono::steady_clock::time_point t;
+  const char* tag;
+  explicit PhaseTimer(const char* tag) : on(time_enabled()), tag(tag) {
+    if (on) t = std::chrono::steady_clock::now();
   }
-  return x;
+  void mark(const char* phase) {
+    if (!on) return;
+    auto now = std::chrono::steady_clock::now();
+    std::fprintf(stderr, "native %s.%s %.3fs\n", tag, phase,
+                 std::chrono::duration<double>(now - t).count());
+    t = now;
+  }
+};
+
+// Find over a flat uint32 union-find array whose representative is the
+// *max-position* element of each component (the later-in-sequence vertex
+// survives, mirroring lib/unionfind.h:82-102 unify(lesser, greater)).
+// Two-phase: a read-only walk to the root, then full path compression —
+// the write-free <=1-hop fast path matters on the latency-bound bench
+// host, where path-halving's unconditional store dirtied a cache line
+// (RFO traffic) even for chains it could not shorten.  Returns the same
+// root as any compression flavor (roots are never rewritten), so
+// outputs are unchanged.
+static inline uint32_t uf_find(uint32_t* uf, uint32_t x) {
+  uint32_t r = uf[x];
+  if (r == x) return x;
+  uint32_t rr = uf[r];
+  if (rr == r) return r;  // 2 reads, 0 writes — the overwhelming cases
+  do {
+    r = rr;
+    rr = uf[r];
+  } while (rr != r);
+  while (uf[x] != r) {  // full compression of the (rare) long chain
+    uint32_t nx = uf[x];
+    uf[x] = r;
+    x = nx;
+  }
+  return r;
+}
+
+// ---------------------------------------------------------------------------
+// Cache-blocked kernels (round-6).
+//
+// Measured on the 1-core bench host, the forest build decays 43M -> 13.3M
+// edges/s from 2^16 to 2^23 (CPUBENCH23_r05).  Phase timers
+// (SHEEP_NATIVE_TIME=1) put the loss in two places at 2^23: the
+// counting-sort group fill (1.07s -- a random cursor RMW over a 67MB
+// offs table plus a random 4-byte store into the 268MB lo_by_hi array,
+// which outlives the LLC) and the adoption loop (1.0s -- the union-find
+// chase plus a random parent_out read per link).  Plain independent
+// random loads on this host cost a flat ~7.8ns (260MB L3; prefetch and
+// hugepages were measured to change nothing), so the wins come from
+// REMOVING random touches and passes, not from streaming:
+//
+//   * the group fill becomes a two-phase split: links partition into
+//     K = 128 EQUAL-COUNT buckets (quantiles of the per-h prefix
+//     table) as packed (h << 32 | lo) records — each bucket's stream
+//     write is sequential and the cursor table lives in L1 — and each
+//     bucket then scatters against its own small slice of the prefix
+//     table into a reused ~linked/K-sized group buffer, with the
+//     adoption scan fused right behind while the bucket's lo values
+//     are still warm;
+//   * every loop stays a TIGHT single-purpose pass: fusing several
+//     random-access streams into one loop body was measured up to 2x
+//     slower (it starves the out-of-order window's memory-level
+//     parallelism), and more than ~128 concurrent write streams
+//     measured up to 3x slower per record — both shaped this design;
+//   * the adoption loop drops its random parent_out read: a root
+//     returned by find has parent set iff it was adopted in the
+//     CURRENT group (uf chains are strictly increasing, so a root
+//     adopted in an earlier group can never be found again), and the
+//     current group's few adoptions sit in a hot vector a linear scan
+//     checks faster than one L3 miss (large hub groups fall back to
+//     the parent check to stay O(len)).
+//
+// Everything is order-stable, so outputs are bit-identical to the
+// unblocked path (kept for small inputs and the SHEEP_NATIVE_BLOCKED=0
+// A/B escape hatch).
+// ---------------------------------------------------------------------------
+
+constexpr int kMaxBuckets = 128;  // write-stream cap (measured knee)
+
+static inline bool blocked_enabled() {
+  const char* v = std::getenv("SHEEP_NATIVE_BLOCKED");
+  return !(v && v[0] == '0' && v[1] == '\0');
+}
+
+static inline bool use_blocked(int64_t m, int64_t n) {
+  // below one bucket of vertices (or trivially few records) the plain
+  // counting sort is already cache-resident and the extra pass is waste
+  // m < 2^31: the blocked kernel's int32 prefix table must fit the
+  // link count (larger inputs take the int64 unblocked path)
+  return blocked_enabled() && n > (int64_t{1} << 16) &&
+         m > (int64_t{1} << 16) && m < (int64_t{1} << 31) - 2;
+}
+
+// One hi-group's adoption scan (the reference's per-vertex edge scan,
+// lib/jtree.cpp:34-55): shared verbatim by the blocked and unblocked
+// paths so their semantics cannot drift.  Unions are deferred to the end
+// of the group (adoptKids, lib/jnode.h:184-188).  The already-adopted
+// check scans the group's own adoption list while it is small: a found
+// root r has uf[r] == r, uf chains are strictly increasing and adoption
+// at an earlier group set uf[r] to that group's (larger) vertex forever,
+// so parent_out[r] != kInvalid can ONLY mean "adopted earlier in this
+// group" -- which the hot list answers without a ~7.8ns random read.
+template <bool kPre>
+static inline void adopt_group_impl(const uint32_t* grp, int64_t len,
+                                    uint32_t hh, uint32_t* uf,
+                                    uint32_t* parent_out, uint32_t* pre_out,
+                                    std::vector<uint32_t>& adopted) {
+  adopted.clear();
+  for (int64_t i = 0; i < len; ++i) {
+    if (i + 8 < len) __builtin_prefetch(&uf[grp[i + 8]]);
+    uint32_t r = uf_find(uf, grp[i]);
+    if (kPre) ++pre_out[r];
+    if (r == hh) continue;
+    bool seen;
+    if (adopted.size() <= 48) {
+      seen = false;
+      for (uint32_t a : adopted)
+        if (a == r) { seen = true; break; }
+    } else {  // hub group: the list outgrew one cache miss's worth
+      seen = parent_out[r] != kInvalid;
+    }
+    if (!seen) {
+      parent_out[r] = hh;  // adopt: lib/jnode.h:158-162
+      adopted.push_back(r);
+    }
+  }
+  for (uint32_t r : adopted) uf[r] = hh;
+}
+
+static inline void adopt_group(const uint32_t* grp, int64_t len, uint32_t hh,
+                               uint32_t* uf, uint32_t* parent_out,
+                               uint32_t* pre_out,
+                               std::vector<uint32_t>& adopted) {
+  if (pre_out)
+    adopt_group_impl<true>(grp, len, hh, uf, parent_out, pre_out, adopted);
+  else
+    adopt_group_impl<false>(grp, len, hh, uf, parent_out, pre_out, adopted);
+}
+
+static inline uint32_t rec_lo(uint64_t r) { return (uint32_t)r; }
+static inline int64_t rec_h(uint64_t r) { return (int64_t)(r >> 32); }
+
+// Grouping + adoption of (lo, hi<n) links, shared by sheep_build_forest
+// and the fused sheep_build_forest_edges.  One global per-h count
+// builds the prefix table; EQUAL-COUNT bucket boundaries come from its
+// quantiles -- equal-SPAN buckets were measured useless on power-law
+// inputs, where ONE 2^16-position window held 79% of all links at 2^23
+// and its scatter degenerated back to the cache-hostile global fill.
+// With ~linked/128 links per bucket, a hub bucket's position span is
+// tiny (its slice of the prefix table and its group buffer are L2-
+// resident) while a sparse bucket's wide span carries few links.  The
+// per-link bucket lookup is O(1): a 32KB block table (h >> 10) gives
+// the starting bucket and a short forward walk crosses any remaining
+// boundaries.  ``pst_out`` non-null also accumulates the tree-link pst
+// histogram inside the partition pass's read loop (its own tight pass
+// upstream would reread the full link arrays).
+static void blocked_group_adopt(const uint32_t* lo, const uint32_t* hi,
+                                int64_t m, int64_t n, uint32_t* pst_out,
+                                uint32_t* uf, uint32_t* parent_out,
+                                uint32_t* pre_out, PhaseTimer& pt) {
+  // int32 prefix table: the count pass's random increments measured
+  // ~27% cheaper on 4-byte counters than 8-byte (narrower line
+  // footprint); use_blocked guarantees m < 2^31 so the prefix fits
+  std::vector<int32_t> offs((size_t)n + 1, 0);
+  for (int64_t i = 0; i < m; ++i)
+    if (hi[i] < (uint64_t)n) ++offs[hi[i] + 1];
+  if (pst_out)
+    for (int64_t i = 0; i < m; ++i) ++pst_out[lo[i]];
+  pt.mark("count+pst");
+  for (int64_t h = 0; h < n; ++h) offs[h + 1] += offs[h];
+  const int64_t linked = offs[n];
+  // equal-count boundaries (a single h never splits: a bucket is just
+  // allowed to run over when one group alone exceeds the target)
+  const int64_t K = kMaxBuckets;
+  std::vector<int64_t> bound((size_t)K + 1);
+  bound[0] = 0;
+  bound[(size_t)K] = n;
+  for (int64_t b = 1; b < K; ++b)
+    bound[(size_t)b] = std::lower_bound(offs.begin(), offs.begin() + n + 1,
+                                        (int32_t)(b * linked / K)) -
+                       offs.begin();
+  // per-h bucket-id table (uint8; K <= 128): one sequential O(n) build,
+  // then the per-link lookup is a single gather that hub-heavy inputs
+  // keep L1/L2-hot (a boundary-walk lookup was measured 4x slower —
+  // boundaries CLUSTER inside the hub windows, exactly where most
+  // links land, so walks there crossed dozens of boundaries per link)
+  std::vector<uint8_t> bucket_of((size_t)n);
+  for (int64_t b = 0; b < K; ++b)
+    std::memset(bucket_of.data() + bound[(size_t)b], (int)b,
+                (size_t)(bound[(size_t)b + 1] - bound[(size_t)b]));
+  std::vector<int64_t> bstart((size_t)K + 1);
+  for (int64_t b = 0; b <= K; ++b) bstart[(size_t)b] = offs[bound[(size_t)b]];
+  std::unique_ptr<uint64_t[]> recs(new uint64_t[(size_t)linked]);
+  {
+    std::vector<int64_t> bcur(bstart.begin(), bstart.end() - 1);
+    for (int64_t i = 0; i < m; ++i) {
+      const uint32_t h = hi[i];
+      if (h >= (uint64_t)n) continue;
+      recs[(size_t)bcur[bucket_of[h]]++] = ((uint64_t)h << 32) | lo[i];
+    }
+  }
+  pt.mark("partition");
+  std::vector<uint32_t> grouped, adopted;
+  double scat_s = 0, adopt_s = 0;
+  const bool timed = time_enabled();
+  for (int64_t b = 0; b < K; ++b) {
+    const int64_t s = bstart[(size_t)b], t = bstart[(size_t)b + 1];
+    if (s == t) continue;
+    auto t0 = timed ? std::chrono::steady_clock::now()
+                    : std::chrono::steady_clock::time_point();
+    if ((int64_t)grouped.size() < t - s) grouped.resize((size_t)(t - s));
+    // offs[h] is the global start of group h; mutate it as the scatter
+    // cursor, leaving offs[h] == end of group h for the boundary walk
+    for (int64_t i = s; i < t; ++i)
+      grouped[(size_t)(offs[rec_h(recs[(size_t)i])]++ - s)] =
+          rec_lo(recs[(size_t)i]);
+    auto t1 = timed ? std::chrono::steady_clock::now()
+                    : std::chrono::steady_clock::time_point();
+    int64_t prev = s;
+    for (int64_t h = bound[(size_t)b]; h < bound[(size_t)b + 1]; ++h) {
+      const int64_t end = offs[h];
+      if (end > prev)
+        adopt_group(grouped.data() + (prev - s), end - prev, (uint32_t)h,
+                    uf, parent_out, pre_out, adopted);
+      prev = end;
+    }
+    if (timed) {
+      auto t2 = std::chrono::steady_clock::now();
+      scat_s += std::chrono::duration<double>(t1 - t0).count();
+      adopt_s += std::chrono::duration<double>(t2 - t1).count();
+    }
+  }
+  if (timed)
+    std::fprintf(stderr, "native buckets.scatter %.3fs .adopt %.3fs\n",
+                 scat_s, adopt_s);
+  pt.mark("buckets");
 }
 }  // namespace
 
@@ -62,49 +304,55 @@ int sheep_build_forest(const uint32_t* lo, const uint32_t* hi, int64_t m,
                        uint32_t* parent_out, uint32_t* pst_out,
                        uint32_t* pre_out) {
   if (n < 0 || m < 0) return -1;
-  for (int64_t i = 0; i < m; ++i)
-    if (lo[i] >= (uint64_t)n) return -3;  // malformed link
+  PhaseTimer pt("build_forest");
+  const bool blocked = use_blocked(m, n);
   if (pst_in) {
     std::memcpy(pst_out, pst_in, sizeof(uint32_t) * (size_t)n);
   } else {
     std::memset(pst_out, 0, sizeof(uint32_t) * (size_t)n);
-    for (int64_t i = 0; i < m; ++i) ++pst_out[lo[i]];
   }
-
-  // Counting sort of lo values grouped by hi; pst-only links are excluded.
-  std::vector<int64_t> offs((size_t)n + 1, 0);
-  for (int64_t i = 0; i < m; ++i)
-    if (hi[i] < (uint64_t)n) ++offs[hi[i] + 1];
-  for (int64_t h = 0; h < n; ++h) offs[h + 1] += offs[h];
-  int64_t linked = offs[n];
-  std::vector<uint32_t> lo_by_hi((size_t)linked);
-  {
-    std::vector<int64_t> cur(offs.begin(), offs.end() - 1);
-    for (int64_t i = 0; i < m; ++i)
-      if (hi[i] < (uint64_t)n) lo_by_hi[(size_t)cur[hi[i]]++] = lo[i];
-  }
-
   for (int64_t v = 0; v < n; ++v) parent_out[v] = kInvalid;
   if (pre_out) std::memset(pre_out, 0, sizeof(uint32_t) * (size_t)n);
   std::vector<uint32_t> uf((size_t)n);
   for (int64_t v = 0; v < n; ++v) uf[(size_t)v] = (uint32_t)v;
 
-  std::vector<uint32_t> adopted;
-  for (int64_t h = 0; h < n; ++h) {
-    const uint32_t hh = (uint32_t)h;
-    adopted.clear();
-    for (int64_t i = offs[h]; i < offs[h + 1]; ++i) {
-      uint32_t r = uf_find(uf.data(), lo_by_hi[(size_t)i]);
-      if (pre_out) ++pre_out[r];
-      if (r != hh && parent_out[r] == kInvalid) {
-        parent_out[r] = hh;  // adopt: lib/jnode.h:158-162
-        adopted.push_back(r);
-      }
+  if (!blocked) {
+    for (int64_t i = 0; i < m; ++i)
+      if (lo[i] >= (uint64_t)n) return -3;  // malformed link
+    if (!pst_in)
+      for (int64_t i = 0; i < m; ++i) ++pst_out[lo[i]];
+    pt.mark("validate+pst");
+    // Counting sort of lo values grouped by hi; pst-only links excluded.
+    std::vector<int64_t> offs((size_t)n + 1, 0);
+    for (int64_t i = 0; i < m; ++i)
+      if (hi[i] < (uint64_t)n) ++offs[hi[i] + 1];
+    pt.mark("count");
+    for (int64_t h = 0; h < n; ++h) offs[h + 1] += offs[h];
+    int64_t linked = offs[n];
+    std::vector<uint32_t> lo_by_hi((size_t)linked);
+    {
+      std::vector<int64_t> cur(offs.begin(), offs.end() - 1);
+      for (int64_t i = 0; i < m; ++i)
+        if (hi[i] < (uint64_t)n) lo_by_hi[(size_t)cur[hi[i]]++] = lo[i];
     }
-    // Deferred unify (adoptKids): repeat edges into the same component
-    // within one group keep finding the old root, as in the reference.
-    for (uint32_t r : adopted) uf[r] = hh;
+    pt.mark("scatter");
+    std::vector<uint32_t> adopted;
+    for (int64_t h = 0; h < n; ++h)
+      adopt_group(lo_by_hi.data() + offs[h], offs[h + 1] - offs[h],
+                  (uint32_t)h, uf.data(), parent_out, pre_out, adopted);
+    pt.mark("adopt");
+    return 0;
   }
+
+  // Blocked path: validate in one tight pass, then the shared
+  // quantile-bucketed grouping+adoption (which also accumulates pst
+  // unless precomputed).  Outputs are undefined on error, so a
+  // partially-filled pst at the -3 return is fine.
+  for (int64_t i = 0; i < m; ++i)
+    if (lo[i] >= (uint64_t)n) return -3;
+  pt.mark("validate");
+  blocked_group_adopt(lo, hi, m, n, pst_in ? nullptr : pst_out, uf.data(),
+                      parent_out, pre_out, pt);
   return 0;
 }
 
@@ -233,6 +481,93 @@ int sheep_degree_histogram(const uint32_t* tail, const uint32_t* head,
     ++deg_out[tail[i]];
     ++deg_out[head[i]];
   }
+  return 0;
+}
+
+// Fused degree sequence straight from edge records (round-6): histogram
+// + ascending-degree counting sort in one call, with the histogram in
+// uint32 — int64 counters measured ~27% slower per random increment on
+// the bench host purely from the wider line footprint, and per-vertex
+// degrees fit uint32 up to 2^31 records (validated; falls back -5 past
+// it, callers use the two-call path).  Semantics identical to
+// sheep_degree_histogram + sheep_degree_sequence: nonzero degrees only,
+// ascending degree, ascending-vid tie break.  Returns the sequence
+// length, -3 on an out-of-range vid, -5 when m is too large for the
+// uint32 histogram.
+int64_t sheep_degree_sequence_edges(const uint32_t* tail,
+                                    const uint32_t* head, int64_t m,
+                                    int64_t n, uint32_t* seq_out) {
+  if (n < 0 || m < 0 || 2 * m > (int64_t)kInvalid) return -5;
+  std::vector<uint32_t> deg((size_t)n, 0);
+  for (int64_t i = 0; i < m; ++i) {
+    if (tail[i] >= (uint64_t)n || head[i] >= (uint64_t)n) return -3;
+    ++deg[tail[i]];
+    ++deg[head[i]];
+  }
+  uint32_t max_deg = 0;
+  for (int64_t v = 0; v < n; ++v)
+    if (deg[v] > max_deg) max_deg = deg[v];
+  // same bucket-width guard as the two-call path: a multigraph hub can
+  // push max_degree far past n, where counting buckets explode; callers
+  // fall back to the comparison sort on -6
+  if ((int64_t)max_deg > std::max<int64_t>(4 * n, int64_t{1} << 20))
+    return -6;
+  std::vector<int64_t> offs((size_t)max_deg + 2, 0);
+  for (int64_t v = 0; v < n; ++v)
+    if (deg[v] > 0) ++offs[deg[v] + 1];
+  for (uint32_t d = 0; d <= max_deg; ++d) offs[d + 1] += offs[d];
+  const int64_t total = offs[max_deg + 1];
+  for (int64_t v = 0; v < n; ++v)
+    if (deg[v] > 0) seq_out[offs[deg[v]]++] = (uint32_t)v;
+  return total;
+}
+
+// Fused edge->forest build: maps raw records through the vid->position
+// table and feeds the blocked grouping DIRECTLY — the lo/hi link arrays
+// of the two-call path (sheep_edges_to_links + sheep_build_forest) are
+// never materialized, which at 2^23 removes ~0.5GB of stream traffic
+// plus the second full-m validation scan.  Exact same semantics: a vid
+// beyond the table or mapped to kInvalid is absent; self-loops and
+// both-absent records drop; one-absent records count toward pst at the
+// present endpoint but never group (the reference's forever-uninserted
+// neighbors, jtree.cpp:47-49).  pst_out/parent_out as sheep_build_forest
+// (pst always recomputed here — callers with precomputed pst use the
+// two-call path).  Returns 0, or -3 when a mapped position lands at or
+// beyond n (corrupt pos table).
+int sheep_build_forest_edges(const uint32_t* tail, const uint32_t* head,
+                             int64_t m, const uint32_t* pos, int64_t pos_len,
+                             int64_t n, uint32_t* parent_out,
+                             uint32_t* pst_out, uint32_t* pre_out) {
+  if (n < 0 || m < 0) return -1;
+  PhaseTimer pt("build_forest_edges");
+  std::memset(pst_out, 0, sizeof(uint32_t) * (size_t)n);
+  for (int64_t v = 0; v < n; ++v) parent_out[v] = kInvalid;
+  if (pre_out) std::memset(pre_out, 0, sizeof(uint32_t) * (size_t)n);
+  std::vector<uint32_t> uf((size_t)n);
+  for (int64_t v = 0; v < n; ++v) uf[(size_t)v] = (uint32_t)v;
+
+  // Tight mapping pass (the only pos-gather pass; pst and the group
+  // count live in blocked_group_adopt's own read passes — a fused loop
+  // mixing extra random-access streams here was measured to starve the
+  // out-of-order window's memory-level parallelism), then the shared
+  // quantile-bucketed grouping+adoption.  pst-only links (absent
+  // neighbor, hi = kInvalid >= n) stay in the mapped arrays: the pst
+  // pass counts every link's lo, the grouping skips hi >= n.
+  std::vector<uint32_t> mlo((size_t)m), mhi((size_t)m);
+  int64_t k = 0;
+  for (int64_t i = 0; i < m; ++i) {
+    const uint32_t pt_ = tail[i] < (uint64_t)pos_len ? pos[tail[i]] : kInvalid;
+    const uint32_t ph_ = head[i] < (uint64_t)pos_len ? pos[head[i]] : kInvalid;
+    if (pt_ == ph_) continue;  // self-loop or both absent
+    const uint32_t l = pt_ < ph_ ? pt_ : ph_;
+    if (l >= (uint64_t)n) return -3;  // corrupt pos table
+    mlo[(size_t)k] = l;
+    mhi[(size_t)k] = pt_ < ph_ ? ph_ : pt_;
+    ++k;
+  }
+  pt.mark("map");
+  blocked_group_adopt(mlo.data(), mhi.data(), k, n, pst_out, uf.data(),
+                      parent_out, pre_out, pt);
   return 0;
 }
 

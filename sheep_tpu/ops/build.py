@@ -312,22 +312,30 @@ def default_handoff_factor() -> int:
     return int(os.environ.get("SHEEP_HANDOFF_FACTOR", default))
 
 
+def pack_handoff(n: int) -> bool:
+    """THE 6-byte-packing policy, shared by the serial fetch
+    (fetch_links_host) and the overlapped stream (_StreamFetcher) so
+    SHEEP_PACK_HANDOFF means ONE thing across both paths (ADVICE r05:
+    the stream used to pack on n alone, so a pack-off A/B arm with
+    overlap on still packed).  Default: pack where the fetch is
+    byte-bound (accelerator tunnel), not on cpu; packing needs n < 2^24.
+    """
+    pack = os.environ.get("SHEEP_PACK_HANDOFF", "")
+    if pack == "":
+        pack = "0" if jax.devices()[0].platform == "cpu" else "1"
+    return pack == "1" and n < (1 << 24)
+
+
 def fetch_links_host(lo, hi, live: int, n: int):
     """THE production link-fetch policy, shared with scripts/hybrid_profile
     so the profiler's d2h phase can never drift from what the hybrid
     actually does: 64K-granular cut (each distinct slice length is a fresh
     XLA program; tunneled compiles are slow), 6-byte packing where the
-    link is byte-bound (SHEEP_PACK_HANDOFF overrides; needs n < 2^24),
-    dead-sentinel filter.  Returns (lo_h, hi_h uint-safe int arrays,
-    packed: bool).
+    link is byte-bound (:func:`pack_handoff`), dead-sentinel filter.
+    Returns (lo_h, hi_h uint-safe int arrays, packed: bool).
     """
-    import os
-
     cut = min(int(lo.shape[0]), -(-live // (1 << 16)) * (1 << 16))
-    pack = os.environ.get("SHEEP_PACK_HANDOFF", "")
-    if pack == "":  # default: pack where the fetch is byte-bound (tunnel)
-        pack = "0" if jax.devices()[0].platform == "cpu" else "1"
-    packed = pack == "1" and n < (1 << 24)
+    packed = pack_handoff(n)
     if packed:
         from .forest import pack_links_6b, unpack_links_6b
         buf = np.asarray(pack_links_6b(lo[:cut], hi[:cut]))[:live]
@@ -374,7 +382,7 @@ class _StreamFetcher:
     def __init__(self, lo, hi, n: int, live: int, slice_links: int):
         self.n = n
         self.live = live
-        self.packed = n < (1 << 24)
+        self.packed = pack_handoff(n)  # ONE policy with fetch_links_host
         self.bytes_per_link = 6 if self.packed else 8
         width = int(lo.shape[0])  # pow2-padded
         # the env knob is an arbitrary int: round DOWN to a power of two
@@ -498,7 +506,7 @@ class _SpecHandoff:
 
     def __init__(self, n: int):
         self.n = n
-        self.bpl = 6 if n < (1 << 24) else 8
+        self.bpl = 6 if pack_handoff(n) else 8
         self.spec_live = int(os.environ.get(
             "SHEEP_OVERLAP_SPEC_FACTOR", "8")) * n
         self.slice_links = int(os.environ.get(
@@ -559,7 +567,12 @@ class _SpecHandoff:
                     live * self.bpl * self.MARGIN:
                 self.stats["spec_restarts"] += 1
                 self._abandon()
-                if not self.dead:
+                # restarts honor the same min_bytes floor as first
+                # starts (ADVICE r05): a late-loop restart on a tiny
+                # snapshot pays a pack dispatch and possibly a fresh
+                # slice-program compile (30-130s tunneled) to save a
+                # fetch that costs less than either
+                if not self.dead and live * self.bpl >= self.min_bytes:
                     self._start(lo, hi, live)
             return False
         if live <= self.spec_live and live * self.bpl >= self.min_bytes:
@@ -661,6 +674,11 @@ def reduce_and_fetch_links(lo, hi, n: int, stop_live: int,
     if perf is not None:
         perf["loop_s"] = round(t1 - t0, 4)
         perf["fetch_tail_s"] = round(time.perf_counter() - t1, 4)
+        # the ACTUAL handed-off link count (ADVICE r05): with
+        # speculation, a/b can be a strictly larger early snapshot plus
+        # kept partials, so `live` alone misreads the handoff volume
+        perf["handoff_links"] = int(len(lo_h))
+        perf["packed_handoff"] = pack_handoff(n)
         if spec is not None:
             perf.update(spec.stats)
     return "host", lo_h, hi_h, int(live), rounds

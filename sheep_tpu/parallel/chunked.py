@@ -253,6 +253,87 @@ def _gather_tail_enabled(override: bool | None) -> bool:
     return os.environ.get("SHEEP_MESH_GATHER_TAIL", "1") != "0"
 
 
+def _tail_shard_enabled(override: bool | None) -> bool:
+    """Round-6 sharded tail gate (SHEEP_MESH_TAIL_SHARD, default on):
+    see reduce_links_sharded — the round-5 gather-tail made the plateau
+    collective-free but REPLICATED, so W-1 chips re-derived the same
+    chain collapse; the sharded tail splits that work by vertex window
+    so per-chip tail work falls with W."""
+    import os
+    if override is not None:
+        return override
+    return os.environ.get("SHEEP_MESH_TAIL_SHARD", "1") != "0"
+
+
+@functools.partial(jax.jit, static_argnames=("n", "mesh"))
+def shard_links_by_window(lo, hi, n: int, mesh):
+    """Replicated flat links -> [W, B] sharded by CONTIGUOUS hi window.
+
+    Window boundaries are the live-count QUANTILES of the hi
+    distribution (one replicated sort; deterministic, so every worker
+    derives identical boundaries with zero communication): worker i
+    keeps the links whose hi falls in [q_i, q_{i+1}) with q_0 = 0 and
+    q_W = n, i.e. ~live/W links each.  Equal-width windows were
+    measured badly skewed on power-law graphs (70% of the live links on
+    one chip at W=8 — the plateau window concentrates in the middle of
+    the position space); value-quantiles balance up to hub ties, and a
+    single heavy hi is a STAR, which one local sort-rewrite collapses
+    anyway.  Soundness is the map-phase argument: local rounds are
+    per-subset transforms, and ANY partition of the multiset preserves
+    union threshold connectivity.  Windows are contiguous ON PURPOSE:
+    chains ascend through positions, so a contiguous window keeps each
+    chain segment whole on one worker where local rounds can collapse
+    it; a modulo shard would scatter every chain and leave the local
+    phase nothing to do.
+    """
+    w = mesh.size
+
+    def body(lo, hi):
+        i = lax.axis_index(AXIS).astype(jnp.int32)
+        sent = jnp.int32(n)
+        live = lo < sent
+        cnt = jnp.sum(live, dtype=jnp.int32)
+        sh = lax.sort(hi)  # sentinels (= n) sort last
+        lower = jnp.where(i == 0, jnp.int32(0),
+                          sh[(i * cnt) // jnp.int32(w)])
+        upper = jnp.where(i == jnp.int32(w - 1), sent,
+                          sh[((i + 1) * cnt) // jnp.int32(w)])
+        mine = live & (hi >= lower) & (hi < upper)
+        return (jnp.where(mine, lo, sent)[None, :],
+                jnp.where(mine, hi, sent)[None, :])
+
+    fn = shard_map(body, mesh=mesh, in_specs=(P(), P()),
+                   out_specs=(P(AXIS, None), P(AXIS, None)),
+                   check_vma=False)
+    return fn(lo, hi)
+
+
+@functools.partial(jax.jit, static_argnames=("n", "mesh"))
+def row_live_counts(lo, n: int, mesh):
+    """Replicated [W] vector of per-row live-link counts (the sharded
+    tail's per-chip work observability; measurement path only)."""
+    def body(lo):
+        c = jnp.sum(lo[0] != jnp.int32(n), dtype=jnp.int32)
+        return lax.all_gather(c, AXIS)
+
+    fn = shard_map(body, mesh=mesh, in_specs=(P(AXIS, None),),
+                   out_specs=P(), check_vma=False)
+    return fn(lo)
+
+
+def _tail_shard_local_rounds() -> int:
+    """Round cap for the sharded tail's local pass
+    (SHEEP_MESH_TAIL_SHARD_ROUNDS, default 5 — the chunk schedule's
+    probing prefix, where the mass dedupe/star-collapse lands): past it
+    the marginal local round retires little (the window's own straggler
+    crawl), while the replicated finish pays ~finish_live * round for
+    EVERY extra round it has to grind — the 2^18 model measured cap 13
+    costing W=2 more per-chip work than no shard at all, and cap 5
+    strictly decreasing across W=2/4/8."""
+    import os
+    return int(os.environ.get("SHEEP_MESH_TAIL_SHARD_ROUNDS", "5"))
+
+
 def _gather_tail_factor() -> float:
     """Gather when W * cols <= factor * (n+1).  Default 2.0: the gather
     moves 8 * W * cols bytes, i.e. <= 4 pmin-round payloads at the
@@ -268,7 +349,9 @@ def reduce_links_sharded(lo, hi, n: int, mesh, global_f: bool,
                          levels: int = _LEVELS, jrounds: int = _JROUNDS,
                          first_levels: int = _FIRST_LEVELS,
                          fetch=None, gather_tail: bool | None = None,
-                         comm: dict | None = None, runtime=None):
+                         tail_shard: bool | None = None,
+                         comm: dict | None = None, runtime=None,
+                         max_rounds: int | None = None):
     """Host-orchestrated chunk loop on [W, B] sharded links.
 
     ``global_f`` False = map phase (per-shard independent), True = reduce
@@ -292,18 +375,41 @@ def reduce_links_sharded(lo, hi, n: int, mesh, global_f: bool,
     through the single-chip chunk loop (ops.forest.reduce_links_hosted)
     with ZERO further collectives — executed SPMD-replicated, so every
     worker deterministically holds the identical result, and the tail
-    inherits the single-chip kit: depth-tier escalation and
-    vremap_compact, which windows the per-round jump-table work to the
-    live vertex set (the composition VERDICT item 4 asks for).
+    inherits the single-chip kit: depth-tier escalation, vremap_compact,
+    and the round-6 plateau scheduler + straggler assist.
     Soundness: the gathered multiset is exactly the union of shard link
     sets — the same global threshold connectivity — and the forest is a
     function of threshold connectivity only.  SHEEP_MESH_GATHER_TAIL=0
-    (or gather_tail=False) restores the round-4 behavior.
+    (or gather_tail=False) restores the round-4 behavior.  The gather
+    never fires before the first sharded chunk has run (round-6 fix,
+    ADVICE r05): a sparse input whose whole window already fits the
+    gather budget would otherwise bypass the mesh at round 0 and run
+    the ENTIRE reduce replicated on every worker.
+
+    **Sharded tail (round-6, VERDICT r05 item 3).**  The round-5 tail
+    was replicated: W-1 chips re-derived the identical plateau chain
+    collapse, so per-chip tail work was CONSTANT in W — the builder's
+    own scaling model capped W=8 at ~2% of north star.  With
+    SHEEP_MESH_TAIL_SHARD (default on; tail_shard overrides), the
+    gathered links are re-sharded by CONTIGUOUS hi vertex window
+    (:func:`shard_links_by_window` — chain segments stay whole on one
+    worker), each worker collapses its window's segments with LOCAL
+    rounds (zero inter-chip collectives, the map-phase machinery), and
+    only the converged per-window forests — a far smaller union whose
+    vertices hold at most one up-link per window — re-gather for the
+    replicated finish.  Per-chip tail work becomes
+    O(live/W * local_rounds) + O(union) instead of O(live * rounds),
+    strictly decreasing with W (measured columns in MESHBENCH).
 
     ``comm`` — optional dict accumulating the collective-volume model
     (per-worker logical payload bytes): sharded_global_rounds,
     pmin_payload_bytes (4(n+1) per global round), gather_payload_bytes
-    (8*W*cols at the handoff), tail_rounds (collective-free).
+    (8*W*cols summed over BOTH gathers when the tail shards),
+    tail_rounds (replicated, collective-free), plus the sharded-tail
+    observability columns: tail_shard_rounds (local window rounds),
+    tail_shard_row_live (per-chip live at the shard handoff),
+    tail_gather_live / tail_finish_live (live counts entering the
+    shard phase and the replicated finish).
 
     ``runtime`` — optional runtime.ChunkRuntime (see
     ops/forest.reduce_links_hosted): each sharded dispatch runs under the
@@ -324,27 +430,66 @@ def reduce_links_sharded(lo, hi, n: int, mesh, global_f: bool,
     chunk_i = 0
     cap = int(np.ceil(np.log2(n + 2)))
     do_gather = global_f and _gather_tail_enabled(gather_tail)
+    do_shard = _tail_shard_enabled(tail_shard) and w > 1
     gather_at = _gather_tail_factor() * (n + 1)
     if comm is not None:
         comm.setdefault("sharded_global_rounds", 0)
         comm.setdefault("pmin_payload_bytes", 0)
         comm.setdefault("gather_payload_bytes", 0)
         comm.setdefault("tail_rounds", 0)
+        comm.setdefault("tail_shard_rounds", 0)
+
+    def _finish_hosted(flat_lo, flat_hi, rounds):
+        """Replicated single-chip finish of the gathered union."""
+        from ..ops.forest import reduce_links_hosted
+        flat_lo, flat_hi, _, tail_rounds, _ = reduce_links_hosted(
+            flat_lo, flat_hi, n, levels=levels, jrounds=jrounds,
+            first_levels=first_levels, runtime=runtime)
+        if comm is not None:
+            comm["tail_rounds"] += tail_rounds
+        return flat_lo, flat_hi, rounds + tail_rounds, True
+
     while True:
         cols = int(lo.shape[1])
-        if do_gather and w * cols <= gather_at:
+        # round-0 bypass guard (chunk_i >= 1): the tail rationale only
+        # applies AFTER the mass-kill — see the docstring
+        if do_gather and chunk_i >= 1 and w * cols <= gather_at:
             flat_lo, flat_hi = gather_links_replicated(lo, hi, mesh)
             if comm is not None:
                 comm["gather_payload_bytes"] += 8 * w * cols
-            from ..ops.forest import reduce_links_hosted
-            flat_lo, flat_hi, _, tail_rounds, _ = reduce_links_hosted(
-                flat_lo, flat_hi, n, levels=levels, jrounds=jrounds,
-                first_levels=first_levels, runtime=runtime)
-            rounds += tail_rounds
+                comm["tail_gather_live"] = int(fetch(jnp.sum(
+                    flat_lo != jnp.int32(n), dtype=jnp.int32)))
+            if not do_shard:
+                return _finish_hosted(flat_lo, flat_hi, rounds)
+            # sharded tail: window the union, collapse each window's
+            # chain segments locally (zero collectives), then gather
+            # the much smaller per-window forests for the finish
+            slo, shi = shard_links_by_window(flat_lo, flat_hi, n, mesh)
             if comm is not None:
-                comm["tail_rounds"] += tail_rounds
-            return flat_lo, flat_hi, rounds, True
+                rl = [int(x) for x in fetch(row_live_counts(slo, n, mesh))]
+                comm["tail_shard_row_live"] = rl
+            # local rounds are capped: the cheap parallel work (star
+            # collapse + short segments) lands in the first ~dozen
+            # rounds; a window's long-chain crawl is exactly what the
+            # replicated finish's plateau assist resolves best, so past
+            # the cap the remaining links just move on
+            slo, shi, local_rounds, _ = reduce_links_sharded(
+                slo, shi, n, mesh, global_f=False, levels=levels,
+                jrounds=jrounds, first_levels=first_levels, fetch=fetch,
+                runtime=runtime, max_rounds=_tail_shard_local_rounds())
+            rounds += local_rounds
+            if comm is not None:
+                comm["tail_shard_rounds"] += local_rounds
+            fcols = int(slo.shape[1])
+            flat_lo, flat_hi = gather_links_replicated(slo, shi, mesh)
+            if comm is not None:
+                comm["gather_payload_bytes"] += 8 * w * fcols
+                comm["tail_finish_live"] = int(fetch(jnp.sum(
+                    flat_lo != jnp.int32(n), dtype=jnp.int32)))
+            return _finish_hosted(flat_lo, flat_hi, rounds)
         j = _SCHEDULE[chunk_i] if chunk_i < len(_SCHEDULE) else jrounds
+        if max_rounds is not None:
+            j = max(1, min(j, max_rounds - rounds))
         if global_f:
             # reduce rounds: flat base depth — the MESHBENCH rerun
             # measured the deep tier consistently 8-10% WORSE here with
@@ -371,6 +516,11 @@ def reduce_links_sharded(lo, hi, n: int, mesh, global_f: bool,
             comm["pmin_payload_bytes"] += j * 4 * (n + 1)
         moved_i, live_i = (int(x) for x in fetch(stats))  # one sync
         if moved_i == 0:
+            return lo, hi, rounds, False
+        if max_rounds is not None and rounds >= max_rounds:
+            # bounded phase (the sharded tail's local pass): the caller
+            # finishes elsewhere — returning unconverged is sound, every
+            # chunk output has the input's threshold connectivity
             return lo, hi, rounds, False
         target = _pad_pow2_cols(live_i)
         if target <= int(lo.shape[1]) // 2:
@@ -403,6 +553,7 @@ def build_links_chunked_sharded(tail_2d, head_2d, n: int, mesh,
                                 pos=None, fetch=None, timings=None,
                                 unified: bool = True,
                                 gather_tail: bool | None = None,
+                                tail_shard: bool | None = None,
                                 comm: dict | None = None, runtime=None):
     """Full chunked mesh build from staged [W, B] edge arrays.
 
@@ -410,8 +561,9 @@ def build_links_chunked_sharded(tail_2d, head_2d, n: int, mesh,
     parent [n] int32 with n marking roots.  ``timings``: optional dict
     that receives wall-clock seconds for the prep/map/reduce phases and
     the per-phase round counts (the MESHBENCH instrumentation hook).
-    ``gather_tail``/``comm``: see reduce_links_sharded (the ICI-honest
-    tail handoff and its collective-volume accounting).
+    ``gather_tail``/``tail_shard``/``comm``: see reduce_links_sharded
+    (the ICI-honest tail handoff, the round-6 per-chip tail sharding,
+    and their collective-volume accounting).
 
     ``unified`` (default): run global-f rounds from the FIRST round —
     measured 1.77x (W=2) to 2.07x (W=8) faster than the map-then-reduce
@@ -438,7 +590,8 @@ def build_links_chunked_sharded(tail_2d, head_2d, n: int, mesh,
     if unified:
         lo, hi, red_rounds, gathered = reduce_links_sharded(
             lo, hi, n, mesh, global_f=True, fetch=fetch,
-            gather_tail=gather_tail, comm=comm, runtime=runtime)
+            gather_tail=gather_tail, tail_shard=tail_shard, comm=comm,
+            runtime=runtime)
         map_rounds = 0
         t2 = t1
     else:
@@ -450,7 +603,8 @@ def build_links_chunked_sharded(tail_2d, head_2d, n: int, mesh,
         # reduce: global-f rounds stitch the partials into one forest
         lo, hi, red_rounds, gathered = reduce_links_sharded(
             lo, hi, n, mesh, global_f=True, fetch=fetch,
-            gather_tail=gather_tail, comm=comm, runtime=runtime)
+            gather_tail=gather_tail, tail_shard=tail_shard, comm=comm,
+            runtime=runtime)
     parent = _extract_parent(lo, hi, n, mesh, gathered)
     jax.block_until_ready(parent)
     t3 = _time.perf_counter()

@@ -1,0 +1,54 @@
+"""Environment capture for benchmark records (VERDICT r05 item 5).
+
+Round 5 closed with an unexplained 2.8x gap between the driver's bench
+numbers and a clean serialized rerun of the same code at 2^22 — and the
+records carried nothing that could attribute it (was the host loaded?
+pinned differently? a different backend?).  Every benchmark record now
+embeds this capture so driver-vs-clean divergences are attributable from
+the artifact alone: host load at measurement time, core count and the
+process's actual affinity mask (thread pins), cpu model, thread-count
+env pins, and the jax backend when one is already up.
+
+Deliberately import-light: no jax import (a capture must never be the
+thing that initializes a backend), /proc reads are best-effort, and any
+failure degrades to omitting the field, never to raising.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+
+def env_capture(platform: str | None = None) -> dict:
+    """One dict of host/environment facts for embedding in a record."""
+    rec: dict = {"nproc": os.cpu_count()}
+    try:
+        rec["loadavg_1m_5m_15m"] = [round(x, 2) for x in os.getloadavg()]
+    except OSError:
+        pass
+    try:
+        rec["affinity_cores"] = sorted(os.sched_getaffinity(0))
+    except (AttributeError, OSError):
+        pass
+    try:
+        with open("/proc/cpuinfo") as f:
+            for line in f:
+                if line.lower().startswith("model name"):
+                    rec["cpu_model"] = line.split(":", 1)[1].strip()
+                    break
+    except OSError:
+        pass
+    pins = {k: v for k, v in os.environ.items()
+            if k in ("OMP_NUM_THREADS", "XLA_FLAGS", "TASKSET",
+                     "GOMP_CPU_AFFINITY", "JAX_PLATFORMS")}
+    if pins:
+        rec["thread_env"] = pins
+    if platform is not None:
+        rec["backend"] = platform
+    elif "jax" in sys.modules:  # never initialize one just to report it
+        try:
+            rec["backend"] = sys.modules["jax"].devices()[0].platform
+        except Exception:
+            pass
+    return rec

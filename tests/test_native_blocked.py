@@ -1,0 +1,124 @@
+"""Cache-blocked native kernels (sheep_native.cpp, round 6): the
+quantile-bucketed grouping, the fused edges->forest entry, and the fused
+degree sequence must be bit-identical to the unblocked path and to the
+python oracle — including past the cache cliff (>= 2^21) where the
+blocked layout actually diverges in memory behavior.
+"""
+
+import numpy as np
+import pytest
+
+from conftest import random_multigraph
+
+from sheep_tpu import native
+from sheep_tpu.core import build_forest, degree_sequence
+from sheep_tpu.core.forest import build_forest_links, edges_to_positions
+from sheep_tpu.core.sequence import sequence_positions
+from sheep_tpu.utils import rmat_edges
+
+pytestmark = pytest.mark.skipif(not native.available(),
+                                reason="native runtime unavailable")
+
+
+@pytest.mark.parametrize("trial", range(8))
+def test_fused_edges_equals_two_call(trial):
+    """build_forest_edges == edges_to_links + build_forest_links on
+    random multigraphs (self-loops, duplicates, absent vids)."""
+    rng = np.random.default_rng(300 + trial)
+    tail, head = random_multigraph(rng, n_max=120, e_max=600)
+    seq = degree_sequence(tail, head)
+    # absent vids: drop a third of the sequence
+    seq = seq[: max(2, len(seq) * 2 // 3)]
+    max_vid = int(max(tail.max(), head.max()))
+    pos = sequence_positions(seq, max_vid)
+    lo, hi = native.edges_to_links(tail, head, pos)
+    p2, w2 = native.build_forest_links(lo, hi, len(seq))
+    p1, w1 = native.build_forest_edges(tail, head, pos, len(seq))
+    np.testing.assert_array_equal(p1, p2)
+    np.testing.assert_array_equal(w1, w2)
+
+
+@pytest.mark.parametrize("trial", range(6))
+def test_blocked_toggle_bit_identical_small(trial, monkeypatch):
+    rng = np.random.default_rng(400 + trial)
+    tail, head = random_multigraph(rng, n_max=100, e_max=500)
+    out = {}
+    for arm in ("1", "0"):
+        monkeypatch.setenv("SHEEP_NATIVE_BLOCKED", arm)
+        seq = degree_sequence(tail, head)
+        f = build_forest(tail, head, seq)
+        out[arm] = (seq, f.parent, f.pst_weight)
+    np.testing.assert_array_equal(out["1"][0], out["0"][0])
+    np.testing.assert_array_equal(out["1"][1], out["0"][1])
+    np.testing.assert_array_equal(out["1"][2], out["0"][2])
+
+
+def test_degree_sequence_fused_equals_two_call():
+    rng = np.random.default_rng(41)
+    tail, head = random_multigraph(rng, n_max=200, e_max=2000)
+    n = int(max(tail.max(), head.max())) + 1
+    fused = native.degree_sequence_from_edges(tail, head, n)
+    deg = native.degree_histogram(tail, head, n)
+    two_call = native.degree_sequence_from_degrees(deg)
+    assert fused is not None and two_call is not None
+    np.testing.assert_array_equal(fused, two_call)
+
+
+def test_degree_sequence_fused_out_of_range_raises():
+    with pytest.raises(ValueError):
+        native.degree_sequence_from_edges(
+            np.array([5], np.uint32), np.array([1], np.uint32), 3)
+
+
+def test_fused_edges_corrupt_pos_raises():
+    # a pos table mapping into positions >= n is corrupt: -3
+    tail = np.array([0], np.uint32)
+    head = np.array([1], np.uint32)
+    pos = np.array([7, 9], np.uint32)  # both beyond n=2
+    with pytest.raises(RuntimeError):
+        native.build_forest_edges(tail, head, pos, 2)
+
+
+def test_blocked_pst_in_respected():
+    """The precomputed-pst path must pass pst through untouched on the
+    blocked kernel too (it skips the histogram entirely)."""
+    rng = np.random.default_rng(43)
+    tail, head = random_multigraph(rng, n_max=90, e_max=400)
+    seq = degree_sequence(tail, head)
+    pos = sequence_positions(seq, int(max(tail.max(), head.max())))
+    lo, hi = native.edges_to_links(tail, head, pos)
+    pst = rng.integers(0, 100, len(seq)).astype(np.uint32)
+    p, w = native.build_forest_links(lo, hi, len(seq), pst=pst)
+    np.testing.assert_array_equal(w, pst)
+
+
+def test_blocked_vs_unblocked_past_cache_cliff(monkeypatch):
+    """2^21 (past the cliff where the blocked layout's behavior actually
+    diverges): both native arms bit-identical."""
+    log_n = 21
+    n = 1 << log_n
+    tail, head = rmat_edges(log_n, 4 * n, seed=9)
+    out = {}
+    for arm in ("1", "0"):
+        monkeypatch.setenv("SHEEP_NATIVE_BLOCKED", arm)
+        seq = degree_sequence(tail, head)
+        f = build_forest(tail, head, seq, max_vid=n - 1)
+        out[arm] = (seq, f.parent, f.pst_weight)
+    np.testing.assert_array_equal(out["1"][0], out["0"][0])
+    np.testing.assert_array_equal(out["1"][1], out["0"][1])
+    np.testing.assert_array_equal(out["1"][2], out["0"][2])
+
+
+@pytest.mark.slow
+def test_native_vs_python_past_cache_cliff():
+    """Native (blocked) vs the python oracle at 2^21, bit-identical —
+    slow: the python union-find walks ~8.4M links in the interpreter."""
+    log_n = 21
+    n = 1 << log_n
+    tail, head = rmat_edges(log_n, 4 * n, seed=9)
+    seq = degree_sequence(tail, head)
+    f_native = build_forest(tail, head, seq, max_vid=n - 1, impl="native")
+    lo, hi = edges_to_positions(tail, head, seq, n - 1)
+    f_python = build_forest_links(lo, hi, len(seq), impl="python")
+    np.testing.assert_array_equal(f_native.parent, f_python.parent)
+    np.testing.assert_array_equal(f_native.pst_weight, f_python.pst_weight)

@@ -229,10 +229,19 @@ def test_spec_handoff_restart_policy():
     sp = _SpecHandoff(n)
     started = []
     sp._start = lambda lo, hi, live: started.append(live)  # type: ignore
-    # active fetch with a huge remainder vs a small current snapshot
+    # active fetch with a huge remainder vs a still-large current
+    # snapshot (above the min_bytes floor): abandon AND restart
+    big_live = 2 * sp.min_bytes // sp.bpl
+    sp.active = FakeFetcher(remaining=100 * sp.min_bytes)
+    assert sp.on_chunk(None, None, big_live) is False
+    assert sp.stats["spec_restarts"] == 1 and started == [big_live]
+    # huge remainder vs a TINY snapshot: abandon, but the restart honors
+    # the same min_bytes floor as first starts (ADVICE r05 — a restart
+    # on a tiny snapshot pays a pack dispatch / fresh compile for less
+    # than it saves)
     sp.active = FakeFetcher(remaining=10_000_000)
     assert sp.on_chunk(None, None, 1000) is False
-    assert sp.stats["spec_restarts"] == 1 and started == [1000]
+    assert sp.stats["spec_restarts"] == 2 and started == [big_live]
     # finished fetch stops the loop
     sp.active = FakeFetcher(remaining=0)
     assert sp.on_chunk(None, None, 500) is True

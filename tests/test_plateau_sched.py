@@ -1,0 +1,237 @@
+"""Plateau-adaptive round scheduler (ops/forest.py, round 6): detection
+boundaries, the host straggler assist's walk, and oracle exactness.
+
+The scheduler consumes the per-chunk (moved, live) stats the hosted loop
+already fetches; once the live count plateaus it runs bounded host
+assists that walk straggler f-chains sequentially (the crawl the device
+rounds spend ~80 of 90 rounds on at 2^22).  Every transform the assist
+applies is the module's own bounded pointer jump, so parents must stay
+bit-identical to the oracle under any detection/assist schedule — which
+is what these tests pin, alongside each detection boundary.
+"""
+
+import numpy as np
+import pytest
+
+from conftest import random_multigraph
+
+from sheep_tpu.core import build_forest, degree_sequence
+from sheep_tpu.ops.forest import (_PlateauSched, min_up_table,
+                                  plateau_assist_walk)
+
+
+def test_detector_live_ratio_boundary():
+    """Plateau flips on when live drops < 5% per chunk — exactly at the
+    RATIO boundary, strictly-greater comparison."""
+    p = _PlateauSched()
+    p.enabled = True
+    p.on = False
+    p.observe(moved=10**6, live=1000)  # first observation: baseline only
+    assert not p.on
+    # live == RATIO * prev exactly: NOT a plateau (strict >)
+    p.observe(moved=10**6, live=int(1000 * _PlateauSched.RATIO))
+    assert not p.on
+    p2 = _PlateauSched()
+    p2.enabled = True
+    p2.on = False
+    p2.observe(moved=10**6, live=1000)
+    p2.observe(moved=10**6, live=int(1000 * _PlateauSched.RATIO) + 1)
+    assert p2.on
+
+
+def test_detector_moved_fraction_boundary():
+    """Plateau also flips on when movers are <= live/MOVED_FRAC."""
+    p = _PlateauSched()
+    p.enabled = True
+    p.on = False
+    frac = _PlateauSched.MOVED_FRAC
+    p.observe(moved=1000 // frac + 1, live=1000)  # just above: no flip
+    assert not p.on
+    p.observe(moved=1000 // frac, live=1000)  # at the boundary: flips
+    assert p.on
+    # sticky: a later fast-moving chunk does not un-flip it
+    p.observe(moved=10**6, live=10**6)
+    assert p.on
+
+
+def test_detector_zero_moved_never_flips_moved_rule():
+    p = _PlateauSched()
+    p.enabled = True
+    p.on = False
+    p.observe(moved=0, live=1000)  # moved == 0 is convergence, not plateau
+    assert not p.on
+
+
+def test_detector_disabled_never_flips():
+    p = _PlateauSched()
+    p.enabled = False
+    p.observe(moved=1, live=1000)
+    p.observe(moved=1, live=1000)
+    assert not p.on
+    assert not p.wants_assist(1)
+
+
+def test_wants_assist_cap_and_bail_backoff():
+    p = _PlateauSched()
+    p.enabled = True
+    p.on = True
+    assert p.wants_assist(p.cap)
+    assert not p.wants_assist(p.cap + 1)
+    assert not p.wants_assist(0)
+    # a capped bail defers retries until movers clearly decayed
+    p.bail = 1000
+    assert not p.wants_assist(501)
+    assert p.wants_assist(500)
+
+
+def _walk(links, n, cap=None):
+    l = np.array([a for a, _ in links], dtype=np.int64)
+    h = np.array([b for _, b in links], dtype=np.int64)
+    f = np.full(n + 1, n, np.int64)
+    np.minimum.at(f, l, h)
+    walks, passes, strag = plateau_assist_walk(l, h, f, n, cap=cap)
+    return l, h, walks, passes, strag
+
+
+def test_walk_no_stragglers_noop():
+    # a functional forest: every link already has hi == f(lo)
+    l, h, walks, passes, strag = _walk([(0, 1), (1, 2), (2, 3)], 4)
+    assert walks == 0 and strag == 0
+    assert list(l) == [0, 1, 2]
+
+
+def test_walk_single_straggler_advances_through_chain():
+    # chain 0->1->2->3 plus straggler (0, 3): lo must land on 2
+    l, h, walks, passes, strag = _walk([(0, 1), (1, 2), (2, 3), (0, 3)], 4)
+    assert strag == 1 and walks >= 1
+    assert l[3] == 2  # advanced to the maximal f-ancestor below hi
+    assert list(l[:3]) == [0, 1, 2]
+
+
+def test_walk_cascade_materializes_chain_steps():
+    """The braid: (0,2) settles and materializes f[1] = 2, which lets
+    (1,3) advance to 2 and materialize f[2] = 3 — the sequential cascade
+    one invocation must drive to fixpoint."""
+    links = [(0, 1), (0, 2), (1, 3)]
+    # f = {0:1, 1:3}; stragglers: (0,2) (f[0]=1<2) and (1,3) settled?
+    l, h, walks, passes, strag = _walk(links, 4)
+    # fixpoint: every link has hi == f_final(lo)
+    f = np.full(5, 4, np.int64)
+    np.minimum.at(f, l, h)
+    assert all(f[l[i]] <= h[i] for i in range(len(l)))
+    # (0,2) advanced to (1,2)
+    assert l[1] == 1 and h[1] == 2
+
+
+def test_walk_cap_bails_untouched():
+    links = [(0, 3), (1, 3), (0, 2), (1, 2), (0, 1)]
+    l_before = [a for a, _ in links]
+    l, h, walks, passes, strag = _walk(links, 4, cap=1)
+    if strag > 1:  # bailed: nothing moved
+        assert walks == 0
+        assert list(l) == l_before
+
+
+def test_walk_sentinels_ignored():
+    n = 4
+    l = np.array([0, n, n], dtype=np.int64)
+    h = np.array([1, n, n], dtype=np.int64)
+    f = np.full(n + 1, n, np.int64)
+    np.minimum.at(f, l[l < n], h[l < n])
+    walks, passes, strag = plateau_assist_walk(l, h, f, n)
+    assert strag == 0
+    assert list(l) == [0, n, n]
+
+
+def test_min_up_table_matches_numpy():
+    rng = np.random.default_rng(5)
+    n = 50
+    lo = rng.integers(0, n, 200)
+    hi = lo + rng.integers(1, 5, 200)
+    hi = np.minimum(hi, n)
+    dead = hi >= n
+    lo = np.where(dead, n, lo).astype(np.int32)
+    hi = np.where(dead, n, hi).astype(np.int32)
+    got = np.asarray(min_up_table(lo, hi, n))
+    want = np.full(n + 1, n, np.int64)
+    np.minimum.at(want, lo.astype(np.int64), hi.astype(np.int64))
+    np.testing.assert_array_equal(got.astype(np.int64), want)
+
+
+def _device_parent(tail, head, n):
+    import jax.numpy as jnp
+    from sheep_tpu.ops.build import prepare_links
+    from sheep_tpu.ops.forest import forest_fixpoint_hosted
+
+    seq, pos, m, lo, hi, pst = prepare_links(
+        jnp.asarray(tail, jnp.int32), jnp.asarray(head, jnp.int32), n)
+    parent, rounds = forest_fixpoint_hosted(lo, hi, n)
+    return np.asarray(parent), int(m), rounds
+
+
+@pytest.mark.parametrize("trial", range(6))
+def test_forced_assist_oracle_exact(trial, monkeypatch):
+    """SHEEP_PLATEAU_FORCE puts the scheduler in plateau mode from round
+    one, so the assist machinery runs on inputs too small to plateau
+    naturally — parents must stay bit-identical to the oracle."""
+    monkeypatch.setenv("SHEEP_PLATEAU_FORCE", "1")
+    monkeypatch.setenv("SHEEP_PLATEAU_ADAPT", "1")
+    rng = np.random.default_rng(4200 + trial)
+    tail, head = random_multigraph(rng, n_max=300, e_max=2000)
+    n = int(max(tail.max(), head.max())) + 1
+    parent, m, _ = _device_parent(tail, head, n)
+    want_seq = degree_sequence(tail, head)
+    want = build_forest(tail, head, want_seq, max_vid=n - 1)
+    got = parent[:m].astype(np.int64)
+    wantp = np.where(want.parent == 0xFFFFFFFF, n,
+                     want.parent.astype(np.int64))
+    np.testing.assert_array_equal(got, wantp)
+
+
+def test_forced_assist_tiny_cap_oracle_exact(monkeypatch):
+    """A cap of 1 makes nearly every assist bail — the loop must fall
+    back to plain deep rounds and still converge exactly."""
+    monkeypatch.setenv("SHEEP_PLATEAU_FORCE", "1")
+    monkeypatch.setenv("SHEEP_PLATEAU_ASSIST_CAP", "1")
+    rng = np.random.default_rng(77)
+    tail, head = random_multigraph(rng, n_max=200, e_max=1500)
+    n = int(max(tail.max(), head.max())) + 1
+    parent, m, _ = _device_parent(tail, head, n)
+    want = build_forest(tail, head, degree_sequence(tail, head),
+                        max_vid=n - 1)
+    got = parent[:m].astype(np.int64)
+    wantp = np.where(want.parent == 0xFFFFFFFF, n,
+                     want.parent.astype(np.int64))
+    np.testing.assert_array_equal(got, wantp)
+
+
+def test_adapt_off_matches_on(monkeypatch):
+    """The knob changes the schedule, never the answer."""
+    rng = np.random.default_rng(91)
+    tail, head = random_multigraph(rng, n_max=400, e_max=3000)
+    n = int(max(tail.max(), head.max())) + 1
+    monkeypatch.setenv("SHEEP_PLATEAU_ADAPT", "0")
+    off, m_off, r_off = _device_parent(tail, head, n)
+    monkeypatch.setenv("SHEEP_PLATEAU_ADAPT", "1")
+    monkeypatch.setenv("SHEEP_PLATEAU_FORCE", "1")
+    on, m_on, r_on = _device_parent(tail, head, n)
+    assert m_off == m_on
+    np.testing.assert_array_equal(off, on)
+
+
+@pytest.mark.slow
+def test_natural_plateau_cuts_rounds_at_2_18(monkeypatch):
+    """At 2^18 the plateau fires naturally; the scheduler must converge
+    in fewer rounds than the round-5 schedule, oracle-exact."""
+    from sheep_tpu.utils import rmat_edges
+
+    log_n = 18
+    n = 1 << log_n
+    tail, head = rmat_edges(log_n, 4 * n, seed=3)
+    monkeypatch.setenv("SHEEP_PLATEAU_ADAPT", "0")
+    off, m, r_off = _device_parent(tail, head, n)
+    monkeypatch.setenv("SHEEP_PLATEAU_ADAPT", "1")
+    on, m2, r_on = _device_parent(tail, head, n)
+    assert m == m2
+    np.testing.assert_array_equal(off, on)
+    assert int(r_on) < int(r_off), (r_on, r_off)

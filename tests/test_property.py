@@ -4,6 +4,12 @@ partition of the edges, and the partitioner/evaluator invariants hold.
 """
 
 import numpy as np
+import pytest
+
+# a container without hypothesis must skip cleanly, not error collection
+# (the tier-1 gate runs with --continue-on-collection-errors, but an
+# error still fails pytest's exit code where a skip does not)
+pytest.importorskip("hypothesis")
 from hypothesis import assume, given, settings, strategies as st
 
 from sheep_tpu import INVALID_PART, native

@@ -9,6 +9,14 @@
 # Graphs default to the bundled hep-th; override with
 #   SHEEP_BENCH_GRAPHS="path1.dat path2.dat ..."
 #   SHEEP_BENCH_WORKERS="1 2 4 6 8"
+#
+# Liveness (ROADMAP follow-up, ISSUE 5): every trial's shell workers beat
+# heartbeat files under SHEEP_HEARTBEAT_DIR (scripts/*-worker.sh already
+# honor it; default ${RDIR}/heartbeats, SHEEP_HEARTBEAT_DIR='' disables),
+# so a wedged multi-hour sweep is diagnosable from another terminal —
+# `ls -l --time-style=+%s $RDIR/heartbeats` tells dead from slow — with
+# the same mtime protocol the tournament supervisor reads.  The dir is
+# cleared between trials: a stale beat must never vouch for a new run.
 
 TRUE=0
 FALSE=1
@@ -42,6 +50,11 @@ WORKER_LIST=( ${SHEEP_BENCH_WORKERS:-1 2 4 6} )
 if [ $MAKE_DATA -eq $TRUE ]; then
   mkdir -p $RDIR
 
+  # heartbeat wiring: default on, under the runtimes dir; opt out with
+  # SHEEP_HEARTBEAT_DIR='' (set-but-empty)
+  SHEEP_HEARTBEAT_DIR=${SHEEP_HEARTBEAT_DIR-${RDIR}/heartbeats}
+  export SHEEP_HEARTBEAT_DIR
+
   for G in ${GRAPHS[@]}; do
     NAME=$(basename $G .dat)
     RAW="${RDIR}/${NAME}.raw"
@@ -49,6 +62,10 @@ if [ $MAKE_DATA -eq $TRUE ]; then
 
     for WORKERS in ${WORKER_LIST[@]}; do
       for i in $(seq 1 $TRIALS); do
+        if [ -n "$SHEEP_HEARTBEAT_DIR" ]; then
+          rm -rf "$SHEEP_HEARTBEAT_DIR"
+          mkdir -p "$SHEEP_HEARTBEAT_DIR"
+        fi
         echo "Starting with $WORKERS workers..." | tee -a $RAW
         scripts/dist-partition.sh $VERTICAL $MPI_SORT $MPI_REDUCE $CORES -w $WORKERS $G 0 | tee -a $RAW
         echo | tee -a $RAW

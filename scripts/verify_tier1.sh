@@ -90,6 +90,68 @@ then
 fi
 # -------------------------------------------------------------------------
 
+# --- resource-exhaustion smoke (budgets + I/O faults, ISSUE 5) -----------
+# One enospc-at-checkpoint abort + resume on the chunked build, and one
+# short-write-at-publish through the supervised tournament; both must end
+# bit-identical to their fault-free runs with nothing torn published.
+# Seconds of work; a regression in the exhaustion/recovery paths fails
+# the gate before pytest even runs.
+if ! env JAX_PLATFORMS=cpu python - <<'EOF'
+import os, tempfile
+import numpy as np
+from sheep_tpu.core.forest import build_forest
+from sheep_tpu.core.sequence import degree_sequence
+from sheep_tpu.io import faultfs
+from sheep_tpu.io.edges import write_net
+from sheep_tpu.resources import DiskExhausted
+from sheep_tpu.runtime import RuntimeConfig, build_graph_resilient
+from sheep_tpu.supervisor import InlineRunner, SupervisorConfig, run_supervised
+from sheep_tpu.utils.synth import rmat_edges
+
+# enospc at the second checkpoint write: typed abort, exact resume
+tail, head = rmat_edges(9, 4 << 9, seed=11)
+want = build_forest(tail, head, degree_sequence(tail, head))
+d = tempfile.mkdtemp()
+faultfs.install_plan(faultfs.parse_io_fault_plan("enospc@ckpt:1"))
+try:
+    build_graph_resilient(tail, head, config=RuntimeConfig(
+        checkpoint_dir=d, ladder=("single", "host", "spill")))
+    raise SystemExit("ENOSPC SMOKE: expected a DiskExhausted abort")
+except DiskExhausted:
+    pass
+faultfs.clear_plan()
+_, forest = build_graph_resilient(tail, head, config=RuntimeConfig(
+    checkpoint_dir=d, resume=True, ladder=("single", "host", "spill")))
+np.testing.assert_array_equal(forest.parent, want.parent)
+
+# short write at a publish site of the supervised tournament: the torn
+# prefix never publishes, the retried run is bit-identical
+s = tempfile.mkdtemp()
+t2, h2 = rmat_edges(6, 4 << 6, seed=5)
+graph = s + "/g.net"
+write_net(graph, t2, h2)
+
+def run(name, plan=None):
+    if plan:
+        faultfs.install_plan(faultfs.parse_io_fault_plan(plan))
+    cfg = SupervisorConfig(workers=2, poll_s=0.01, backoff_base_s=0.0,
+                           grammar=False)
+    m = run_supervised(graph, f"{s}/{name}", cfg, runner=InlineRunner(0.05))
+    faultfs.clear_plan()
+    with open(m.final_tree, "rb") as f:
+        return f.read()
+
+base = run("base")
+hurt = run("hurt", plan="short@tre:0,enospc@sidecar:1")
+assert hurt == base, "short-write run diverged from the fault-free tree"
+EOF
+then
+  echo "RESOURCE SMOKE FAILED: exhaustion recovery did not reproduce the" \
+       "fault-free tree" >&2
+  exit 1
+fi
+# -------------------------------------------------------------------------
+
 # --- plateau + tail-shard smoke (reduce core, ISSUE 4) -------------------
 # One forced-assist device build and one sharded-tail mesh build on a
 # small R-MAT, both asserted bit-identical to the oracle.  Seconds of

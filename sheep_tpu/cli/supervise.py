@@ -12,21 +12,26 @@ corrupted artifacts, its own death — rerun with the same -d to resume):
 scripts/horizontal-dist.sh delegates to this under dist-partition.sh -S.
 
 Options:
-  -d DIR   state dir: manifest + intermediate artifacts + worker logs
-           (default: <graph>.supervisor).  Rerunning with the same dir
-           fscks the surviving artifacts and re-dispatches only the
-           dirty/missing legs.
-  -w N     tournament width (map workers; default SHEEP_WORKERS or 2)
-  -r N     tournament fan-in (default REDUCTION or 2)
-  -s SEQ   existing sequence file to build over (skip the sort phase)
-  -o OUT   final tree path (default <state-dir>/<base>.tre)
-  -t SEC   heartbeat deadline (default SHEEP_DEADLINE_S or 30)
-  -v       echo the event trace as it happens
+  -d DIR     state dir: manifest + intermediate artifacts + worker logs
+             (default: <graph>.supervisor).  Rerunning with the same dir
+             fscks the surviving artifacts and re-dispatches only the
+             dirty/missing legs.
+  -w N       tournament width (map workers; default SHEEP_WORKERS or 2)
+  -r N       tournament fan-in (default REDUCTION or 2)
+  -s SEQ     existing sequence file to build over (skip the sort phase)
+  -o OUT     final tree path (default <state-dir>/<base>.tre)
+  -t SEC     heartbeat deadline (default SHEEP_DEADLINE_S or 30)
+  -v         echo the event trace as it happens
+  --status   read-only operator report of the state dir (leg states,
+             dispatch counts, heartbeat ages, disk/mem budget headroom —
+             supervisor/status.py) instead of running anything
 
 Exit codes: 0 tournament complete, 1 failure (budget spent / bad state
 dir), 2 usage error.  SHEEP_FAULT_PLAN (see supervisor/chaos.py) injects
-deterministic faults — operators can rehearse a recovery before trusting
-a multi-hour run to it.
+deterministic faults, SHEEP_IO_FAULT_PLAN (io/faultfs.py) injects
+ENOSPC/EIO/short/slow at any write site, and SHEEP_MEM_BUDGET /
+SHEEP_DISK_BUDGET / SHEEP_LEG_CORES bound what a run may consume —
+operators can rehearse a recovery before trusting a multi-hour run to it.
 """
 
 from __future__ import annotations
@@ -39,13 +44,13 @@ from ..supervisor import (SupervisionFailed, SupervisorConfig,
                           SupervisorKilled, run_supervised)
 
 USAGE = ("USAGE: supervise graph [-d state_dir] [-w workers] [-r reduction]"
-         " [-s seq_file] [-o out_tree] [-t deadline_s] [-v]")
+         " [-s seq_file] [-o out_tree] [-t deadline_s] [-v] [--status]")
 
 
 def main(argv: list[str] | None = None) -> int:
     argv = sys.argv[1:] if argv is None else argv
     try:
-        opts, args = getopt.gnu_getopt(argv, "d:w:r:s:o:t:v")
+        opts, args = getopt.gnu_getopt(argv, "d:w:r:s:o:t:v", ["status"])
     except getopt.GetoptError as exc:
         print(f"Unknown option character '{(exc.opt or '?')[:1]}'.")
         return 2
@@ -54,6 +59,7 @@ def main(argv: list[str] | None = None) -> int:
     seq_file = None
     out_file = None
     verbose = False
+    status = False
     overrides: dict = {}
     for o, a in opts:
         if o == "-d":
@@ -70,6 +76,19 @@ def main(argv: list[str] | None = None) -> int:
             overrides["deadline_s"] = float(a)
         elif o == "-v":
             verbose = True
+        elif o == "--status":
+            status = True
+
+    if status:
+        # --status needs a state dir: given directly, or derived from the
+        # graph argument the way a run would derive it
+        if state_dir is None and len(args) == 1:
+            state_dir = args[0] + ".supervisor"
+        if state_dir is None:
+            print(USAGE)
+            return 2
+        from ..supervisor.status import main_status
+        return main_status(state_dir)
 
     if len(args) != 1:
         print(USAGE)

@@ -17,11 +17,17 @@ dependency is gated, not required.  ``sig`` ties an artifact to the build
 input that produced it (runtime.snapshot.input_signature); merge_trees
 refuses to zip trees whose signatures disagree.
 
-Writer contract: the artifact is renamed into place FIRST, then the
-sidecar.  A crash in between leaves an artifact without (or with a stale)
-sidecar; "repair" treats a mismatched pair as corrupt and a missing
-sidecar as unverified — never as silently fine when a sidecar says
-otherwise.
+Writer contract (ISSUE 5 hardened): the sidecar lands FIRST, then the
+artifact — the same ordering as every other publish in the system
+(scripts/lib.sh ``sheep_mv_artifact``, the supervisor's publish).  Both
+renames are atomic and the sidecar rename happens via
+``atomic_write``'s ``pre_publish`` seam, so ANY write failure — crash,
+ENOSPC, injected fault (io/faultfs.py) — aborts with the previous
+(artifact, sidecar) pair intact: a new artifact can never appear under
+its final name without the checksum that vouches for it.  The remaining
+crash window (new sidecar + old artifact) reads as a mismatch; "repair"
+treats a mismatched pair as corrupt and a missing sidecar as unverified
+— never as silently fine when a sidecar says otherwise.
 
 Policy modes (env ``SHEEP_INTEGRITY``, default "strict"):
 
@@ -95,12 +101,15 @@ def sidecar_path(path: str) -> str:
 
 def write_sidecar(path: str, crc: int | None = None, size: int | None = None,
                   algo: str = DEFAULT_ALGO,
-                  extra: dict | None = None) -> str:
+                  extra: dict | None = None,
+                  data_path: str | None = None) -> str:
     """Write ``path``'s sidecar.  With crc/size None the artifact is read
-    back and summed (the npz writer seeks, so its bytes cannot be teed)."""
+    back and summed (the npz writer seeks, so its bytes cannot be teed);
+    ``data_path`` reads the bytes from a different file — the sealed
+    temp, for writers that sum before publishing (:func:`sealed_write`)."""
     if crc is None or size is None:
         crc, size = 0, 0
-        with open(path, "rb") as f:
+        with open(data_path or path, "rb") as f:
             while True:
                 block = f.read(1 << 24)
                 if not block:
@@ -242,17 +251,43 @@ class _CrcTee:
 
 @contextlib.contextmanager
 def checksummed_write(path: str, mode: str = "wb",
-                      extra: dict | None = None):
-    """:func:`io.atomic.atomic_write` + a sidecar sealed after the rename.
+                      extra: dict | None = None,
+                      expect_bytes: int | None = None):
+    """:func:`io.atomic.atomic_write` + a sidecar sealed sidecar-first.
 
-    The artifact lands first, the sidecar second (module docstring).  On an
-    exception neither appears and the previous (artifact, sidecar) pair is
-    untouched.  A kill BETWEEN the two renames leaves the new artifact with
-    the old sidecar — a mismatch, which strict mode rejects and repair mode
-    treats as corrupt: the failure is loud, never silently wrong.
+    The sidecar lands first (via the ``pre_publish`` seam, after the
+    artifact's bytes are durable at the temp name), the artifact second
+    (module docstring).  On any exception — including mid-write
+    ENOSPC/EIO, real or injected — neither appears and the previous
+    (artifact, sidecar) pair is untouched.  ``expect_bytes`` enables the
+    disk preflight (io/atomic.py).
     """
     from ..io.atomic import atomic_write
-    with atomic_write(path, mode) as f:
+    tee_box: list = []
+
+    def seal(tmp: str) -> None:
+        tee = tee_box[0]
+        write_sidecar(path, tee.crc, tee.size, extra=extra)
+
+    with atomic_write(path, mode, expect_bytes=expect_bytes,
+                      pre_publish=seal) as f:
         tee = _CrcTee(f, text=(mode == "w"))
+        tee_box.append(tee)
         yield tee
-    write_sidecar(path, tee.crc, tee.size, extra=extra)
+
+
+@contextlib.contextmanager
+def sealed_write(path: str, mode: str = "wb", extra: dict | None = None,
+                 expect_bytes: int | None = None):
+    """:func:`checksummed_write` for SEEKING writers (the npz snapshot):
+    the bytes cannot be teed, so the fsync'd temp file is read back for
+    the checksum — then the sidecar lands first and the artifact renames
+    second, same invariant as every other writer."""
+    from ..io.atomic import atomic_write
+
+    def seal(tmp: str) -> None:
+        write_sidecar(path, extra=extra, data_path=tmp)
+
+    with atomic_write(path, mode, expect_bytes=expect_bytes,
+                      pre_publish=seal) as f:
+        yield f

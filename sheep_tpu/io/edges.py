@@ -404,17 +404,20 @@ def write_dat(path: str, tail: np.ndarray, head: np.ndarray) -> None:
     # Crash-safe like every writer in this package (io/atomic.py): the
     # per-part edge files feed the next pipeline stage through a polling
     # filesystem handoff, so a torn record prefix must be impossible.
-    # checksummed_write additionally seals a .sum sidecar next to it.
+    # checksummed_write additionally seals a .sum sidecar next to it and
+    # (ISSUE 5) preflights the disk with the exact record size.
     rec = np.empty(len(tail), dtype=_XS1_DTYPE)
     rec["tail"] = tail
     rec["head"] = head
     rec["weight"] = 1.0
-    with checksummed_write(path, "wb") as f:
+    with checksummed_write(path, "wb", expect_bytes=rec.nbytes) as f:
         f.write(rec.tobytes())
 
 
 def write_net(path: str, tail: np.ndarray, head: np.ndarray) -> None:
-    with checksummed_write(path, "w") as f:
+    # preflight at the uint32 text ceiling (two 10-digit vids + sep/NL)
+    with checksummed_write(path, "w",
+                           expect_bytes=22 * len(tail)) as f:
         for x, y in zip(tail.tolist(), head.tolist()):
             f.write(f"{x} {y}\n")
 

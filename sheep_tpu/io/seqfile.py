@@ -28,13 +28,18 @@ _TEXT_BYTES = frozenset(b"0123456789 \t\r\n")
 def write_sequence(seq: np.ndarray, path: str, binary: bool = False) -> None:
     # Crash-safe (see io/atomic.py): downstream workers poll for the .seq
     # file and must never read a truncated sequence as a complete one.
+    # Exhaustion-aware (ISSUE 5): the size estimate preflights the disk —
+    # a refusal is a typed DiskExhausted before any bytes land (text rows
+    # are priced at the uint32 ceiling of 11 bytes/line).
     seq = np.asarray(seq, dtype=np.uint32)
     if binary:
-        with checksummed_write(path, "wb") as f:
+        with checksummed_write(path, "wb",
+                               expect_bytes=8 + 4 * len(seq)) as f:
             f.write(np.uint64(len(seq)).tobytes())
             f.write(seq.astype("<u4").tobytes())
     else:
-        with checksummed_write(path, "w") as f:
+        with checksummed_write(path, "w",
+                               expect_bytes=11 * len(seq)) as f:
             f.write("\n".join(map(str, seq.tolist())))
             if len(seq):
                 f.write("\n")

@@ -37,8 +37,10 @@ def write_tree(path: str, parent: np.ndarray, pst_weight: np.ndarray,
     # Crash-safe: the shell pipeline polls for .tre files appearing on a
     # shared filesystem (scripts/lib.sh sheep_wait_for), so a consumer
     # must never observe a torn header/record prefix from a killed writer.
+    # Exhaustion-aware (ISSUE 5): the exact size preflights the disk.
     extra = {"sig": sig} if sig else None
-    with checksummed_write(path, "wb", extra=extra) as f:
+    with checksummed_write(path, "wb", extra=extra,
+                           expect_bytes=4 + rec.nbytes) as f:
         f.write(np.uint32(len(parent)).tobytes())
         f.write(rec.tobytes())
 

@@ -906,6 +906,10 @@ def reduce_links_hosted(lo, hi, n: int, stop_live: int = 0,
             lv = min(levels + 6, cap)
             if plate.assisted:
                 j = 1
+        if runtime is not None:
+            # memory budget (ISSUE 5): the jump tables are the loop's
+            # dominant O(n) allocation — cap the depth to the headroom
+            lv = runtime.cap_levels(lv, n_cur)
         if runtime is None:
             nlo, nhi, stats = fixpoint_chunk(lo, hi, n_cur, lv, j)
         else:

@@ -502,6 +502,9 @@ def reduce_links_sharded(lo, hi, n: int, mesh, global_f: bool,
             lv = _depth_tier(cols, cols0,
                              chunk_i < len(_SCHEDULE),
                              levels, first_levels, cap)
+        if runtime is not None:
+            # memory budget (ISSUE 5): jump-table depth tracks headroom
+            lv = runtime.cap_levels(lv, n)
         if runtime is None:
             lo, hi, stats = chunk_sharded(lo, hi, n, mesh, lv, j, global_f)
         else:

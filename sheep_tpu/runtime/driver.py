@@ -45,6 +45,8 @@ from ..core.forest import Forest, build_forest_links, edges_to_positions
 from ..core.sequence import degree_sequence
 from ..integrity.errors import IntegrityError
 from ..integrity.sidecar import resolve_policy
+from ..resources.errors import MemoryBudgetExceeded, ResourceError
+from ..resources.governor import ResourceGovernor
 from .faults import (RetryBudgetExhausted, fault_point, is_retryable,
                      reset_counters)
 from .retry import RetryPolicy, run_with_retry
@@ -78,8 +80,16 @@ class RuntimeConfig:
     #: discarded and the build restarts fresh — never resumed into garbage.
     integrity: str | None = None
     #: degradation ladder, tried in order.  "mesh" is skipped when fewer
-    #: than two devices are visible; "host" cannot fail (pure numpy).
-    ladder: tuple[str, ...] = ("mesh", "single", "host")
+    #: than two devices are visible; "host" is the exact numpy/native
+    #: union-find; "spill" (ISSUE 5) is the memory FLOOR below it — the
+    #: links table lives in a memory-mapped scratch file and folds through
+    #: the union-find in bounded blocks, O(n + block) resident.
+    ladder: tuple[str, ...] = ("mesh", "single", "host", "spill")
+    #: resource budgets (SHEEP_MEM_BUDGET / SHEEP_DISK_BUDGET); None =
+    #: build one from the environment.  The governor routes the ladder
+    #: around rungs whose estimated peak cannot fit, shrinks chunk work
+    #: under measured-RSS pressure, and prices checkpoint writes.
+    governor: ResourceGovernor | None = None
     #: observable trace of what the runtime did: ("retry", site, attempt,
     #: j), ("checkpoint", rung, boundary), ("degrade", rung, next, why),
     #: ("resume", rung, boundary, rounds).  Tests and the CLI -v path
@@ -125,10 +135,14 @@ class ChunkRuntime:
     def __init__(self, policy: RetryPolicy, checkpointer: Checkpointer | None,
                  events: list, rung: str, n: int, seq: np.ndarray,
                  pst: np.ndarray, input_sig: str, rounds_base: int = 0,
-                 promote_after: int = 0):
+                 promote_after: int = 0,
+                 governor: ResourceGovernor | None = None):
         self.policy = policy
         self.ckpt = checkpointer
         self.events = events
+        #: resource budgets: None = unbudgeted (every check is a no-op)
+        self.governor = governor
+        self._last_levels_cap: int | None = None
         self.rung = rung
         self.n = n
         self.seq = seq
@@ -148,10 +162,32 @@ class ChunkRuntime:
         self._clock = time.perf_counter
         self._last_boundary_t = self._clock()
 
+    def cap_levels(self, levels: int, n: int) -> int:
+        """Memory-budget cap on the lifting depth (the jump tables are
+        the chunk loop's dominant O(n) allocation): under a configured
+        ``SHEEP_MEM_BUDGET`` the depth shrinks so the tables fit the
+        CURRENT headroom (governor.shrunk_levels).  Unbudgeted: identity.
+        The chunk drivers call this at every lv decision, so the cap
+        tracks pressure as the build's resident set grows and shrinks."""
+        if self.governor is None:
+            return levels
+        lv = self.governor.shrunk_levels(levels, n)
+        if lv != levels and lv != self._last_levels_cap:
+            self._last_levels_cap = lv
+            self.events.append(("mem-levels", self.rung, lv))
+        return lv
+
     def dispatch(self, site: str, fn, j: int | None = None):
         """Run dispatch ``fn(j)`` under the retry policy (or, once
         promoted, the bare pipelined path).  Returns (outputs, j_used) —
-        ``j_used`` may have shrunk."""
+        ``j_used`` may have shrunk (a retry after a fault, or the memory
+        governor trimming chunk size under RSS pressure: a smaller j
+        reaches the next compaction/boundary sooner, which is when the
+        live set — and the resident set with it — shrinks)."""
+        if self.governor is not None and j is not None and j > 1 \
+                and self.governor.mem_pressure():
+            j = max(1, j // 2)
+            self.events.append(("mem-shrink", self.rung, site, j))
         if self._promoted:
             try:
                 fault_point(site)
@@ -250,17 +286,81 @@ def _rung_single(lo, hi, n, rt, num_workers):
 
 
 def _rung_host(lo, hi, n, rt, num_workers):
-    # the floor of the ladder: exact numpy/native union-find, no device
-    # dispatches, cannot fault.  pst is NOT recounted here — the driver
-    # already holds the order-free pst from prep (these links may be
-    # chunk-rewritten, so per-link counting would be wrong anyway).
+    # exact numpy/native union-find, no device dispatches, cannot fault
+    # — but it casts the whole links table to int64 (16 bytes/link), so
+    # under a tight memory budget the spill rung below is the real floor.
+    # pst is NOT recounted here — the driver already holds the order-free
+    # pst from prep (these links may be chunk-rewritten, so per-link
+    # counting would be wrong anyway).
     zero = np.zeros(n, dtype=np.uint32)
     forest = build_forest_links(lo.astype(np.int64), hi.astype(np.int64), n,
                                 pst=zero)
     return forest.parent
 
 
-_RUNGS = {"mesh": _rung_mesh, "single": _rung_single, "host": _rung_host}
+def _rung_spill(lo, hi, n, rt, num_workers):
+    """The memory FLOOR of the ladder (ISSUE 5): the links table spills
+    to a memory-mapped int32 scratch file and the exact union-find folds
+    over it in bounded blocks — O(n + SPILL_BLOCK) resident, any link
+    count.
+
+    Soundness is the associative-merge property every other layer already
+    leans on (core.forest.build_forest_streaming, the reference's
+    jnode.cpp:174-201 merge): the forest of (carry-links ∪ next-block) is
+    the forest of the union, and a converged forest re-enters the fold as
+    its <= n (kid -> parent) links.  pst comes from the driver (order-free
+    since prep), so the fold runs with a zero pst like the host rung.
+
+    The scratch file lives under SHEEP_SCRATCH_DIR > the checkpoint dir >
+    the system temp dir, and is removed on every exit path — scratch is
+    never part of the durable/resumable state (the checkpoint still holds
+    the authoritative link multiset).
+    """
+    import shutil
+    import tempfile
+
+    from ..core.forest import forest_links
+    from ..resources.governor import SPILL_BLOCK
+
+    gov = rt.governor
+    root = (gov.scratch_dir if gov is not None and gov.scratch_dir
+            else None) or (rt.ckpt.directory if rt.ckpt is not None
+                           else None) or tempfile.gettempdir()
+    os.makedirs(root, exist_ok=True)
+    k = len(lo)
+    if k == 0:
+        return np.full(n, INVALID_JNID, dtype=np.uint32)
+    scratch = tempfile.mkdtemp(prefix="sheep-spill.", dir=root)
+    zero = np.zeros(n, dtype=np.uint32)
+    try:
+        mlo = np.memmap(os.path.join(scratch, "lo.i32"), dtype=np.int32,
+                        mode="w+", shape=(k,))
+        mhi = np.memmap(os.path.join(scratch, "hi.i32"), dtype=np.int32,
+                        mode="w+", shape=(k,))
+        mlo[:] = lo
+        mhi[:] = hi
+        mlo.flush()
+        mhi.flush()
+        carry_lo = np.empty(0, dtype=np.int64)
+        carry_hi = np.empty(0, dtype=np.int64)
+        forest = None
+        for a in range(0, k, SPILL_BLOCK):
+            b = min(a + SPILL_BLOCK, k)
+            fold_lo = np.concatenate(
+                [carry_lo, np.asarray(mlo[a:b], dtype=np.int64)])
+            fold_hi = np.concatenate(
+                [carry_hi, np.asarray(mhi[a:b], dtype=np.int64)])
+            forest = build_forest_links(fold_lo, fold_hi, n, pst=zero)
+            carry_lo, carry_hi = forest_links(forest)
+            rt.events.append(("spill-block", a // SPILL_BLOCK,
+                              len(carry_lo)))
+        return forest.parent
+    finally:
+        shutil.rmtree(scratch, ignore_errors=True)
+
+
+_RUNGS = {"mesh": _rung_mesh, "single": _rung_single, "host": _rung_host,
+          "spill": _rung_spill}
 
 
 def _ladder_rungs(config: RuntimeConfig, num_workers) -> list[str]:
@@ -289,7 +389,10 @@ def build_graph_resilient(tail, head, num_vertices=None, num_workers=None,
     reset_counters()
     policy = config.policy()
     events = config.events
-    ckpt = Checkpointer(config.checkpoint_dir, config.checkpoint_every) \
+    gov = config.governor if config.governor is not None \
+        else ResourceGovernor.from_env()
+    ckpt = Checkpointer(config.checkpoint_dir, config.checkpoint_every,
+                        governor=gov) \
         if config.checkpoint_dir else None
 
     tail = np.asarray(tail)
@@ -337,11 +440,23 @@ def build_graph_resilient(tail, head, num_vertices=None, num_workers=None,
         hi = hi64[tree].astype(np.int32)
         rounds = 0
 
+    # Memory-budget ladder planning (ISSUE 5): price each rung's peak
+    # analytically and route around the ones that cannot fit the
+    # headroom — degrading up-front beats OOM-ing mid-rung.  The last
+    # rung (spill: O(n + block) resident) always survives.
+    if gov.active:
+        rungs, trace = gov.plan_rungs(rungs, n, len(lo),
+                                      num_workers or 1)
+        for rung, est, verdict in trace:
+            if verdict == "skip":
+                events.append(("mem-skip-rung", rung, est))
+
     parent = None
     for i, rung in enumerate(rungs):
         rt = ChunkRuntime(policy, ckpt, events, rung, n, seq_h, pst, sig,
                           rounds_base=rounds,
-                          promote_after=config.promote_after)
+                          promote_after=config.promote_after,
+                          governor=gov if gov.active else None)
         if snap is None and i == 0:
             # boundary 0 = "prep complete": a kill during the first chunk
             # resumes without re-running the degree sort / link mapping
@@ -350,8 +465,14 @@ def build_graph_resilient(tail, head, num_vertices=None, num_workers=None,
             parent = _RUNGS[rung](lo, hi, n, rt, num_workers)
             break
         except Exception as exc:
-            retryable = isinstance(exc, RetryBudgetExhausted) \
-                or is_retryable(exc)
+            # Memory exhaustion degrades DOWN the ladder (the cheaper
+            # rung is the recovery); disk exhaustion propagates (the
+            # next rung would hit the same full disk — the run aborts
+            # typed and resumable instead).
+            oom = isinstance(exc, (MemoryError, MemoryBudgetExceeded))
+            retryable = oom or isinstance(exc, RetryBudgetExhausted) \
+                or (is_retryable(exc)
+                    and not isinstance(exc, ResourceError))
             if not retryable or i + 1 >= len(rungs):
                 raise
             events.append(("degrade", rung, rungs[i + 1],

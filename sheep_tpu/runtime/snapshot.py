@@ -41,9 +41,10 @@ from dataclasses import dataclass
 import numpy as np
 
 from ..integrity.errors import IntegrityError, MalformedArtifact
-from ..integrity.sidecar import (resolve_policy, sidecar_path, verify_file,
-                                 write_sidecar)
-from ..io.atomic import atomic_write
+from ..integrity.sidecar import (resolve_policy, sealed_write, sidecar_path,
+                                 verify_file)
+from ..resources import (ResourceGovernor, gc_orphan_temps, retention_gc,
+                         snapshot_nbytes)
 
 SNAPSHOT_NAME = "sheep-ckpt.npz"
 _VERSION = 1
@@ -143,7 +144,8 @@ class Checkpointer:
     off automatically instead of making the operator guess a number.
     """
 
-    def __init__(self, directory: str, every: int = 1):
+    def __init__(self, directory: str, every: int = 1,
+                 governor: ResourceGovernor | None = None):
         if every < 0:
             raise ValueError(f"checkpoint every={every} must be >= 0 "
                              f"(0 = auto-tune)")
@@ -151,7 +153,12 @@ class Checkpointer:
         self.auto = every == 0
         self.every = 1 if self.auto else every
         self.boundary = 0
+        self.governor = governor if governor is not None \
+            else ResourceGovernor.from_env()
         os.makedirs(directory, exist_ok=True)
+        # a killed/faulted predecessor's write debris: unpublished by
+        # construction, reclaimed before it can crowd out OUR snapshots
+        gc_orphan_temps(directory)
 
     def observe(self, save_s: float, chunk_s: float) -> int | None:
         """Feed one (snapshot cost, chunk compute time) measurement; in
@@ -181,15 +188,40 @@ class Checkpointer:
         """Count an off-cadence boundary without persisting anything."""
         self.boundary += 1
 
+    def preflight(self, n: int, links: int) -> int:
+        """Disk preflight for the NEXT snapshot (ISSUE 5): price it
+        analytically, run the retention GC when the ``SHEEP_DISK_BUDGET``
+        cap would trip (keep-resumable: the live snapshot + sidecar are
+        protected; orphan temps and stale files go first), and refuse
+        with a typed DiskExhausted when neither the budget nor the
+        filesystem can hold it.  Returns the estimate."""
+        est = snapshot_nbytes(n, links)
+        gov = self.governor
+        deficit = gov.dir_budget_deficit(self.directory, est)
+        if deficit > 0:
+            retention_gc(self.directory,
+                         protect=(self.path, sidecar_path(self.path)),
+                         keep_last=0, need=deficit)
+            gov.check_dir_budget(self.directory, est, "checkpoint")
+        gov.preflight_write(self.directory, est)
+        return est
+
     def save(self, snap: Snapshot) -> None:
         """Persist ``snap`` at the current boundary and advance the
         counter (callers gate on :meth:`want` first).  Snapshot writes
-        guard themselves: structurally invalid state (a sick rung handing
-        over garbage links) is refused BEFORE it becomes durable."""
+        guard themselves twice over: structurally invalid state (a sick
+        rung handing over garbage links) is refused BEFORE it becomes
+        durable, and a disk that cannot hold the snapshot is refused
+        BEFORE any bytes land (:meth:`preflight`) — in both cases the
+        previous checkpoint stays in place and the run stays resumable."""
         snap.boundary = self.boundary
         self.boundary += 1
         snap.validate()
-        with atomic_write(self.path, "wb") as f:
+        est = self.preflight(snap.n, len(snap.lo))
+        # The npz writer seeks (zip local headers), so the sidecar sums
+        # the sealed temp by read-back (sealed_write) — sidecar first,
+        # artifact second, like every publish in the system.
+        with sealed_write(self.path, "wb", expect_bytes=est) as f:
             np.savez(
                 f,
                 version=np.int64(_VERSION),
@@ -203,10 +235,6 @@ class Checkpointer:
                 rung=np.str_(snap.rung),
                 input_sig=np.str_(snap.input_sig),
             )
-        # The npz writer seeks (zip local headers), so the sidecar sums
-        # the sealed file by read-back rather than a write-through tee.
-        write_sidecar(self.path)
-        return True
 
     def load(self, integrity: str | None = None) -> Snapshot | None:
         """The last persisted snapshot, or None when there is none.

@@ -11,7 +11,10 @@ Four modules, layered bottom-up:
                 kill/corrupt/hang a leg, stop the supervisor)
   supervise.py  the orchestrator: dispatch, fsck-gated publish,
                 retry/backoff, deadline relaunch, speculative
-                re-execution, fsck-driven resume
+                re-execution, fsck-driven resume, disk-budget GC and
+                per-leg cores budgeting (ISSUE 5)
+  status.py     ``sheep supervise --status``: the manifest + heartbeat +
+                budget-headroom operator report (read-only)
 
 See supervise.py's docstring for the failure model; the acceptance
 property (a fault at EVERY tournament round yields a bit-identical final
@@ -24,9 +27,10 @@ from .chaos import (ChaosFault, ChaosPlan, SupervisorKilled, parse_fault_plan,
 from .heartbeat import HeartbeatWriter, beat, is_stale, last_beat_s
 from .manifest import (Leg, Manifest, load_manifest, manifest_path,
                        plan_tournament, save_manifest, tournament_rounds)
+from .status import render_status, status_rows
 from .supervise import (InlineRunner, SubprocessRunner, SupervisionFailed,
                         SupervisorConfig, TournamentSupervisor, reconcile,
-                        run_supervised)
+                        run_supervised, sweep_attempt_debris)
 
 __all__ = [
     "ChaosFault",
@@ -49,7 +53,10 @@ __all__ = [
     "plan_from_env",
     "plan_tournament",
     "reconcile",
+    "render_status",
     "run_supervised",
     "save_manifest",
+    "status_rows",
+    "sweep_attempt_debris",
     "tournament_rounds",
 ]

@@ -1,0 +1,138 @@
+"""``sheep supervise --status``: the manifest as an operator table.
+
+A crashed or long-running supervised tournament is a directory of state
+(manifest.json, per-leg artifacts, heartbeat files, logs) that until now
+only the supervisor itself could interpret.  This module renders it for a
+human: per-leg state / dispatch counts / artifact presence / heartbeat
+age, plus the resource headroom the ISSUE-5 budgets track (disk usage vs
+``SHEEP_DISK_BUDGET`` and free space, RSS vs ``SHEEP_MEM_BUDGET``).
+
+Read-only by design: --status never mutates the state dir (no GC, no
+debris sweep, no manifest rewrite), so an operator can inspect a LIVE
+run another supervisor owns without racing it.
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+import time
+
+from ..resources.governor import (ResourceGovernor, dir_usage, disk_free,
+                                  rss_bytes)
+from .manifest import DONE, Manifest, load_manifest, manifest_path
+
+
+def _fmt_bytes(n: int | None) -> str:
+    if n is None:
+        return "-"
+    for unit, shift in (("G", 30), ("M", 20), ("K", 10)):
+        if abs(n) >= (1 << shift):
+            return f"{n / (1 << shift):.1f}{unit}"
+    return f"{n}B"
+
+
+def _fmt_age(s: float | None) -> str:
+    if s is None:
+        return "-"
+    if s < 120:
+        return f"{s:.0f}s"
+    if s < 7200:
+        return f"{s / 60:.0f}m"
+    return f"{s / 3600:.1f}h"
+
+
+def _newest_heartbeat_age(output: str, now: float) -> float | None:
+    """Age of the freshest beat among this leg's attempt heartbeats
+    (``<output>.aN.hb``) — None when no attempt ever beat.  Stale files
+    from dead attempts age honestly: a huge number reads as 'dead'."""
+    newest = None
+    for hb in glob.glob(glob.escape(output) + ".a*.hb"):
+        try:
+            m = os.path.getmtime(hb)
+        except OSError:
+            continue
+        newest = m if newest is None else max(newest, m)
+    return None if newest is None else max(0.0, now - newest)
+
+
+def status_rows(manifest: Manifest, now: float | None = None) -> list[dict]:
+    """One dict per leg: key/kind/round/state/dispatches/artifact bytes
+    (None = absent)/heartbeat age seconds (None = never beat)."""
+    now = time.time() if now is None else now
+    rows = []
+    for leg in manifest.legs:
+        try:
+            size = os.path.getsize(leg.output)
+        except OSError:
+            size = None
+        rows.append(dict(
+            key=leg.key, kind=leg.kind, round=leg.round, state=leg.state,
+            dispatches=leg.dispatches, artifact_bytes=size,
+            heartbeat_age_s=_newest_heartbeat_age(leg.output, now)))
+    return rows
+
+
+def render_status(state_dir: str, integrity: str | None = None,
+                  governor: ResourceGovernor | None = None,
+                  now: float | None = None) -> str:
+    """The full operator report for one state dir.  Raises
+    IntegrityError/OSError when the manifest is missing or corrupt —
+    a status view must never invent a healthier story than fsck would."""
+    manifest = load_manifest(state_dir, integrity)
+    gov = governor if governor is not None else ResourceGovernor.from_env()
+    now = time.time() if now is None else now
+    rows = status_rows(manifest, now)
+    done = sum(1 for r in rows if r["state"] == DONE)
+    dispatches = sum(r["dispatches"] for r in rows)
+
+    head = f"{'LEG':<8} {'KIND':<6} {'STATE':<8} {'DISP':>4} " \
+           f"{'ARTIFACT':>9} {'HEARTBEAT':>9}"
+    lines = [
+        f"supervised tournament: {manifest.graph}",
+        f"state dir: {state_dir}",
+        f"workers {manifest.workers}  reduction {manifest.reduction}  "
+        f"legs {done}/{len(rows)} done  dispatches {dispatches}",
+        "",
+        head,
+        "-" * len(head),
+    ]
+    for r in rows:
+        lines.append(
+            f"{r['key']:<8} {r['kind']:<6} {r['state']:<8} "
+            f"{r['dispatches']:>4} "
+            f"{_fmt_bytes(r['artifact_bytes']):>9} "
+            f"{_fmt_age(r['heartbeat_age_s']):>9}")
+
+    usage = dir_usage(state_dir)
+    free = disk_free(state_dir)
+    lines += ["", f"disk: state dir {_fmt_bytes(usage)} used, "
+                  f"{_fmt_bytes(free)} free on filesystem"]
+    if gov.disk_budget is not None:
+        lines.append(f"      budget {_fmt_bytes(gov.disk_budget)} "
+                     f"(headroom {_fmt_bytes(gov.disk_budget - usage)})")
+    rss = rss_bytes()
+    mem = f"mem:  rss {_fmt_bytes(rss)}"
+    if gov.mem_budget is not None:
+        mem += f", budget {_fmt_bytes(gov.mem_budget)} " \
+               f"(headroom {_fmt_bytes(gov.mem_budget - rss)})"
+    lines.append(mem)
+    if not manifest.done():
+        lines.append("resume: rerun `sheep supervise <graph> -d "
+                     + state_dir + "` to fsck survivors and finish")
+    return "\n".join(lines) + "\n"
+
+
+def main_status(state_dir: str, integrity: str | None = None) -> int:
+    """The CLI face: print the report; exit 0 when the manifest loads
+    (even mid-run), 1 when the state dir has no readable manifest."""
+    import sys
+    if not os.path.exists(manifest_path(state_dir)):
+        print(f"supervise: no manifest in {state_dir}", file=sys.stderr)
+        return 1
+    try:
+        sys.stdout.write(render_status(state_dir, integrity))
+    except (ValueError, OSError) as exc:
+        print(f"supervise: {exc}", file=sys.stderr)
+        return 1
+    return 0

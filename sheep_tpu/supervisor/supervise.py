@@ -43,6 +43,7 @@ to the fault-free run, re-dispatching only the faulted leg.
 from __future__ import annotations
 
 import os
+import re
 import shutil
 import sys
 import threading
@@ -52,6 +53,7 @@ from typing import Callable
 
 from ..integrity.errors import IntegrityError
 from ..integrity.sidecar import read_sidecar, resolve_policy
+from ..resources import ResourceGovernor, gc_orphan_temps, retention_gc
 from ..runtime.retry import RetryPolicy
 from .chaos import ChaosPlan, SupervisorKilled, plan_from_env
 from .heartbeat import (HEARTBEAT_FILE_ENV, HEARTBEAT_INTERVAL_ENV,
@@ -90,6 +92,18 @@ class SupervisorConfig:
     poll_s: float = 0.05
     #: max concurrent attempts (0 = unthrottled; the bash driver's CORES)
     cores: int = 0
+    #: CPU cores each leg may use (env SHEEP_LEG_CORES; 0 = unmanaged).
+    #: Caps concurrency at host_cores // leg_cores — so a speculative
+    #: twin can never oversubscribe the host it shares with the straggler
+    #: it is racing — and the subprocess runner pins each attempt to its
+    #: own rotating core slice + thread-count env caps.
+    leg_cores: int = 0
+    #: disk/memory budgets (SHEEP_DISK_BUDGET / SHEEP_MEM_BUDGET); None =
+    #: from env.  Under a disk budget the supervisor GCs retired
+    #: intermediates (outputs no pending leg consumes — everything it
+    #: deletes is re-creatable by a resume) when the state dir trips the
+    #: cap, and sweeps write debris on every failure.
+    governor: ResourceGovernor | None = None
     integrity: str | None = None
     #: print the reference phase grammar ("Mapped in N seconds.") that
     #: data/make-parallel.sh greps
@@ -112,6 +126,8 @@ class SupervisorConfig:
             heartbeat_s=float(env.get("SHEEP_HEARTBEAT_S", "1")),
             max_retries=int(env.get("SHEEP_MAX_RETRIES", "3")),
             backoff_base_s=float(env.get("SHEEP_BACKOFF_BASE", "0.05")),
+            leg_cores=int(env.get("SHEEP_LEG_CORES", "0") or 0),
+            governor=ResourceGovernor.from_env(),
             integrity=env.get("SHEEP_INTEGRITY") or None,
             chaos=plan_from_env(),
         )
@@ -243,11 +259,48 @@ class InlineRunner:
 class SubprocessRunner:
     """Run legs as real CLI subprocesses — the production path.  Each
     child gets SHEEP_HEARTBEAT_FILE pointing at its attempt's heartbeat
-    (cli/common.maybe_start_heartbeat) and logs to the state dir."""
+    (cli/common.maybe_start_heartbeat) and logs to the state dir.
 
-    def __init__(self, interval_s: float = 1.0, env: dict | None = None):
+    ``leg_cores`` (env SHEEP_LEG_CORES): pin each child to its own
+    rotating ``leg_cores``-wide slice of the host's affinity mask and cap
+    its math-library thread counts to match — the per-leg cores budget
+    that keeps a speculative twin from oversubscribing the host it
+    shares with the straggler it is racing (the supervisor separately
+    caps CONCURRENCY at host_cores // leg_cores)."""
+
+    _THREAD_ENVS = ("OMP_NUM_THREADS", "OPENBLAS_NUM_THREADS",
+                    "MKL_NUM_THREADS", "NUMEXPR_NUM_THREADS")
+
+    def __init__(self, interval_s: float = 1.0, env: dict | None = None,
+                 leg_cores: int = 0):
         self.interval_s = interval_s
         self.env = env
+        self.leg_cores = leg_cores
+        self._slot = 0
+
+    def _pin(self, env: dict):
+        """(preexec_fn, env) for the next attempt's core slice; (None,
+        env) when unmanaged or the platform lacks affinity control."""
+        k = self.leg_cores
+        if not k or not hasattr(os, "sched_setaffinity"):
+            return None, env
+        try:
+            host = sorted(os.sched_getaffinity(0))
+        except OSError:
+            host = list(range(os.cpu_count() or 1))
+        slots = max(1, len(host) // k)
+        at = (self._slot % slots) * k
+        self._slot += 1
+        cpus = set(host[at: at + k]) or set(host)
+        for var in self._THREAD_ENVS:
+            env[var] = str(k)
+
+        def preexec():  # runs in the child, pre-exec
+            try:
+                os.sched_setaffinity(0, cpus)
+            except OSError:
+                pass
+        return preexec, env
 
     def start(self, argv: list[str], hb_path: str, log_path: str):
         import subprocess
@@ -259,10 +312,12 @@ class SubprocessRunner:
         env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
         env[HEARTBEAT_FILE_ENV] = hb_path
         env[HEARTBEAT_INTERVAL_ENV] = str(self.interval_s)
+        preexec, env = self._pin(env)
         log_f = open(log_path, "ab")
         proc = subprocess.Popen(
             [sys.executable, "-m", f"sheep_tpu.cli.{argv[0]}"] + argv[1:],
-            stdout=log_f, stderr=subprocess.STDOUT, env=env)
+            stdout=log_f, stderr=subprocess.STDOUT, env=env,
+            preexec_fn=preexec)
         return _SubprocessHandle(proc, log_f)
 
 
@@ -307,6 +362,32 @@ def _discard(*paths: str) -> None:
             pass
 
 
+#: attempt-private files: <output>.aN plus its .sum / .hb companions
+_ATTEMPT_DEBRIS_RE = re.compile(r"\.a\d+(\.sum|\.hb)?$")
+
+
+def sweep_attempt_debris(state_dir: str) -> list[str]:
+    """Remove the attempt temps a DEAD supervisor stranded (ISSUE 5).
+    Only safe when no attempts are in flight — run_supervised calls it
+    before constructing the supervisor.  Attempt files are by protocol
+    unpublished (the publish is the rename away from the ``.aN`` name),
+    so a resume never reads one; left behind they only eat the budget."""
+    removed = []
+    try:
+        names = os.listdir(state_dir)
+    except OSError:
+        return removed
+    for name in names:
+        if _ATTEMPT_DEBRIS_RE.search(name):
+            path = os.path.join(state_dir, name)
+            try:
+                os.unlink(path)
+                removed.append(path)
+            except OSError:
+                pass
+    return removed
+
+
 def _corrupt_bytes(path: str) -> None:
     """Chaos "corrupt": flip one payload byte under the unchanged sidecar
     (bit rot after a successful write — exactly what fsck exists for)."""
@@ -329,8 +410,11 @@ class TournamentSupervisor:
         self.state_dir = state_dir
         self.config = config
         self.runner = runner if runner is not None \
-            else SubprocessRunner(interval_s=config.heartbeat_s)
+            else SubprocessRunner(interval_s=config.heartbeat_s,
+                                  leg_cores=config.leg_cores)
         self.policy = config.policy()
+        self.governor = config.governor if config.governor is not None \
+            else ResourceGovernor.from_env()
         self.mode = resolve_policy(config.integrity)
         self.events = config.events
         self.log_dir = os.path.join(state_dir, "logs")
@@ -347,6 +431,54 @@ class TournamentSupervisor:
         #: budget is per-life so a many-times-resumed run is never
         #: permanently bricked by its history
         self._life: dict[str, int] = {}
+
+    # -- resource budgets --------------------------------------------------
+
+    def _slots(self) -> int:
+        """Max concurrent attempts: the explicit ``cores`` throttle
+        AND the per-leg cores budget (host_cores // leg_cores) — the
+        tighter wins; 0 = unthrottled."""
+        slots = self.config.cores
+        if self.config.leg_cores:
+            try:
+                avail = len(os.sched_getaffinity(0))
+            except (AttributeError, OSError):
+                avail = os.cpu_count() or 1
+            by_budget = max(1, avail // self.config.leg_cores)
+            slots = min(slots, by_budget) if slots else by_budget
+        return slots
+
+    def _inflight(self) -> int:
+        return sum(len(a) for a in self._running.values())
+
+    def _maybe_gc(self, force: bool = False) -> int:
+        """Reclaim retired intermediates when the state dir trips the
+        ``SHEEP_DISK_BUDGET`` cap (or on ``force``: an attempt just
+        failed with what may be a full disk).  Keep-resumable: the
+        manifest, the final tree, the sequence, every pending leg's
+        inputs/output, and all in-flight attempt files are protected;
+        everything deleted is re-creatable by a resume (reconcile marks
+        the producers of a missing-but-needed artifact pending again)."""
+        gov = self.governor
+        if gov.disk_budget is None and not force:
+            return 0
+        deficit = gov.dir_budget_deficit(self.state_dir, 0)
+        if deficit <= 0 and not force:
+            return 0
+        protect = {manifest_path(self.state_dir),
+                   self.manifest.final_tree, self.manifest.seq_file}
+        for leg in self.manifest.legs:
+            if leg.state != DONE:
+                protect.add(leg.output)
+                protect.update(leg.inputs)
+        for atts in self._running.values():
+            for att in atts:
+                protect.update((att.tmp, att.hb))
+        freed, removed = retention_gc(self.state_dir, protect=protect,
+                                      keep_last=0, need=max(0, deficit))
+        if removed:
+            self.events.append(("gc", len(removed), freed))
+        return freed
 
     # -- dispatch ----------------------------------------------------------
 
@@ -420,6 +552,7 @@ class TournamentSupervisor:
         leg.state = DONE
         self.events.append(("publish", leg.key))
         save_manifest(self.manifest, self.state_dir)
+        self._maybe_gc()
         # siblings (speculative twins) lost the race: cancel + discard
         for other in self._running.get(leg.key, []):
             if other is not att:
@@ -458,6 +591,12 @@ class TournamentSupervisor:
         leg = att.leg
         _discard(att.tmp, att.tmp + ".sum", att.hb)
         self.events.append(("leg-failed", leg.key, reason))
+        # an attempt that died on a full disk leaves the condition in
+        # place for its retry: sweep write debris, and reclaim retired
+        # intermediates (all re-creatable) before dispatching again
+        gc_orphan_temps(self.state_dir)
+        if "ENOSPC" in reason or "No space" in reason:
+            self._maybe_gc(force=True)
         self._running[leg.key] = [
             a for a in self._running.get(leg.key, []) if a is not att]
         if self._running[leg.key]:
@@ -492,7 +631,12 @@ class TournamentSupervisor:
                           > self.config.speculate_after_s
                           and len(self._running.get(key, [])) == 1
                           and self._life.get(key, 0)
-                          < self.config.max_dispatches):
+                          < self.config.max_dispatches
+                          # the cores budget binds speculation too: a
+                          # twin that would oversubscribe the host only
+                          # slows the straggler it is meant to beat
+                          and (not self._slots()
+                               or self._inflight() < self._slots())):
                         self._launch(att.leg, now, speculative=True)
                 elif rc == 0:
                     self._complete(att)
@@ -515,6 +659,7 @@ class TournamentSupervisor:
 
     def _launch_ready(self, now: float) -> int:
         launched = 0
+        slots = self._slots()
         for leg in sorted(self.manifest.pending(),
                           key=lambda l: (l.round, l.index)):
             if leg.key in self._running:
@@ -526,9 +671,7 @@ class TournamentSupervisor:
             if any(p in self._producer and self._producer[p].state != DONE
                    for p in leg.inputs):
                 continue
-            if self.config.cores and sum(
-                    len(a) for a in self._running.values()) \
-                    >= self.config.cores:
+            if slots and self._inflight() >= slots:
                 break
             self._launch(leg, now)
             launched += 1
@@ -632,6 +775,11 @@ def run_supervised(graph: str, state_dir: str,
     """
     config = config or SupervisorConfig.from_env()
     os.makedirs(state_dir, exist_ok=True)
+    # a dead predecessor's write debris: atomic-write temps and attempt
+    # files are unpublished by construction — reclaim before they count
+    # against the disk budget (and before attempt names could collide)
+    gc_orphan_temps(state_dir)
+    sweep_attempt_debris(state_dir)
     base = os.path.basename(graph)
     for suffix in (".dat", ".net"):
         if base.endswith(suffix):

@@ -1,0 +1,187 @@
+"""I/O fault injection through the DISTRIBUTED file path (ISSUE 5).
+
+The acceptance property: an ENOSPC / EIO / short-write fired at ANY write
+site of a supervised tournament (``SHEEP_IO_FAULT_PLAN`` grammar, the I/O
+sibling of PR-3's ``SHEEP_FAULT_PLAN``) must leave the system in one of
+exactly two states:
+
+  * the run COMPLETED anyway (the supervisor's retry absorbed the faulted
+    worker write) with a final tree bit-identical to the fault-free run —
+    equal ECV(down) included; or
+  * the run ABORTED with a typed error (a fault in the supervisor's own
+    manifest write), every artifact published before the abort fscks
+    clean, and a rerun of the same state dir resumes off the PR-3
+    manifest to the bit-identical tree.
+
+In BOTH worlds: no published artifact ever fails fsck, and no write
+debris (atomic temps, attempt files) survives into the resumed world's
+budget.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from sheep_tpu.core.forest import build_forest
+from sheep_tpu.core.sequence import degree_sequence
+from sheep_tpu.integrity.fsck import fsck_paths
+from sheep_tpu.io import faultfs
+from sheep_tpu.io.edges import write_net
+from sheep_tpu.io.trefile import read_tree
+from sheep_tpu.resources import ResourceError
+from sheep_tpu.supervisor import (InlineRunner, SupervisionFailed,
+                                  SupervisorConfig, run_supervised)
+from sheep_tpu.utils.synth import rmat_edges
+
+pytestmark = pytest.mark.chaos
+
+WORKERS = 2
+
+
+@pytest.fixture(autouse=True)
+def _clean_io_faults():
+    faultfs.clear_plan()
+    yield
+    faultfs.clear_plan()
+
+
+@pytest.fixture(scope="module")
+def small_graph(tmp_path_factory):
+    d = tmp_path_factory.mktemp("iofaults")
+    tail, head = rmat_edges(6, 4 << 6, seed=5)
+    graph = str(d / "g.net")
+    write_net(graph, tail, head)
+    seq = degree_sequence(tail, head)
+    want = build_forest(tail, head, seq)
+    return graph, tail, head, seq, want
+
+
+def _ecv_down(tail, head, seq, parent, pst, parts=2):
+    from sheep_tpu.core.forest import Forest
+    from sheep_tpu.partition.evaluate import evaluate_partition
+    from sheep_tpu.partition.partition import Partition
+
+    p = Partition.from_forest(seq, Forest(parent, pst), parts)
+    rep = evaluate_partition(p.parts, tail, head, seq, p.num_parts)
+    return rep.ecv_down
+
+
+def _config(**overrides) -> SupervisorConfig:
+    kw = dict(workers=WORKERS, deadline_s=10.0, poll_s=0.01,
+              backoff_base_s=0.0, heartbeat_s=0.05, grammar=False)
+    kw.update(overrides)
+    return SupervisorConfig(**kw)
+
+
+def _run(graph, state_dir):
+    manifest = run_supervised(graph, str(state_dir), _config(),
+                              runner=InlineRunner(0.05))
+    return manifest
+
+
+def _assert_published_clean(state_dir):
+    """Every artifact under the state dir that carries a final name must
+    fsck clean — the publish gate may never have let a faulted write
+    through.  (Attempt temps are not artifacts; fsck skips them by
+    suffix.)"""
+    targets = [os.path.join(state_dir, n) for n in os.listdir(state_dir)
+               if n.endswith((".tre", ".seq")) ]
+    if not targets:
+        return
+    results, failures = fsck_paths(targets)
+    assert not failures, failures
+
+
+@pytest.fixture(scope="module")
+def baseline(small_graph, tmp_path_factory):
+    graph, tail, head, seq, want = small_graph
+    d = tmp_path_factory.mktemp("base")
+    manifest = _run(graph, d / "state")
+    with open(manifest.final_tree, "rb") as f:
+        tree_bytes = f.read()
+    parent, pst = read_tree(manifest.final_tree)
+    ecv = _ecv_down(tail, head, seq, parent, pst)
+    return tree_bytes, ecv
+
+
+#: the write-site sweep: every site class a tournament writes, at several
+#: indices, under each failure kind.  A worker-side fault (seq/tre/
+#: sidecar) is absorbed by retry; a supervisor-side fault (manifest)
+#: aborts the run typed and must resume off the manifest.
+SWEEP = [
+    ("enospc", "seq", 0), ("short", "seq", 0), ("eio", "seq", 0),
+    ("enospc", "tre", 0), ("enospc", "tre", 1), ("enospc", "tre", 2),
+    ("eio", "tre", 1), ("short", "tre", 0), ("short", "tre", 2),
+    ("enospc", "sidecar", 0), ("eio", "sidecar", 1),
+    ("short", "sidecar", 2),
+    ("enospc", "manifest", 0), ("enospc", "manifest", 2),
+    ("eio", "manifest", 1), ("short", "manifest", 3),
+]
+
+
+@pytest.mark.parametrize("kind,site,nth", SWEEP,
+                         ids=[f"{k}@{s}:{n}" for k, s, n in SWEEP])
+def test_fault_at_every_write_site(small_graph, baseline, tmp_path,
+                                   kind, site, nth):
+    graph, tail, head, seq, want = small_graph
+    want_bytes, want_ecv = baseline
+    state = tmp_path / "state"
+
+    faultfs.install_plan(
+        faultfs.parse_io_fault_plan(f"{kind}@{site}:{nth}"))
+    completed = False
+    try:
+        manifest = _run(graph, state)
+        completed = manifest.done()
+    except (SupervisionFailed, ResourceError, OSError):
+        pass
+    faultfs.clear_plan()
+
+    # invariant 1: nothing published ever fscks dirty, completed or not
+    if os.path.isdir(state):
+        _assert_published_clean(str(state))
+
+    # invariant 2: the run either completed exactly, or resumes exactly
+    if not completed:
+        manifest = _run(graph, state)
+        assert manifest.done()
+    with open(manifest.final_tree, "rb") as f:
+        got = f.read()
+    assert got == want_bytes, f"{kind}@{site}:{nth} diverged"
+    parent, pst = read_tree(manifest.final_tree)
+    assert _ecv_down(tail, head, seq, parent, pst) == want_ecv
+
+    # invariant 3: no write debris survives into the final world
+    names = os.listdir(state)
+    assert not any(n.endswith(".tmp") for n in names), names
+
+
+def test_worker_fault_is_single_redispatch(small_graph, baseline,
+                                           tmp_path):
+    """A worker-side ENOSPC costs exactly one extra dispatch of one leg —
+    the supervisor never re-runs healthy legs over an I/O fault."""
+    graph, tail, head, seq, want = small_graph
+    want_bytes, _ = baseline
+    faultfs.install_plan(faultfs.parse_io_fault_plan("enospc@tre:0"))
+    manifest = _run(graph, tmp_path / "state")
+    faultfs.clear_plan()
+    assert manifest.done()
+    counts = {leg.key: leg.dispatches for leg in manifest.legs}
+    assert sum(counts.values()) == len(manifest.legs) + 1, counts
+    with open(manifest.final_tree, "rb") as f:
+        assert f.read() == want_bytes
+
+
+def test_slow_everywhere_still_exact(small_graph, baseline, tmp_path):
+    """The slow kind (stalled writes) must never fail a run — it exists
+    to exercise heartbeat/deadline margins, not recovery."""
+    graph, tail, head, seq, want = small_graph
+    want_bytes, _ = baseline
+    faultfs.install_plan(faultfs.parse_io_fault_plan(
+        "slow@seq:0,slow@tre:0,slow@tre:1,slow@manifest:0"))
+    manifest = _run(graph, tmp_path / "state")
+    faultfs.clear_plan()
+    assert manifest.done()
+    with open(manifest.final_tree, "rb") as f:
+        assert f.read() == want_bytes

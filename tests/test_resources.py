@@ -1,0 +1,584 @@
+"""Resource-exhaustion hardening tests (ISSUE 5).
+
+The acceptance properties:
+
+  * an injected ENOSPC/EIO/short-write at ANY write site never publishes
+    an artifact (the previous pair stays intact and fscks clean), always
+    surfaces as a typed ResourceError, and leaves no temp debris;
+  * a checkpointed build killed OR disk-refused at every boundary keeps
+    exactly the resumable set on disk (retention GC reclaims junk under
+    SHEEP_DISK_BUDGET pressure, never the live snapshot) and resumes to
+    the bit-identical tree with equal ECV(down);
+  * under a memory budget the ladder routes around rungs that cannot fit
+    — down to the memory-mapped spill floor — and the result stays
+    oracle-exact.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from sheep_tpu.core.forest import build_forest
+from sheep_tpu.core.sequence import degree_sequence
+from sheep_tpu.io import faultfs
+from sheep_tpu.io.atomic import atomic_write
+from sheep_tpu.io.trefile import read_tree, write_tree
+from sheep_tpu.resources import (DiskExhausted, MemoryBudgetExceeded,
+                                 ResourceError, ResourceGovernor, WriteFault,
+                                 dir_usage, gc_orphan_temps, parse_size,
+                                 retention_gc, rss_bytes)
+from sheep_tpu.runtime import (BuildKilled, FaultPlan, RuntimeConfig,
+                               build_graph_resilient, clear_plan,
+                               install_plan, reset_counters)
+from sheep_tpu.runtime.snapshot import SNAPSHOT_NAME, Checkpointer
+from sheep_tpu.utils.synth import rmat_edges
+
+pytestmark = pytest.mark.faults
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    clear_plan()
+    reset_counters()
+    faultfs.clear_plan()
+    yield
+    clear_plan()
+    reset_counters()
+    faultfs.clear_plan()
+
+
+@pytest.fixture(scope="module")
+def small_graph():
+    tail, head = rmat_edges(9, 4 << 9, seed=11)
+    seq = degree_sequence(tail, head)
+    want = build_forest(tail, head, seq)
+    return tail, head, seq, want
+
+
+def _ecv_down(tail, head, seq, forest, parts=2):
+    from sheep_tpu.partition.evaluate import evaluate_partition
+    from sheep_tpu.partition.partition import Partition
+
+    p = Partition.from_forest(seq, forest, parts)
+    rep = evaluate_partition(p.parts, tail, head, seq, p.num_parts)
+    return rep.ecv_down
+
+
+# ---------------------------------------------------------------------------
+# units: size parsing, site derivation, plan grammar
+# ---------------------------------------------------------------------------
+
+
+def test_parse_size():
+    assert parse_size("512M") == 512 << 20
+    assert parse_size("2g") == 2 << 30
+    assert parse_size("1k") == 1024
+    assert parse_size("123") == 123
+    assert parse_size("1.5G") == int(1.5 * (1 << 30))
+    assert parse_size(None) is None
+    assert parse_size("") is None
+    assert parse_size("0") is None
+    for bad in ("12Q", "garbage", "-1M"):
+        with pytest.raises(ValueError):
+            parse_size(bad)
+
+
+def test_site_for():
+    assert faultfs.site_for("/a/g.tre") == "tre"
+    assert faultfs.site_for("/a/g00r1.tre.a3") == "tre"
+    assert faultfs.site_for("/a/g00r1.tre.a3.sum") == "sidecar"
+    assert faultfs.site_for("/a/g.seq") == "seq"
+    assert faultfs.site_for("/a/g.dat") == "dat"
+    assert faultfs.site_for("/a/g.net") == "net"
+    assert faultfs.site_for("/a/sheep-ckpt.npz") == "ckpt"
+    assert faultfs.site_for("/a/manifest.json") == "manifest"
+    assert faultfs.site_for("/a/manifest.json.sum") == "sidecar"
+    assert faultfs.site_for("/a/notes.txt") == "other"
+
+
+def test_io_fault_plan_grammar():
+    plan = faultfs.parse_io_fault_plan("enospc@ckpt:1, short@tre:0")
+    assert [(f.kind, f.site, f.nth) for f in plan.faults] == \
+        [("enospc", "ckpt", 1), ("short", "tre", 0)]
+    assert plan.take("ckpt", 0) is None
+    assert plan.take("ckpt", 1) == "enospc"
+    assert plan.take("ckpt", 1) is None  # fired once
+    for bad in ("boom@tre:0", "enospc@tre", "enospc:tre@0"):
+        with pytest.raises(ValueError):
+            faultfs.parse_io_fault_plan(bad)
+
+
+def test_env_plan_counts_across_writes(tmp_path, monkeypatch):
+    monkeypatch.setenv(faultfs.IO_FAULT_PLAN_ENV, "eio@tre:1")
+    faultfs.clear_plan()  # re-read env with fresh counters
+    parent = np.array([1, 0xFFFFFFFF], np.uint32)
+    pst = np.zeros(2, np.uint32)
+    write_tree(str(tmp_path / "a.tre"), parent, pst)  # tre write 0: clean
+    with pytest.raises(WriteFault):
+        write_tree(str(tmp_path / "b.tre"), parent, pst)  # write 1: EIO
+    write_tree(str(tmp_path / "c.tre"), parent, pst)  # fired once: clean
+    faultfs.clear_plan()
+
+
+# ---------------------------------------------------------------------------
+# the write-site invariant: a faulted write never publishes
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("kind,exc", [("enospc", DiskExhausted),
+                                      ("eio", WriteFault),
+                                      ("short", DiskExhausted)])
+def test_faulted_write_never_publishes(tmp_path, kind, exc):
+    path = tmp_path / "t.tre"
+    parent = np.array([2, 2, 0xFFFFFFFF], np.uint32)
+    pst = np.array([1, 0, 3], np.uint32)
+    write_tree(str(path), parent, pst)
+    before = path.read_bytes()
+    before_sum = (tmp_path / "t.tre.sum").read_bytes()
+
+    faultfs.install_plan(faultfs.parse_io_fault_plan(f"{kind}@tre:0"))
+    with pytest.raises(exc):
+        write_tree(str(path), parent[::-1].copy(), pst)
+    # previous pair intact, still verifies, no debris
+    assert path.read_bytes() == before
+    assert (tmp_path / "t.tre.sum").read_bytes() == before_sum
+    read_tree(str(path))
+    assert sorted(os.listdir(tmp_path)) == ["t.tre", "t.tre.sum"]
+
+
+def test_sidecar_fault_blocks_artifact_publish(tmp_path):
+    """Sidecar-first publish: a fault on the SIDECAR write must keep the
+    artifact from appearing too — an artifact may never exist under its
+    final name without the checksum that vouches for it."""
+    path = tmp_path / "t.tre"
+    parent = np.array([1, 0xFFFFFFFF], np.uint32)
+    pst = np.zeros(2, np.uint32)
+    faultfs.install_plan(faultfs.parse_io_fault_plan("enospc@sidecar:0"))
+    with pytest.raises(DiskExhausted):
+        write_tree(str(path), parent, pst)
+    assert os.listdir(tmp_path) == []
+
+
+def test_slow_fault_only_delays(tmp_path):
+    faultfs.install_plan(faultfs.parse_io_fault_plan("slow@tre:0"))
+    path = tmp_path / "t.tre"
+    parent = np.array([1, 0xFFFFFFFF], np.uint32)
+    write_tree(str(path), parent, np.zeros(2, np.uint32))
+    read_tree(str(path))
+
+
+def test_real_enospc_maps_to_typed_error(tmp_path):
+    """A REAL OSError(ENOSPC) from the file layer surfaces as the same
+    typed DiskExhausted the injected kind produces."""
+    import errno
+
+    path = tmp_path / "x.bin"
+    with pytest.raises(DiskExhausted):
+        with atomic_write(str(path)) as f:
+            raise OSError(errno.ENOSPC, "No space left on device")
+    assert os.listdir(tmp_path) == []
+
+
+# ---------------------------------------------------------------------------
+# retention GC + orphan temps
+# ---------------------------------------------------------------------------
+
+
+def _touch(path, nbytes=10, mtime=None):
+    with open(path, "wb") as f:
+        f.write(b"x" * nbytes)
+    if mtime is not None:
+        os.utime(path, (mtime, mtime))
+
+
+def test_gc_orphan_temps(tmp_path):
+    _touch(tmp_path / ".t.tre.abc123.tmp")
+    _touch(tmp_path / "real.tre")
+    removed = gc_orphan_temps(str(tmp_path))
+    assert len(removed) == 1
+    assert os.listdir(tmp_path) == ["real.tre"]
+
+
+def test_retention_gc_policy(tmp_path):
+    # oldest-first, protect wins, sidecars travel, keep-last survives
+    for i, name in enumerate(["a.tre", "b.tre", "c.tre"]):
+        _touch(tmp_path / name, mtime=1000 + i)
+        _touch(tmp_path / (name + ".sum"), mtime=1000 + i)
+    _touch(tmp_path / ".junk.xyz.tmp", mtime=5000)
+    protect = [str(tmp_path / "b.tre")]
+    freed, removed = retention_gc(str(tmp_path), protect=protect,
+                                  keep_last=1)
+    left = sorted(os.listdir(tmp_path))
+    # a (oldest) reclaimed with its sidecar; b protected; c kept (last);
+    # the orphan temp always reclaimed
+    assert left == ["b.tre", "b.tre.sum", "c.tre", "c.tre.sum"]
+    assert freed > 0 and any(p.endswith("a.tre") for p in removed)
+
+
+def test_retention_gc_need_stops_early(tmp_path):
+    for i in range(4):
+        _touch(tmp_path / f"f{i}.tre", nbytes=100, mtime=1000 + i)
+    freed, removed = retention_gc(str(tmp_path), keep_last=0, need=150)
+    assert freed >= 150
+    assert len(os.listdir(tmp_path)) == 2  # only enough reclaimed
+
+
+# ---------------------------------------------------------------------------
+# governor units
+# ---------------------------------------------------------------------------
+
+
+def test_governor_memory_model():
+    assert rss_bytes() > 0
+    gov = ResourceGovernor(mem_budget=rss_bytes() + (1 << 30))
+    assert gov.mem_headroom() > 0
+    assert not gov.mem_pressure()
+    gov.check_mem(1 << 20, "small")  # fits
+    with pytest.raises(MemoryBudgetExceeded):
+        gov.check_mem(2 << 30, "huge")
+    tight = ResourceGovernor(mem_budget=1)
+    assert tight.mem_pressure()
+    # levels shrink but never below 2
+    assert tight.shrunk_levels(10, 1 << 20) == 2
+    assert ResourceGovernor().shrunk_levels(10, 1 << 20) == 10
+
+
+def test_governor_plans_rungs_around_budget():
+    gov = ResourceGovernor(mem_budget=1)  # zero headroom
+    rungs, trace = gov.plan_rungs(["mesh", "single", "host", "spill"],
+                                  1 << 16, 1 << 18)
+    assert rungs == ["spill"]  # the floor always survives
+    assert all(v == "skip" for _, _, v in trace[:-1])
+    free = ResourceGovernor()
+    rungs, trace = free.plan_rungs(["single", "host"], 1 << 16, 1 << 18)
+    assert rungs == ["single", "host"] and trace == []
+
+
+def test_governor_disk_budget(tmp_path):
+    _touch(tmp_path / "a.bin", nbytes=500)
+    gov = ResourceGovernor(disk_budget=600)
+    assert dir_usage(str(tmp_path)) == 500
+    assert gov.dir_budget_deficit(str(tmp_path), 50) <= 0
+    assert gov.dir_budget_deficit(str(tmp_path), 200) == 100
+    with pytest.raises(DiskExhausted):
+        gov.check_dir_budget(str(tmp_path), 200, "test")
+
+
+# ---------------------------------------------------------------------------
+# checkpoint preflight + retention under budget pressure
+# ---------------------------------------------------------------------------
+
+
+def _resilient(tail, head, d, resume=False, **kw):
+    cfg = RuntimeConfig(checkpoint_dir=d, resume=resume,
+                        ladder=("single", "host", "spill"),
+                        backoff_base_s=0.0, **kw)
+    seq, forest = build_graph_resilient(tail, head, config=cfg)
+    return seq, forest, cfg
+
+
+def test_checkpoint_gc_reclaims_junk_keeps_resumable(small_graph, tmp_path):
+    """Under a disk budget sized for ~one snapshot, every boundary's
+    preflight GC reclaims stale junk but never the live snapshot — and a
+    kill at each of the first boundaries still resumes bit-identical."""
+    tail, head, seq, want = small_graph
+    base_d = str(tmp_path / "base")
+    _, forest0, cfg0 = _resilient(tail, head, base_d)
+    np.testing.assert_array_equal(forest0.parent, want.parent)
+    boundaries = sum(1 for e in cfg0.events if e[0] == "checkpoint")
+    assert boundaries >= 2
+    ecv0 = _ecv_down(tail, head, seq, forest0)
+
+    for k in range(min(3, boundaries)):
+        d = str(tmp_path / f"kill{k}")
+        os.makedirs(d)
+        # stale junk from "previous runs" + a stranded atomic-write temp,
+        # sized so the budget cannot hold (junk + next snapshot): every
+        # boundary's preflight must GC to proceed
+        _touch(os.path.join(d, "old-run.npz"), nbytes=1 << 20, mtime=1000)
+        _touch(os.path.join(d, ".sheep-ckpt.npz.x.tmp"), nbytes=1 << 20,
+               mtime=1000)
+        gov = ResourceGovernor(disk_budget=256 << 10)
+        install_plan(FaultPlan(site="boundary", at=k, kind="kill"))
+        with pytest.raises(BuildKilled):
+            _resilient(tail, head, d, governor=gov)
+        clear_plan()
+        # exactly the resumable set survives the pressure
+        left = sorted(os.listdir(d))
+        assert SNAPSHOT_NAME in left and SNAPSHOT_NAME + ".sum" in left
+        assert "old-run.npz" not in left
+        assert not any(n.endswith(".tmp") for n in left)
+        seq1, forest1, cfg1 = _resilient(tail, head, d, resume=True,
+                                         governor=gov)
+        assert any(e[0] == "resume" for e in cfg1.events), k
+        np.testing.assert_array_equal(forest1.parent, want.parent)
+        np.testing.assert_array_equal(seq1, seq)
+        assert _ecv_down(tail, head, seq, forest1) == ecv0
+
+
+def test_checkpoint_refused_when_budget_too_small_for_snapshot(
+        small_graph, tmp_path):
+    """A budget that cannot hold even one snapshot is a typed refusal —
+    and the refusal aborts the build resumably, never torn."""
+    tail, head, seq, want = small_graph
+    d = str(tmp_path / "tiny")
+    gov = ResourceGovernor(disk_budget=64)
+    with pytest.raises(DiskExhausted):
+        _resilient(tail, head, d, governor=gov)
+    # nothing half-written under the final snapshot name
+    assert not os.path.exists(os.path.join(d, SNAPSHOT_NAME))
+
+
+def test_enospc_at_every_checkpoint_write_resumes_identical(
+        small_graph, tmp_path):
+    """Fire an injected ENOSPC at each of the first checkpoint WRITES in
+    turn: the build aborts typed (never torn), the previous snapshot
+    survives, and a resume with the fault cleared is bit-identical with
+    equal ECV(down) — the FATE/DESTINI discipline at the ckpt site."""
+    tail, head, seq, want = small_graph
+    ecv0 = None
+    for k in range(3):
+        d = str(tmp_path / f"ck{k}")
+        faultfs.install_plan(
+            faultfs.parse_io_fault_plan(f"enospc@ckpt:{k}"))
+        with pytest.raises(DiskExhausted):
+            _resilient(tail, head, d)
+        faultfs.clear_plan()
+        # the snapshot under the final name (boundary k-1's, if any) is
+        # complete and verifiable; resume completes the build exactly
+        ck = Checkpointer(d)
+        snap = ck.load()
+        if k > 0:
+            assert snap is not None
+        seq1, forest1, _ = _resilient(tail, head, d, resume=True)
+        np.testing.assert_array_equal(forest1.parent, want.parent)
+        np.testing.assert_array_equal(forest1.pst_weight, want.pst_weight)
+        ecv = _ecv_down(tail, head, seq, forest1)
+        ecv0 = ecv if ecv0 is None else ecv0
+        assert ecv == ecv0
+
+
+# ---------------------------------------------------------------------------
+# memory budget: shrink + spill, oracle-exact
+# ---------------------------------------------------------------------------
+
+
+def test_spill_rung_oracle_exact(small_graph, tmp_path):
+    tail, head, seq, want = small_graph
+    cfg = RuntimeConfig(ladder=("spill",),
+                        checkpoint_dir=str(tmp_path / "spill"))
+    seq1, forest1 = build_graph_resilient(tail, head, config=cfg)
+    np.testing.assert_array_equal(seq1, seq)
+    np.testing.assert_array_equal(forest1.parent, want.parent)
+    np.testing.assert_array_equal(forest1.pst_weight, want.pst_weight)
+    assert any(e[0] == "spill-block" for e in cfg.events)
+    # scratch never leaks into the durable state
+    assert not any(n.startswith("sheep-spill.")
+                   for n in os.listdir(tmp_path / "spill"))
+
+
+def test_spill_block_fold_matches_whole(small_graph, monkeypatch):
+    """Force multiple fold blocks through the spill rung (SPILL_BLOCK
+    shrunk below the link count): the associative carry fold must equal
+    the one-shot oracle exactly."""
+    import sheep_tpu.resources.governor as gov_mod
+
+    tail, head, seq, want = small_graph
+    monkeypatch.setattr(gov_mod, "SPILL_BLOCK", 257)
+    cfg = RuntimeConfig(ladder=("spill",))
+    _, forest1 = build_graph_resilient(tail, head, config=cfg)
+    np.testing.assert_array_equal(forest1.parent, want.parent)
+    assert sum(1 for e in cfg.events if e[0] == "spill-block") > 1
+
+
+def test_zero_headroom_budget_routes_to_spill(small_graph):
+    """SHEEP_MEM_BUDGET below the measured RSS: every priced rung is
+    skipped, the spill floor runs, and the tree is still oracle-exact —
+    the 'completes via shrink/spill instead of OOM-ing' acceptance
+    property at test scale."""
+    tail, head, seq, want = small_graph
+    gov = ResourceGovernor(mem_budget=1)
+    cfg = RuntimeConfig(governor=gov)
+    seq1, forest1 = build_graph_resilient(tail, head, config=cfg)
+    np.testing.assert_array_equal(forest1.parent, want.parent)
+    np.testing.assert_array_equal(seq1, seq)
+    assert any(e[0] == "mem-skip-rung" for e in cfg.events)
+
+
+def test_moderate_budget_shrinks_levels_not_correctness(small_graph):
+    """A budget above RSS but tight enough to cap the jump tables: the
+    chunk driver shrinks lifting depth / chunk rounds under pressure and
+    the build stays exact."""
+    tail, head, seq, want = small_graph
+    gov = ResourceGovernor(mem_budget=rss_bytes() + (4 << 20))
+    cfg = RuntimeConfig(governor=gov, ladder=("single", "host", "spill"))
+    seq1, forest1 = build_graph_resilient(tail, head, config=cfg)
+    np.testing.assert_array_equal(forest1.parent, want.parent)
+
+
+def test_memory_error_degrades_down_ladder(small_graph):
+    """A rung that raises MemoryError mid-flight degrades to the next
+    rung instead of dying (the measured-RSS backstop's failure shape)."""
+    from sheep_tpu.runtime import driver as drv
+
+    tail, head, seq, want = small_graph
+    calls = {"n": 0}
+
+    def oom_rung(lo, hi, n, rt, num_workers):
+        calls["n"] += 1
+        raise MemoryError("allocation failed")
+
+    orig = dict(drv._RUNGS)
+    drv._RUNGS["oomtest"] = oom_rung
+    try:
+        cfg = RuntimeConfig(ladder=("oomtest", "host"))
+        _, forest1 = build_graph_resilient(tail, head, config=cfg)
+    finally:
+        drv._RUNGS.clear()
+        drv._RUNGS.update(orig)
+    assert calls["n"] == 1
+    np.testing.assert_array_equal(forest1.parent, want.parent)
+    assert any(e[0] == "degrade" for e in cfg.events)
+
+
+def test_disk_exhaustion_does_not_degrade(small_graph, tmp_path):
+    """ENOSPC must PROPAGATE (the next rung faces the same full disk),
+    typed, with the state dir resumable — not burn the ladder."""
+    tail, head, seq, want = small_graph
+    d = str(tmp_path / "ck")
+    faultfs.install_plan(faultfs.parse_io_fault_plan("enospc@ckpt:1"))
+    cfg = RuntimeConfig(checkpoint_dir=d, ladder=("single", "host", "spill"))
+    with pytest.raises(DiskExhausted):
+        build_graph_resilient(tail, head, config=cfg)
+    faultfs.clear_plan()
+    assert not any(e[0] == "degrade" for e in cfg.events)
+
+
+@pytest.mark.slow
+def test_mem_budget_half_peak_2_20_completes_exact(tmp_path):
+    """The ISSUE-5 acceptance criterion at full scale: measure the RSS
+    peak an unbudgeted 2^20 chunked build reaches, set SHEEP_MEM_BUDGET
+    to HALF of it, and the build must still complete oracle-exact — via
+    rung skipping / level shrinking / the spill floor, never an OOM."""
+    import resource
+
+    tail, head = rmat_edges(20, 4 << 20, seed=7)
+    seq = degree_sequence(tail, head)
+    want = build_forest(tail, head, seq)
+
+    _, forest0 = build_graph_resilient(
+        tail, head, config=RuntimeConfig(ladder=("single", "host")))
+    np.testing.assert_array_equal(forest0.parent, want.parent)
+    peak = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss * 1024
+
+    gov = ResourceGovernor(mem_budget=peak // 2)
+    cfg = RuntimeConfig(governor=gov,
+                        ladder=("single", "host", "spill"))
+    seq1, forest1 = build_graph_resilient(tail, head, config=cfg)
+    np.testing.assert_array_equal(seq1, seq)
+    np.testing.assert_array_equal(forest1.parent, want.parent)
+    np.testing.assert_array_equal(forest1.pst_weight, want.pst_weight)
+    # the budget did something: a rung was skipped, work was shrunk, or
+    # the spill floor carried it
+    assert any(e[0] in ("mem-skip-rung", "mem-shrink", "mem-levels",
+                        "spill-block") for e in cfg.events)
+
+
+# ---------------------------------------------------------------------------
+# satellites: supervise --status, SHEEP_LEG_CORES, attempt-debris sweep
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture()
+def supervised_state(tmp_path):
+    from sheep_tpu.io.edges import write_net
+    from sheep_tpu.supervisor import (InlineRunner, SupervisorConfig,
+                                      run_supervised)
+
+    tail, head = rmat_edges(6, 4 << 6, seed=5)
+    graph = str(tmp_path / "g.net")
+    write_net(graph, tail, head)
+    cfg = SupervisorConfig(workers=2, poll_s=0.01, backoff_base_s=0.0,
+                           grammar=False)
+    manifest = run_supervised(graph, str(tmp_path / "state"), cfg,
+                              runner=InlineRunner(0.05))
+    return str(tmp_path / "state"), manifest
+
+
+def test_supervise_status_renders(supervised_state):
+    from sheep_tpu.supervisor import render_status, status_rows
+    from sheep_tpu.supervisor.manifest import load_manifest
+
+    state_dir, manifest = supervised_state
+    rows = status_rows(load_manifest(state_dir))
+    assert len(rows) == len(manifest.legs)
+    assert all(r["state"] == "done" for r in rows)
+    assert all(r["artifact_bytes"] for r in rows)
+    out = render_status(state_dir,
+                        governor=ResourceGovernor(mem_budget=1 << 30,
+                                                  disk_budget=1 << 20))
+    assert "legs" in out and "done" in out
+    assert "budget" in out and "headroom" in out
+    for leg in manifest.legs:
+        assert leg.key in out
+
+
+def test_supervise_status_cli(supervised_state, tmp_path, capsys):
+    from sheep_tpu.cli.supervise import main as sup_main
+
+    state_dir, _ = supervised_state
+    assert sup_main(["--status", "-d", state_dir]) == 0
+    assert "LEG" in capsys.readouterr().out
+    assert sup_main(["--status", "-d", str(tmp_path / "empty")]) == 1
+
+
+def test_leg_cores_caps_slots(supervised_state):
+    from sheep_tpu.supervisor import (SupervisorConfig,
+                                      TournamentSupervisor)
+    from sheep_tpu.supervisor.manifest import load_manifest
+
+    state_dir, _ = supervised_state
+    manifest = load_manifest(state_dir)
+    try:
+        avail = len(os.sched_getaffinity(0))
+    except (AttributeError, OSError):
+        avail = os.cpu_count() or 1
+    sup = TournamentSupervisor(
+        manifest, state_dir,
+        SupervisorConfig(leg_cores=1, grammar=False))
+    assert sup._slots() == avail
+    sup2 = TournamentSupervisor(
+        manifest, state_dir,
+        SupervisorConfig(leg_cores=max(1, avail), cores=2, grammar=False))
+    assert sup2._slots() == min(2, max(1, avail // max(1, avail)))
+
+
+def test_subprocess_runner_pins_thread_envs():
+    from sheep_tpu.supervisor import SubprocessRunner
+
+    r = SubprocessRunner(leg_cores=1)
+    preexec, env = r._pin({})
+    if hasattr(os, "sched_setaffinity"):
+        assert preexec is not None
+        assert env["OMP_NUM_THREADS"] == "1"
+        # slots rotate deterministically
+        _, env2 = r._pin({})
+        assert env2["OMP_NUM_THREADS"] == "1"
+    unmanaged = SubprocessRunner(leg_cores=0)
+    preexec, env = unmanaged._pin({})
+    assert preexec is None and env == {}
+
+
+def test_attempt_debris_swept_on_resume(supervised_state):
+    from sheep_tpu.supervisor import sweep_attempt_debris
+
+    state_dir, manifest = supervised_state
+    stale = os.path.join(state_dir, "g00r0.tre.a7")
+    for p in (stale, stale + ".sum", stale + ".hb"):
+        _touch(p)
+    removed = sweep_attempt_debris(state_dir)
+    assert len(removed) == 3
+    assert not os.path.exists(stale)
+    # final artifacts untouched
+    assert os.path.exists(manifest.final_tree)

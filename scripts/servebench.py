@@ -1,0 +1,218 @@
+"""SERVEBENCH: the serve daemon under load, faults, and kill -9.
+
+Measures the four numbers the ROADMAP's "Serve it" acceptance names, on a
+REAL ``bin/serve`` subprocess over real sockets:
+
+  query_qps / p50 / p99     sustained single-connection query throughput
+                            and latency over ``--queries`` PART requests
+  insert_per_sec            acknowledged (WAL-fsync'd) insert throughput
+  loaded_p99_ms             query p99 WHILE a concurrent insert stream,
+                            an injected slow-client (SHEEP_SERVE_FAULT_
+                            PLAN slow@query), and an injected ENOSPC on
+                            the next snapshot seal (SHEEP_IO_FAULT_PLAN
+                            enospc@snap) are all running — the "bounded
+                            p99 under hostile load" acceptance column
+  recovery_s                kill -9 at full state -> restart -> first
+                            successful query, with the restarted daemon's
+                            applied seqno asserted equal to every
+                            acknowledged insert (nothing acked is lost)
+
+The record embeds ``env_capture`` (utils/envinfo.py) like every bench
+artifact since r06, so a slow host explains itself.
+
+Usage: python scripts/servebench.py [graph] [out.json]
+Defaults: data/hep-th.dat, SERVEBENCH_r01.json at the repo root.  All
+published numbers must come from serialized runs on the bench host
+(ROADMAP "Known bench context").
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import statistics
+import subprocess
+import sys
+import threading
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from sheep_tpu.serve.protocol import ServeClient, connect_retry  # noqa: E402
+from sheep_tpu.utils.envinfo import env_capture  # noqa: E402
+
+
+def _spawn(state_dir, *args, env_extra=None):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env.update(env_extra or {})
+    return subprocess.Popen(
+        [sys.executable, "-m", "sheep_tpu.cli.serve", "-d", state_dir,
+         *args],
+        stdout=subprocess.DEVNULL, stderr=subprocess.STDOUT, env=env,
+        cwd=REPO)
+
+
+def _addr(state_dir, timeout=60.0):
+    deadline = time.monotonic() + timeout
+    path = os.path.join(state_dir, "serve.addr")
+    while time.monotonic() < deadline:
+        try:
+            host, port = open(path).read().split()
+            return host, int(port)
+        except (OSError, ValueError):
+            time.sleep(0.05)
+    raise TimeoutError("serve.addr never appeared")
+
+
+def _quantiles(samples_ms):
+    samples = sorted(samples_ms)
+    if not samples:
+        return 0.0, 0.0
+    p50 = statistics.median(samples)
+    p99 = samples[min(len(samples) - 1, int(0.99 * len(samples)))]
+    return round(p50, 3), round(p99, 3)
+
+
+def _query_burst(client, vids, n_requests, batch=16):
+    """n_requests PART requests; returns per-request latencies in ms."""
+    lat = []
+    for i in range(n_requests):
+        batch_vids = [vids[(i * batch + j) % len(vids)]
+                      for j in range(batch)]
+        t0 = time.perf_counter()
+        client.part(batch_vids)
+        lat.append((time.perf_counter() - t0) * 1000)
+    return lat
+
+
+def main() -> int:
+    graph = sys.argv[1] if len(sys.argv) > 1 \
+        else os.path.join(REPO, "data", "hep-th.dat")
+    out = sys.argv[2] if len(sys.argv) > 2 \
+        else os.path.join(REPO, "SERVEBENCH_r01.json")
+    n_queries = int(os.environ.get("SERVEBENCH_QUERIES", "2000"))
+    n_inserts = int(os.environ.get("SERVEBENCH_INSERTS", "500"))
+
+    import tempfile
+    work = tempfile.mkdtemp(prefix="servebench-")
+    state = os.path.join(work, "state")
+
+    from sheep_tpu.io.edges import load_edges
+    el = load_edges(graph)
+    max_vid = el.max_vid
+    vids = list(range(0, max_vid + 1, max(1, (max_vid + 1) // 4096)))
+
+    rec = {"bench": "SERVEBENCH", "round": 1, "graph": graph,
+           "records": el.num_edges, "max_vid": max_vid,
+           "queries": n_queries, "inserts": n_inserts,
+           "env": env_capture()}
+
+    # -- cold start + sustained queries -----------------------------------
+    t0 = time.perf_counter()
+    proc = _spawn(state, "-g", graph, "-k", "8")
+    host, port = _addr(state)
+    c = connect_retry(host, port, timeout_s=120)
+    rec["cold_start_s"] = round(time.perf_counter() - t0, 3)
+
+    t0 = time.perf_counter()
+    lat = _query_burst(c, vids, n_queries)
+    wall = time.perf_counter() - t0
+    p50, p99 = _quantiles(lat)
+    rec["query_qps"] = round(n_queries / wall, 1)
+    rec["query_p50_ms"] = p50
+    rec["query_p99_ms"] = p99
+
+    # -- insert throughput (each acked insert is a WAL fsync) -------------
+    rng_pairs = [((7 * i) % (max_vid + 1), (13 * i + 1) % (max_vid + 1))
+                 for i in range(n_inserts)]
+    t0 = time.perf_counter()
+    for i in range(0, n_inserts, 10):
+        c.insert(rng_pairs[i:i + 10])
+    wall = time.perf_counter() - t0
+    rec["insert_per_sec"] = round(n_inserts / wall, 1)
+    acked = n_inserts // 10 + (1 if n_inserts % 10 else 0)
+
+    # -- queries under hostile load ---------------------------------------
+    # concurrent insert stream + injected slow-client + ENOSPC on the next
+    # snapshot seal; the bench asserts availability stays typed and p99
+    # stays bounded.  Faults are injected via a SECOND daemon restart so
+    # the env plans are armed in the serving process.
+    c.close()
+    proc.send_signal(signal.SIGTERM)
+    proc.wait(timeout=60)
+    os.unlink(os.path.join(state, "serve.addr"))
+    proc = _spawn(state, env_extra={
+        "SHEEP_SERVE_FAULT_PLAN": "slow@query:50,slow@query:150",
+        "SHEEP_IO_FAULT_PLAN": "enospc@snap:0",
+        "SHEEP_SERVE_SNAP_EVERY": "20",
+    })
+    host, port = _addr(state)
+    c = connect_retry(host, port, timeout_s=120)
+
+    stop = threading.Event()
+    insert_errors = []
+    inserted_under_load = [0]
+
+    def insert_stream():
+        with ServeClient(host, port) as ic:
+            i = 0
+            while not stop.is_set():
+                u = (11 * i) % (max_vid + 1)
+                v = (29 * i + 3) % (max_vid + 1)
+                try:
+                    ic.insert([(u, v)])
+                    inserted_under_load[0] += 1
+                except Exception as exc:  # typed refusals are data here
+                    insert_errors.append(str(exc))
+                i += 1
+                time.sleep(0.002)
+
+    t = threading.Thread(target=insert_stream, daemon=True)
+    t.start()
+    lat = _query_burst(c, vids, max(200, n_queries // 4))
+    stop.set()
+    t.join(timeout=10)
+    p50, p99 = _quantiles(lat)
+    rec["loaded_p50_ms"] = p50
+    rec["loaded_p99_ms"] = p99
+    rec["loaded_inserts_acked"] = inserted_under_load[0]
+    rec["loaded_insert_refusals"] = len(insert_errors)
+    st = c.kv("STATS")
+    rec["snap_failures"] = st["snap_failures"]  # the injected ENOSPC
+    total_acked = st["applied_seqno"]
+
+    # -- kill -9 -> restart -> first answer (recovery time) ---------------
+    c.close()
+    proc.kill()
+    proc.wait(timeout=60)
+    os.unlink(os.path.join(state, "serve.addr"))
+    t0 = time.perf_counter()
+    proc = _spawn(state)
+    host, port = _addr(state)
+    c = connect_retry(host, port, timeout_s=120)
+    rec["recovery_s"] = round(time.perf_counter() - t0, 3)
+    st = c.kv("STATS")
+    rec["recovered_applied_seqno"] = st["applied_seqno"]
+    rec["acked_before_kill"] = total_acked
+    assert st["applied_seqno"] == total_acked, \
+        f"acked inserts lost: {st['applied_seqno']} != {total_acked}"
+    c.request("QUIT")
+    c.close()
+    proc.send_signal(signal.SIGTERM)
+    proc.wait(timeout=60)
+    del acked
+
+    with open(out, "w") as f:
+        json.dump(rec, f, indent=1)
+        f.write("\n")
+    print(json.dumps({k: v for k, v in rec.items() if k != "env"},
+                     indent=1))
+    print(f"servebench: record written to {out}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

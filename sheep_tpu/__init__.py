@@ -20,8 +20,10 @@ Layout:
   ops/        single-device JAX kernels (sort, hooking, segment sums, eval)
   parallel/   mesh construction, sharded fused build, tournament merge
   partition/  tree partitioners (forward FFD et al.), fennel, evaluators
+  serve/      the long-lived partition service: WAL, snapshots, protocol,
+              admission control, incremental inserts (`sheep serve`)
   cli/        graph2tree / partition_tree / degree_sequence / merge_trees
-              / fsck
+              / fsck / supervise / serve
   utils/      phase timers (stdout grammar), misc helpers
 """
 

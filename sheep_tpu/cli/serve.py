@@ -1,0 +1,147 @@
+"""sheep serve: run the crash-safe partition service.
+
+No reference counterpart — the reference answers nothing without a cold
+build; this daemon keeps the tree + partition resident and serves
+part/ECV/subtree queries plus WAL-backed incremental inserts over the
+line protocol (sheep_tpu.serve.protocol).
+
+    bin/serve -d state/ -g graph.dat -k 8          # bootstrap + serve
+    bin/serve -d state/ -T g.tre -s g.seq -g g.dat # serve existing build
+    bin/serve -d state/                            # restart: snapshot+WAL
+
+First start (artifact flags given) bootstraps the state dir: artifacts
+load through the strict integrity readers, generation-0 snapshot seals
+sidecar-first, an empty WAL is created.  Restart (no artifact flags)
+recovers: newest loadable snapshot + WAL replay — bit-identical to the
+pre-crash tree; a torn trailing WAL record is refused in strict mode and
+truncated under ``-m repair``.
+
+Options:
+  -d DIR     state dir (required): snapshots + WAL + serve.addr/serve.hb
+  -g GRAPH   edge file; with no -T/-s the sequence+tree are built from it
+  -T TRE     tree artifact (pairs with -s)
+  -s SEQ     sequence artifact
+  -P FILE    jnid-indexed partition file (default: partition in-process)
+  -k N       number of partitions (default 2; ignored with -P)
+  -p PORT    listen port (default 0 = ephemeral; the bound address is
+             printed and written to <state-dir>/serve.addr)
+  -H HOST    bind host (default 127.0.0.1)
+  -m MODE    integrity policy for recovery: strict (default) / repair
+  -b F       partition balance factor (default 1.03)
+
+Env: SHEEP_SERVE_DEADLINE_S, SHEEP_SERVE_MAX_INFLIGHT,
+SHEEP_SERVE_SNAP_EVERY, SHEEP_SERVE_DRIFT, SHEEP_SERVE_DRIFT_MIN,
+SHEEP_SERVE_FAULT_PLAN (serve/faults.py), SHEEP_IO_FAULT_PLAN sites
+``wal``/``snap``, SHEEP_MEM_BUDGET (read-only degradation).
+
+Exit codes: 0 clean shutdown, 1 startup/recovery failure, 2 usage error.
+"""
+
+from __future__ import annotations
+
+import getopt
+import os
+import signal
+import sys
+
+from ..integrity.errors import IntegrityError
+from ..integrity.sidecar import POLICIES
+
+USAGE = ("USAGE: serve -d state_dir [-g graph] [-T tree -s seq] [-P parts]"
+         " [-k num_parts] [-p port] [-H host] [-m strict|repair]"
+         " [-b balance]")
+
+
+def main(argv: list[str] | None = None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    try:
+        opts, args = getopt.gnu_getopt(argv, "d:g:T:s:P:k:p:H:m:b:")
+    except getopt.GetoptError as exc:
+        print(f"Unknown option character '{(exc.opt or '?')[:1]}'.")
+        return 2
+
+    state_dir = None
+    graph = tre = seq = parts_file = None
+    num_parts = 2
+    port = 0
+    host = "127.0.0.1"
+    mode = None
+    balance = 1.03
+    for o, a in opts:
+        if o == "-d":
+            state_dir = a
+        elif o == "-g":
+            graph = a
+        elif o == "-T":
+            tre = a
+        elif o == "-s":
+            seq = a
+        elif o == "-P":
+            parts_file = a
+        elif o == "-k":
+            num_parts = int(a)
+        elif o == "-p":
+            port = int(a)
+        elif o == "-H":
+            host = a
+        elif o == "-m":
+            if a not in POLICIES:
+                print(f"serve: -m {a!r} must be one of "
+                      f"{'/'.join(POLICIES)}")
+                return 2
+            mode = a
+        elif o == "-b":
+            balance = float(a)
+
+    if state_dir is None or args:
+        print(USAGE)
+        return 2
+
+    from ..serve import ServeConfig, ServeCore, ServeDaemon
+    from ..serve.state import snap_paths
+
+    config = ServeConfig.from_env(host=host, port=port)
+    core_kw = dict(snap_every=config.snap_every,
+                   drift_frac=config.drift_frac,
+                   drift_min_cut=config.drift_min_cut)
+    try:
+        bootstrap = not snap_paths(state_dir) if os.path.isdir(state_dir) \
+            else True
+        if bootstrap:
+            if graph is None and tre is None:
+                print(f"serve: {state_dir} holds no snapshots and no "
+                      f"artifacts were given to bootstrap from", flush=True,
+                      file=sys.stderr)
+                return 1
+            core = ServeCore.bootstrap(
+                state_dir, tre_path=tre, seq_path=seq, graph_path=graph,
+                parts_path=parts_file, num_parts=num_parts,
+                balance=balance, integrity=mode, **core_kw)
+        else:
+            core = ServeCore.open(state_dir, integrity=mode, **core_kw)
+    except (IntegrityError, OSError, ValueError) as exc:
+        print(f"serve: {exc}", file=sys.stderr)
+        return 1
+
+    daemon = ServeDaemon(core, config).start()
+    h, p = daemon.address
+    st = core.stats()
+    print(f"serve: listening on {h}:{p}", flush=True)
+    print(f"serve: ready n={st['n']} links={st['links']} "
+          f"applied={st['applied_seqno']} inserted={st['inserted']}",
+          flush=True)
+
+    def _term(signum, frame):
+        daemon.shutdown()
+
+    signal.signal(signal.SIGTERM, _term)
+    signal.signal(signal.SIGINT, _term)
+    try:
+        daemon.run_forever()
+    finally:
+        daemon.shutdown()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

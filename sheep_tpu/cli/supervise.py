@@ -25,6 +25,9 @@ Options:
   --status   read-only operator report of the state dir (leg states,
              dispatch counts, heartbeat ages, disk/mem budget headroom —
              supervisor/status.py) instead of running anything
+  --json     with --status: emit the report as one JSON object so the
+             serve daemon's liveness probe and outside monitors consume
+             it without scraping the table
 
 Exit codes: 0 tournament complete, 1 failure (budget spent / bad state
 dir), 2 usage error.  SHEEP_FAULT_PLAN (see supervisor/chaos.py) injects
@@ -44,13 +47,15 @@ from ..supervisor import (SupervisionFailed, SupervisorConfig,
                           SupervisorKilled, run_supervised)
 
 USAGE = ("USAGE: supervise graph [-d state_dir] [-w workers] [-r reduction]"
-         " [-s seq_file] [-o out_tree] [-t deadline_s] [-v] [--status]")
+         " [-s seq_file] [-o out_tree] [-t deadline_s] [-v] "
+         "[--status [--json]]")
 
 
 def main(argv: list[str] | None = None) -> int:
     argv = sys.argv[1:] if argv is None else argv
     try:
-        opts, args = getopt.gnu_getopt(argv, "d:w:r:s:o:t:v", ["status"])
+        opts, args = getopt.gnu_getopt(argv, "d:w:r:s:o:t:v",
+                                       ["status", "json"])
     except getopt.GetoptError as exc:
         print(f"Unknown option character '{(exc.opt or '?')[:1]}'.")
         return 2
@@ -60,6 +65,7 @@ def main(argv: list[str] | None = None) -> int:
     out_file = None
     verbose = False
     status = False
+    as_json = False
     overrides: dict = {}
     for o, a in opts:
         if o == "-d":
@@ -78,6 +84,8 @@ def main(argv: list[str] | None = None) -> int:
             verbose = True
         elif o == "--status":
             status = True
+        elif o == "--json":
+            as_json = True
 
     if status:
         # --status needs a state dir: given directly, or derived from the
@@ -88,7 +96,11 @@ def main(argv: list[str] | None = None) -> int:
             print(USAGE)
             return 2
         from ..supervisor.status import main_status
-        return main_status(state_dir)
+        return main_status(state_dir, as_json=as_json)
+
+    if as_json:
+        print("supervise: --json only applies to --status")
+        return 2
 
     if len(args) != 1:
         print(USAGE)

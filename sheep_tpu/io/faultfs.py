@@ -13,7 +13,7 @@ Grammar — the I/O sibling of the supervisor's ``SHEEP_FAULT_PLAN``
     entry               = kind @ site : nth
     kind                = enospc | eio | short | slow
     site                = tre | seq | dat | net | sidecar | ckpt |
-                          manifest | other | *
+                          wal | snap | manifest | other | *
     nth                 = 0-based index of the write at that site
 
 e.g. ``SHEEP_IO_FAULT_PLAN=enospc@ckpt:1,short@tre:0``.  Sites are
@@ -57,9 +57,13 @@ IO_FAULT_PLAN_ENV = "SHEEP_IO_FAULT_PLAN"
 KINDS = ("enospc", "eio", "short", "slow")
 
 #: suffix -> site class (checked in order; .sum first so a tree's sidecar
-#: is "sidecar", not "tre")
+#: is "sidecar", not "tre").  ``wal``/``snap`` are the serve daemon's
+#: durability sites (ISSUE 6): the write-ahead log appends and the serving
+#: snapshot seals, so kill/ENOSPC-at-every-insert-boundary recovery is
+#: injectable with the same grammar as every offline site.
 _SITE_SUFFIXES = ((".sum", "sidecar"), (".tre", "tre"), (".seq", "seq"),
-                  (".dat", "dat"), (".net", "net"), (".npz", "ckpt"))
+                  (".dat", "dat"), (".net", "net"), (".npz", "ckpt"),
+                  (".wal", "wal"), (".snap", "snap"))
 
 _ATTEMPT_RE = re.compile(r"\.a\d+$")
 

@@ -1,0 +1,49 @@
+"""sheep serve: the crash-safe long-lived partition service (ISSUE 6).
+
+Until now every caller paid a cold build; this package keeps the tree +
+partition resident and answers over a line protocol, with incremental
+edge inserts folded in by the same union-find transform the batch build
+uses — WAL-first, so nothing acknowledged is ever lost.
+
+  wal.py        checksummed, fsync'd write-ahead log (torn-tail policy)
+  state.py      ServeCore: snapshot format, recovery (snapshot+replay),
+                the incremental insert transform, queries, drift-
+                triggered repartition
+  admission.py  slot + memory-pressure shedding (inserts shed first,
+                read-only under pressure)
+  protocol.py   the wire grammar + reference client
+  daemon.py     sockets, deadlines, fault hooks, heartbeat liveness
+  faults.py     SHEEP_SERVE_FAULT_PLAN (kill/hang/slow @ request sites)
+
+Operational face: ``bin/serve`` / ``sheep_tpu.cli.serve``; state dirs
+are fsck-able (``sheep fsck state-dir/`` knows .wal and .snap).
+"""
+
+from .admission import AdmissionController, Overloaded, ReadOnly
+from .daemon import ServeConfig, ServeDaemon
+from .faults import (SERVE_FAULT_PLAN_ENV, ServeKilled,
+                     parse_serve_fault_plan)
+from .protocol import ServeClient, ServeError, connect_retry
+from .state import ServeCore, ecv_down, insert_link
+from .wal import WalAppender, create_wal, read_wal, repair_wal
+
+__all__ = [
+    "AdmissionController",
+    "Overloaded",
+    "ReadOnly",
+    "SERVE_FAULT_PLAN_ENV",
+    "ServeClient",
+    "ServeConfig",
+    "ServeCore",
+    "ServeDaemon",
+    "ServeError",
+    "ServeKilled",
+    "WalAppender",
+    "connect_retry",
+    "create_wal",
+    "ecv_down",
+    "insert_link",
+    "parse_serve_fault_plan",
+    "read_wal",
+    "repair_wal",
+]

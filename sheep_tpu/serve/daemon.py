@@ -1,0 +1,358 @@
+"""The long-lived partition service: sockets around a ServeCore.
+
+Thread shapes: one accept loop, one handler thread per connection (each
+connection serializes its own requests — the batching unit is the line),
+one optional background repartition thread, and the supervisor-machinery
+heartbeat (supervisor/heartbeat.HeartbeatWriter beating
+``<state-dir>/serve.hb``) so the same ``is_stale`` deadline the
+tournament supervisor applies to workers answers "is the daemon alive?"
+for outside monitors — including `sheep supervise --status --json`
+consumers watching a shared state tree.
+
+Request lifecycle (the order is the contract)::
+
+    read line -> parse -> admission slot -> fault hooks (serve/faults:
+    req/query/insert sites) -> deadline check -> dispatch -> respond
+
+Admission holds its slot across the fault hooks on purpose: an injected
+``slow``/``hang`` occupies capacity exactly like a real slow client, so
+the shedding paths are exercised by the same plan grammar that kills the
+process.  The deadline check runs AFTER the hooks — a handler that lost
+its budget answers ``ERR timeout``, it does not answer late.
+
+Every insert is durable (WAL fsync) before its ``OK`` leaves the process;
+a kill -9 anywhere in the lifecycle loses at most inserts that were never
+acknowledged — the restart contract tests/test_serve.py and the tier-1
+smoke enforce.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import sys
+import threading
+import time
+from dataclasses import dataclass, field
+
+from ..resources.errors import ResourceError
+from ..supervisor.heartbeat import HeartbeatWriter, maybe_start_from_env
+from . import faults as serve_faults
+from .admission import AdmissionController, AdmissionRefused
+from .protocol import (MAX_LINE, BadRequest, err_line, ok_kv, ok_line,
+                       parse_request, parse_vids)
+from .state import ServeCore
+
+ADDR_FILE = "serve.addr"
+HEARTBEAT_FILE = "serve.hb"
+
+DEADLINE_ENV = "SHEEP_SERVE_DEADLINE_S"
+MAX_INFLIGHT_ENV = "SHEEP_SERVE_MAX_INFLIGHT"
+SNAP_EVERY_ENV = "SHEEP_SERVE_SNAP_EVERY"
+DRIFT_ENV = "SHEEP_SERVE_DRIFT"
+DRIFT_MIN_ENV = "SHEEP_SERVE_DRIFT_MIN"
+
+
+@dataclass
+class ServeConfig:
+    host: str = "127.0.0.1"
+    port: int = 0            # 0: ephemeral, discover via serve.addr
+    deadline_s: float = 30.0
+    max_inflight: int = 64
+    snap_every: int = 256
+    drift_frac: float = 0.1
+    drift_min_cut: int = 64
+    read_only: bool = False
+    #: ceiling on how long an injected hang may stall a handler
+    hang_cap_s: float = 2.0
+    events: list = field(default_factory=list)
+
+    @classmethod
+    def from_env(cls, **overrides) -> "ServeConfig":
+        kw: dict = {}
+        if os.environ.get(DEADLINE_ENV):
+            kw["deadline_s"] = float(os.environ[DEADLINE_ENV])
+        if os.environ.get(MAX_INFLIGHT_ENV):
+            kw["max_inflight"] = int(os.environ[MAX_INFLIGHT_ENV])
+        if os.environ.get(SNAP_EVERY_ENV):
+            kw["snap_every"] = int(os.environ[SNAP_EVERY_ENV])
+        if os.environ.get(DRIFT_ENV):
+            kw["drift_frac"] = float(os.environ[DRIFT_ENV])
+        if os.environ.get(DRIFT_MIN_ENV):
+            kw["drift_min_cut"] = int(os.environ[DRIFT_MIN_ENV])
+        kw.update(overrides)
+        return cls(**kw)
+
+
+class ServeDaemon:
+    """Sockets + admission + deadlines + fault hooks around one core."""
+
+    def __init__(self, core: ServeCore, config: ServeConfig | None = None):
+        self.core = core
+        self.config = config or ServeConfig.from_env()
+        self.admission = AdmissionController(
+            max_inflight=self.config.max_inflight,
+            governor=core.governor,
+            read_only=self.config.read_only)
+        self._listener: socket.socket | None = None
+        self._stop = threading.Event()
+        self._threads: list[threading.Thread] = []
+        self._conns: set = set()
+        self._conns_lock = threading.Lock()
+        self._hb: HeartbeatWriter | None = None
+        self._env_hb = None
+        self._repartitioning = threading.Lock()
+        self.started_at = time.time()
+        self.counters = {"requests": 0, "queries": 0, "inserts": 0,
+                         "shed": 0, "timeouts": 0, "readonly": 0,
+                         "errors": 0, "faults": 0}
+
+    # -- lifecycle ---------------------------------------------------------
+
+    @property
+    def address(self) -> tuple[str, int]:
+        assert self._listener is not None, "daemon not started"
+        return self._listener.getsockname()[:2]
+
+    def start(self) -> "ServeDaemon":
+        """Bind, publish the address, start beating, spawn the accept
+        loop.  Returns self so tests can ``daemon = ServeDaemon(...)
+        .start()``."""
+        self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._listener.bind((self.config.host, self.config.port))
+        self._listener.listen(128)
+        self._listener.settimeout(0.2)
+        host, port = self.address
+        # address discovery for scripts: plain tiny file, rewritten on
+        # every start (ephemeral ports move across restarts)
+        with open(os.path.join(self.core.state_dir, ADDR_FILE), "w") as f:
+            f.write(f"{host} {port}\n")
+        self._hb = HeartbeatWriter(
+            os.path.join(self.core.state_dir, HEARTBEAT_FILE)).start()
+        self._env_hb = maybe_start_from_env()  # supervisor-launched case
+        t = threading.Thread(target=self._accept_loop, daemon=True,
+                             name="serve-accept")
+        t.start()
+        self._threads.append(t)
+        return self
+
+    def run_forever(self) -> None:
+        """Block until :meth:`shutdown` (the CLI foreground mode)."""
+        while not self._stop.wait(0.5):
+            pass
+
+    def shutdown(self) -> None:
+        self._stop.set()
+        if self._listener is not None:
+            try:
+                self._listener.close()
+            except OSError:
+                pass
+        with self._conns_lock:
+            conns = list(self._conns)
+        for c in conns:
+            try:
+                c.close()
+            except OSError:
+                pass
+        if self._hb is not None:
+            self._hb.stop()
+        if self._env_hb is not None:
+            self._env_hb.stop()
+        self.core.close()
+
+    # -- connection handling -----------------------------------------------
+
+    def _accept_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._listener.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return  # listener closed: shutting down
+            with self._conns_lock:
+                self._conns.add(conn)
+            t = threading.Thread(target=self._handle_conn, args=(conn,),
+                                 daemon=True, name="serve-conn")
+            t.start()
+
+    def _handle_conn(self, conn: socket.socket) -> None:
+        conn.settimeout(None)
+        try:
+            rf = conn.makefile("rb")
+            while not self._stop.is_set():
+                line = rf.readline(MAX_LINE + 1)
+                if not line:
+                    return  # client went away
+                if len(line) > MAX_LINE:
+                    self._send(conn, err_line(
+                        "badreq", f"request line exceeds {MAX_LINE} bytes"))
+                    return
+                try:
+                    text = line.decode("ascii").strip()
+                except UnicodeDecodeError:
+                    self._send(conn, err_line("badreq",
+                                              "non-ascii request line"))
+                    continue
+                if not text:
+                    continue
+                resp, close = self._handle_request(text)
+                if not self._send(conn, resp) or close:
+                    return
+        finally:
+            with self._conns_lock:
+                self._conns.discard(conn)
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def _send(self, conn: socket.socket, resp: str) -> bool:
+        try:
+            # replace, never raise: a non-ascii character smuggled into an
+            # error message must not kill the connection handler
+            conn.sendall(resp.encode("ascii", "replace") + b"\n")
+            return True
+        except OSError:
+            return False
+
+    # -- request lifecycle ---------------------------------------------------
+
+    def _handle_request(self, text: str) -> tuple[str, bool]:
+        """One request -> (response line, close-connection?)."""
+        self.counters["requests"] += 1
+        t0 = time.monotonic()
+        try:
+            req = parse_request(text)
+        except BadRequest as exc:
+            self.counters["errors"] += 1
+            return err_line("badreq", str(exc)), False
+        budget = req.deadline_s if req.deadline_s is not None \
+            else self.config.deadline_s
+        deadline = t0 + budget
+        kind = req.kind
+        self.counters["inserts" if kind == "insert" else "queries"] += 1
+        try:
+            with self.admission.admit(kind):
+                # fault hooks run INSIDE admission: an injected hang/slow
+                # occupies a slot exactly like a real slow client
+                hang = max(0.0, min(deadline - time.monotonic() + 0.05,
+                                    self.config.hang_cap_s))
+                if serve_faults.fire("req", hang_s=hang):
+                    self.counters["faults"] += 1
+                if serve_faults.fire(kind, hang_s=hang):
+                    self.counters["faults"] += 1
+                if time.monotonic() > deadline:
+                    self.counters["timeouts"] += 1
+                    return err_line(
+                        "timeout",
+                        f"request exceeded its {budget:g}s deadline "
+                        f"before dispatch"), False
+                return self._dispatch(req, deadline)
+        except BadRequest as exc:
+            # argument-level parse errors surface from dispatch
+            self.counters["errors"] += 1
+            return err_line("badreq", str(exc)), False
+        except AdmissionRefused as exc:
+            if exc.code == "readonly":
+                self.counters["readonly"] += 1
+            else:
+                self.counters["shed"] += 1
+            return err_line(exc.code, str(exc)), False
+        except ResourceError as exc:
+            # WAL append / snapshot refused by the environment: typed,
+            # nothing acknowledged, daemon keeps serving
+            self.counters["errors"] += 1
+            return err_line("unavailable", str(exc)), False
+        except serve_faults.ServeKilled:
+            raise
+        except Exception as exc:  # the one place "internal" is honest
+            self.counters["errors"] += 1
+            print(f"serve: internal error on {text!r}: "
+                  f"{type(exc).__name__}: {exc}", file=sys.stderr,
+                  flush=True)
+            return err_line("internal", f"{type(exc).__name__}: {exc}"), \
+                False
+
+    def _dispatch(self, req, deadline: float) -> tuple[str, bool]:
+        core = self.core
+        verb = req.verb
+        if verb == "PING":
+            return ok_line("pong"), False
+        if verb == "QUIT":
+            return ok_line("bye"), True
+        if verb == "PART":
+            vids = parse_vids(req.args)
+            return ok_line(*[core.part(v) for v in vids]), False
+        if verb == "PARENT":
+            if len(req.args) != 1:
+                raise BadRequest("PARENT wants exactly one vertex")
+            (vid,) = parse_vids(req.args)
+            p = core.parent_vid(vid)
+            return ok_line("absent" if p is None else p), False
+        if verb == "SUBTREE":
+            if len(req.args) != 1:
+                raise BadRequest("SUBTREE wants exactly one vertex")
+            (vid,) = parse_vids(req.args)
+            st = core.subtree(vid)
+            if st is None:
+                return err_line("notfound",
+                                f"vertex {vid} is not in the sequence"), \
+                    False
+            return ok_kv(size=st[0], pst=st[1]), False
+        if verb == "ECV":
+            try:
+                return ok_kv(**core.ecv()), False
+            except RuntimeError as exc:
+                return err_line("unavailable", str(exc)), False
+        if verb == "STATS":
+            rec = core.stats()
+            rec.update(self.counters)
+            rec["inflight"] = self.admission.inflight
+            rec["uptime_s"] = round(time.time() - self.started_at, 3)
+            rec["read_only"] = int(self.admission.read_only
+                                   or core.governor.mem_pressure())
+            return ok_kv(**rec), False
+        if verb == "INSERT":
+            vids = parse_vids(req.args, want_pairs=True)
+            pairs = [(vids[i], vids[i + 1])
+                     for i in range(0, len(vids), 2)]
+            import numpy as np
+            seqno = core.insert(np.asarray(pairs, dtype=np.uint32))
+            if time.monotonic() > deadline:
+                # the insert IS durable and applied; saying "timeout"
+                # now would teach the client to retry a success.  Honest
+                # answer: OK, late — the deadline bounded the wait for
+                # admission+WAL, which it made.
+                pass
+            self._maybe_background_repartition()
+            return ok_kv(seq=seqno, applied=len(pairs)), False
+        if verb == "SNAPSHOT":
+            path = core.seal_snapshot()
+            return ok_kv(snap=os.path.basename(path)), False
+        if verb == "REPARTITION":
+            return ok_kv(**core.repartition()), False
+        raise BadRequest(f"unhandled verb {verb!r}")  # unreachable
+
+    def _maybe_background_repartition(self) -> None:
+        """Kick the drift-triggered repartition exactly once at a time;
+        queries serve the stale partition until the swap (state.py)."""
+        if not self.core.drift_exceeded():
+            return
+        if not self._repartitioning.acquire(blocking=False):
+            return  # one already running
+
+        def work():
+            try:
+                self.core.repartition()
+                self.config.events.append(("repartition",
+                                           self.core.repartitions))
+            finally:
+                self._repartitioning.release()
+
+        t = threading.Thread(target=work, daemon=True,
+                             name="serve-repartition")
+        t.start()
+        self._threads.append(t)

@@ -1,0 +1,227 @@
+"""The serve wire protocol: one line in, one line out, always typed.
+
+Line-oriented text over a stream socket — trivially speakable from any
+language, ``nc``, or a shell heredoc, and every response is a SINGLE line
+so a reader never blocks mid-response.  Requests::
+
+    [DEADLINE=<seconds>] VERB [args...]
+
+    PART v [v...]        -> OK p [p...]          (-1 = vertex has no part)
+    PARENT v             -> OK <vid> | OK root | OK absent
+    SUBTREE v            -> OK size=<n> pst=<w>
+    ECV                  -> OK ecv_down=<n> baseline=<n> drift_cut=<n>
+                            parts=<k>
+    INSERT u v [u v...]  -> OK seq=<wal seqno> applied=<k>
+    STATS                -> OK key=value ...
+    SNAPSHOT             -> OK snap=<filename>
+    REPARTITION          -> OK parts=<k> baseline=<n>
+    PING                 -> OK pong
+    QUIT                 -> OK bye (connection closes)
+
+``DEADLINE=`` overrides the daemon's default per-request deadline; a
+request that cannot finish inside it gets ``ERR timeout ...`` — a typed
+refusal, never a silent stall (the client's clock is the one that
+matters, so the server refuses rather than answers late).
+
+Errors are ``ERR <code> <message>`` with codes::
+
+    badreq      unparseable request (client bug)
+    timeout     deadline exceeded (typed timeout refusal)
+    overload    admission shed this request (retry with backoff)
+    readonly    inserts refused: explicit flag or memory pressure
+    notfound    the named vertex is not in the sequence
+    unavailable a dependency is missing (no graph edges for ECV; the
+                disk refused a WAL append or snapshot)
+    internal    unexpected server-side failure (bug; logged server-side)
+
+PART and INSERT batch naturally: many vertices / edge pairs per line, one
+round-trip.  :class:`ServeClient` is the reference client used by the
+tests, the tier-1 smoke, and scripts/servebench.py.
+"""
+
+from __future__ import annotations
+
+import socket
+import time
+from dataclasses import dataclass, field
+
+#: verbs that read state (admission kind "query")
+QUERY_VERBS = ("PART", "PARENT", "SUBTREE", "ECV", "STATS", "PING")
+#: verbs that mutate state (admission kind "insert", shed first)
+INSERT_VERBS = ("INSERT",)
+#: operator verbs (admitted as queries; SNAPSHOT/REPARTITION do their own
+#: locking in the core)
+ADMIN_VERBS = ("SNAPSHOT", "REPARTITION", "QUIT")
+
+_DEADLINE_PREFIX = "DEADLINE="
+
+#: protocol line-length cap: a request that does not fit is a bad request,
+#: not an invitation to buffer without bound
+MAX_LINE = 1 << 20
+
+
+class BadRequest(Exception):
+    """Unparseable request line; maps to ``ERR badreq``."""
+
+
+class DeadlineExceeded(Exception):
+    """The request ran past its deadline; maps to ``ERR timeout``."""
+
+
+@dataclass
+class Request:
+    verb: str
+    args: list[str] = field(default_factory=list)
+    deadline_s: float | None = None  # None: the daemon default applies
+
+    @property
+    def kind(self) -> str:
+        return "insert" if self.verb in INSERT_VERBS else "query"
+
+
+def parse_request(line: str) -> Request:
+    toks = line.split()
+    if not toks:
+        raise BadRequest("empty request")
+    deadline = None
+    if toks[0].upper().startswith(_DEADLINE_PREFIX):
+        raw = toks[0][len(_DEADLINE_PREFIX):]
+        try:
+            deadline = float(raw)
+        except ValueError:
+            raise BadRequest(f"unparseable deadline {raw!r}")
+        if deadline < 0:
+            raise BadRequest(f"negative deadline {raw!r}")
+        toks = toks[1:]
+        if not toks:
+            raise BadRequest("deadline with no request")
+    verb = toks[0].upper()
+    if verb not in QUERY_VERBS + INSERT_VERBS + ADMIN_VERBS:
+        raise BadRequest(f"unknown verb {toks[0]!r}")
+    return Request(verb=verb, args=toks[1:], deadline_s=deadline)
+
+
+def parse_vids(args: list[str], want_pairs: bool = False) -> list[int]:
+    if not args:
+        raise BadRequest("expected vertex ids")
+    try:
+        vids = [int(a) for a in args]
+    except ValueError:
+        raise BadRequest(f"non-integer vertex id in {args!r}")
+    if any(v < 0 for v in vids):
+        raise BadRequest("negative vertex id")
+    if want_pairs and len(vids) % 2:
+        raise BadRequest(f"INSERT wants u v pairs, got {len(vids)} ids")
+    return vids
+
+
+def ok_line(*fields) -> str:
+    return " ".join(["OK"] + [str(f) for f in fields])
+
+
+def ok_kv(**kv) -> str:
+    return "OK " + " ".join(f"{k}={v}" for k, v in kv.items())
+
+
+def err_line(code: str, msg: str) -> str:
+    return f"ERR {code} " + " ".join(str(msg).split())
+
+
+class ServeError(RuntimeError):
+    """Client-side face of an ``ERR`` response."""
+
+    def __init__(self, code: str, msg: str):
+        super().__init__(f"{code}: {msg}")
+        self.code = code
+        self.detail = msg
+
+
+class ServeClient:
+    """Minimal blocking client for one connection.
+
+    ``request`` returns the raw response line; the typed helpers raise
+    :class:`ServeError` on ``ERR`` so tests and scripts cannot mistake a
+    refusal for data.
+    """
+
+    def __init__(self, host: str, port: int, timeout_s: float = 30.0):
+        self.sock = socket.create_connection((host, port),
+                                             timeout=timeout_s)
+        self._rf = self.sock.makefile("rb")
+
+    def close(self) -> None:
+        try:
+            self._rf.close()
+        finally:
+            self.sock.close()
+
+    def __enter__(self) -> "ServeClient":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    def request(self, line: str) -> str:
+        self.sock.sendall(line.encode("ascii") + b"\n")
+        resp = self._rf.readline()
+        if not resp:
+            raise ConnectionError("server closed the connection "
+                                  "(killed mid-request?)")
+        return resp.decode("ascii").rstrip("\n")
+
+    def _ok(self, line: str) -> list[str]:
+        resp = self.request(line)
+        toks = resp.split()
+        if not toks or toks[0] != "OK":
+            if toks and toks[0] == "ERR":
+                raise ServeError(toks[1] if len(toks) > 1 else "unknown",
+                                 " ".join(toks[2:]))
+            raise ServeError("protocol", f"unparseable response {resp!r}")
+        return toks[1:]
+
+    def ping(self) -> None:
+        self._ok("PING")
+
+    def part(self, vids) -> list[int]:
+        out = self._ok("PART " + " ".join(str(v) for v in vids))
+        return [int(p) for p in out]
+
+    def insert(self, pairs) -> int:
+        """pairs: iterable of (u, v); returns the batch's WAL seqno."""
+        flat = " ".join(f"{int(u)} {int(v)}" for u, v in pairs)
+        out = self._ok("INSERT " + flat)
+        return int(dict(f.split("=", 1) for f in out)["seq"])
+
+    def kv(self, verb: str) -> dict:
+        """STATS / ECV / REPARTITION-style key=value responses."""
+        out = self._ok(verb)
+        rec = {}
+        for f in out:
+            k, _, v = f.partition("=")
+            try:
+                rec[k] = int(v)
+            except ValueError:
+                rec[k] = v
+        return rec
+
+
+def connect_retry(host: str, port: int, timeout_s: float = 30.0,
+                  poll_s: float = 0.05) -> ServeClient:
+    """Connect + PING with retries — the 'wait for the daemon to come
+    (back) up' helper the recovery tests and servebench time."""
+    deadline = time.monotonic() + timeout_s
+    last: Exception | None = None
+    while time.monotonic() < deadline:
+        try:
+            c = ServeClient(host, port, timeout_s=max(1.0, poll_s * 10))
+            try:
+                c.ping()
+                return c
+            except Exception:
+                c.close()
+                raise
+        except (OSError, ServeError) as exc:
+            last = exc
+            time.sleep(poll_s)
+    raise TimeoutError(f"serve daemon at {host}:{port} not answering "
+                       f"after {timeout_s}s ({last})")

@@ -1,0 +1,754 @@
+"""The serve daemon's resident state: snapshot + WAL = the whole truth.
+
+``ServeCore`` owns everything below the socket: the elimination tree
+(parent/pst over jnid space), the vid-indexed partition, the optional
+resident edge list (for exact ECV), the write-ahead log, and the snapshot
+lifecycle.  It is deliberately socket-free so property tests and the fsck
+tool drive the exact code the daemon runs.
+
+**Incremental insert.**  An arriving edge {u, v} maps to a link
+(lo, hi) by sequence position and is folded into the live tree by the
+union-find transform the whole framework is built on (core/forest.py):
+the merge-associativity property says the post-insert tree equals a full
+rebuild over (old links + new link), and because links only ever attach a
+component's max element to a later vertex, that fold has a local form —
+climb lo's parents to its maximal ancestor r below hi (the component
+representative under threshold-hi connectivity), attach ``parent[r] = hi``,
+and re-insert r's displaced old link, whose hi is strictly larger, so the
+cascade terminates at a root ("Work-Efficient Parallel and Incremental
+Graph Connectivity", PAPERS.md — no rebuild).  Absent endpoints follow the
+offline contract (core/forest.edges_to_positions): one endpoint in the
+sequence -> pst-only; both absent or a self-loop -> recorded but inert.
+The transform is deterministic, which is what makes WAL replay
+bit-identical (serve/wal.py).
+
+**Durability order** (every insert): WAL append + fsync -> in-memory
+apply -> acknowledge.  Snapshots seal sidecar-first through the PR-5
+writers (integrity.sidecar.sealed_write, fault site ``snap``), then the
+WAL is atomically replaced by a fresh one; a crash between the two leaves
+already-applied records in the log, which replay skips by seqno.  Restart
+= newest loadable snapshot + replay of records with larger seqnos.
+
+**Partition drift.**  Inserts are counted against the partition they
+arrive under (an insert whose endpoints live in different parts raises
+ECV(down) by at most 1); when the accumulated cut count crosses the drift
+threshold the owner (daemon) runs :meth:`repartition` in the background —
+queries keep answering from the stale-but-consistent partition until the
+new one swaps in atomically under the state lock.
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+import re
+import struct
+import threading
+import warnings
+
+import numpy as np
+
+from .. import INVALID_JNID, INVALID_PART
+from ..core.forest import Forest
+from ..core.sequence import sequence_positions
+from ..integrity.errors import IntegrityError, MalformedArtifact
+from ..integrity.sidecar import resolve_policy, sealed_write, sidecar_path
+from ..partition.tree_partition import (TreePartitionOptions,
+                                        partition_forest)
+from ..resources import ResourceGovernor, gc_orphan_temps
+from ..runtime.snapshot import input_signature
+from . import faults as serve_faults
+from .wal import WalAppender, create_wal, read_wal, repair_wal, wal_path
+
+SNAP_VERSION = 1
+SNAP_RE = re.compile(r"^snap-(\d{12})\.snap$")
+
+#: serve state dirs keep this many sealed snapshots (the live one plus a
+#: fallback the repair policy can reach for if the newest goes bad)
+KEEP_SNAPSHOTS = 2
+
+
+def snap_name(applied_seqno: int) -> str:
+    return f"snap-{applied_seqno:012d}.snap"
+
+
+def snap_paths(state_dir: str) -> list[str]:
+    """Snapshot files in the dir, oldest first (by applied seqno)."""
+    out = []
+    for path in glob.glob(os.path.join(glob.escape(state_dir),
+                                       "snap-*.snap")):
+        if SNAP_RE.match(os.path.basename(path)):
+            out.append(path)
+    return sorted(out)
+
+
+# -- insert payload codec ---------------------------------------------------
+
+_PAIRS_HEAD = struct.Struct("<I")
+
+
+def encode_inserts(pairs: np.ndarray) -> bytes:
+    """(k, 2) uint32 edge array -> WAL record payload."""
+    pairs = np.ascontiguousarray(pairs, dtype="<u4")
+    if pairs.ndim != 2 or pairs.shape[1] != 2:
+        raise ValueError(f"insert batch must be (k, 2), got {pairs.shape}")
+    return _PAIRS_HEAD.pack(len(pairs)) + pairs.tobytes()
+
+
+def decode_inserts(payload: bytes) -> np.ndarray:
+    if len(payload) < _PAIRS_HEAD.size:
+        raise MalformedArtifact(
+            f"insert record payload of {len(payload)} bytes is shorter "
+            f"than its count header")
+    (k,) = _PAIRS_HEAD.unpack_from(payload, 0)
+    body = payload[_PAIRS_HEAD.size:]
+    if len(body) != 8 * k:
+        raise MalformedArtifact(
+            f"insert record claims {k} pairs but carries {len(body)} "
+            f"payload bytes (want {8 * k})")
+    return np.frombuffer(body, dtype="<u4").reshape(k, 2).copy()
+
+
+# -- snapshot format --------------------------------------------------------
+
+
+class ServeSnapshot:
+    """One sealed serving state (see module docstring for why this tuple
+    is complete): tree + partition + cumulative inserted edges + the WAL
+    seqno folded in so far."""
+
+    def __init__(self, seq, parent, pst, parts, num_parts, applied_seqno,
+                 ins_tail, ins_head, drift_cut, baseline_ecv, graph_path,
+                 sig, balance):
+        self.seq = seq
+        self.parent = parent
+        self.pst = pst
+        self.parts = parts
+        self.num_parts = int(num_parts)
+        self.applied_seqno = int(applied_seqno)
+        self.ins_tail = ins_tail
+        self.ins_head = ins_head
+        self.drift_cut = int(drift_cut)
+        self.baseline_ecv = int(baseline_ecv)
+        self.graph_path = graph_path
+        self.sig = sig
+        self.balance = float(balance)
+
+    def validate(self) -> None:
+        problems = []
+        m = len(self.seq)
+        if len(self.parent) != m or len(self.pst) != m:
+            problems.append(
+                f"tree arrays disagree with the sequence: "
+                f"{len(self.parent)} parent / {len(self.pst)} pst / {m} seq")
+        else:
+            linked = self.parent != INVALID_JNID
+            ids = np.arange(m, dtype=np.uint32)
+            if bool((linked & (self.parent >= m)).any()):
+                problems.append("parent pointer out of range")
+            elif bool((linked & (self.parent <= ids)).any()):
+                problems.append("non-monotone parent pointer "
+                                "(parents must be strictly later)")
+        if m and len(self.parts) <= int(self.seq.max()):
+            problems.append(
+                f"partition covers {len(self.parts)} vids but the "
+                f"sequence names vid {int(self.seq.max())}")
+        if len(self.ins_tail) != len(self.ins_head):
+            problems.append(
+                f"inserted-edge arrays disagree: {len(self.ins_tail)} "
+                f"tails vs {len(self.ins_head)} heads")
+        if self.applied_seqno < 0 or self.drift_cut < 0:
+            problems.append("negative counters")
+        if self.num_parts < 1:
+            problems.append(f"num_parts {self.num_parts} < 1")
+        if problems:
+            raise MalformedArtifact(
+                "corrupt serve snapshot — " + "; ".join(problems))
+
+    def nbytes_estimate(self) -> int:
+        return (self.seq.nbytes + self.parent.nbytes + self.pst.nbytes
+                + self.parts.nbytes + self.ins_tail.nbytes
+                + self.ins_head.nbytes + 4096)
+
+
+def save_serve_snapshot(path: str, snap: ServeSnapshot,
+                        governor: ResourceGovernor | None = None) -> None:
+    """Seal one snapshot sidecar-first (integrity.sidecar.sealed_write):
+    a crash or an injected ``snap``-site fault (io/faultfs.py) aborts
+    with the previous snapshot generation intact."""
+    snap.validate()
+    est = snap.nbytes_estimate()
+    gov = governor if governor is not None else ResourceGovernor.from_env()
+    gov.check_dir_budget(os.path.dirname(os.path.abspath(path)) or ".",
+                         est, "serve snapshot")
+    with sealed_write(path, "wb", expect_bytes=est) as f:
+        np.savez(
+            f,
+            version=np.int64(SNAP_VERSION),
+            seq=np.asarray(snap.seq, dtype=np.uint32),
+            parent=np.asarray(snap.parent, dtype=np.uint32),
+            pst=np.asarray(snap.pst, dtype=np.uint32),
+            parts=np.asarray(snap.parts, dtype=np.int64),
+            num_parts=np.int64(snap.num_parts),
+            applied_seqno=np.int64(snap.applied_seqno),
+            ins_tail=np.asarray(snap.ins_tail, dtype=np.uint32),
+            ins_head=np.asarray(snap.ins_head, dtype=np.uint32),
+            drift_cut=np.int64(snap.drift_cut),
+            baseline_ecv=np.int64(snap.baseline_ecv),
+            graph_path=np.str_(snap.graph_path or ""),
+            sig=np.str_(snap.sig),
+            balance=np.float64(snap.balance),
+        )
+
+
+def load_serve_snapshot(path: str,
+                        integrity: str | None = None) -> ServeSnapshot:
+    """Load + fully verify one serve snapshot (also the ``sheep fsck``
+    checker for ``.snap``).  Like runtime checkpoints, a snapshot is
+    never partially salvaged: the checksum check is strict even under the
+    repair policy — repair's graceful path lives in ServeCore.open, which
+    falls back to an older generation."""
+    from ..integrity.sidecar import verify_file
+    mode = resolve_policy(integrity)
+    if mode != "trust":
+        verify_file(path, "strict")
+    try:
+        with np.load(path) as z:
+            if int(z["version"]) != SNAP_VERSION:
+                raise MalformedArtifact(
+                    f"{path}: serve snapshot version {int(z['version'])} "
+                    f"!= supported {SNAP_VERSION}")
+            snap = ServeSnapshot(
+                seq=z["seq"].copy(), parent=z["parent"].copy(),
+                pst=z["pst"].copy(), parts=z["parts"].copy(),
+                num_parts=int(z["num_parts"]),
+                applied_seqno=int(z["applied_seqno"]),
+                ins_tail=z["ins_tail"].copy(), ins_head=z["ins_head"].copy(),
+                drift_cut=int(z["drift_cut"]),
+                baseline_ecv=int(z["baseline_ecv"]),
+                graph_path=str(z["graph_path"]), sig=str(z["sig"]),
+                balance=float(z["balance"]))
+    except IntegrityError:
+        raise
+    except Exception as exc:  # BadZipFile / KeyError / OSError / ValueError
+        raise MalformedArtifact(
+            f"{path}: corrupt serve snapshot "
+            f"({type(exc).__name__}: {exc})")
+    snap.validate()
+    return snap
+
+
+# -- the incremental transform ----------------------------------------------
+
+
+def insert_link(parent: np.ndarray, lo: int, hi: int) -> int:
+    """Fold one link (lo -> hi), lo < hi, into a live parent array.
+
+    Exactly the merge replay localized (module docstring): climb lo to
+    its component representative under threshold-hi connectivity, attach,
+    cascade the displaced link upward.  Returns the number of parent
+    pointers rewritten (0 = the edge was already implied by the tree).
+    """
+    rewrites = 0
+    while True:
+        r = lo
+        while True:
+            p = int(parent[r])
+            if p == INVALID_JNID or p > hi:
+                break
+            if p == hi:
+                return rewrites  # lo's component already hangs off hi
+            r = p
+        if r == hi:
+            return rewrites
+        p = int(parent[r])  # INVALID or > hi: the displaced link
+        parent[r] = hi
+        rewrites += 1
+        if p == INVALID_JNID:
+            return rewrites
+        lo, hi = r, p
+
+
+def ecv_down(parts: np.ndarray, tail: np.ndarray, head: np.ndarray,
+             pos: np.ndarray) -> int:
+    """ECV(down) — distinct (vertex, part-of-earlier-endpoint) pairs
+    beyond each vertex's own, identical to partition.evaluate's
+    ``ecv_down`` field but tolerant of INVALID_PART entries (vids inserted
+    after the sequence was fixed have no part yet; evaluate's balance
+    bincounts would reject them)."""
+    t = tail.astype(np.int64)
+    h = head.astype(np.int64)
+    X = np.concatenate([t, h])
+    Y = np.concatenate([h, t])
+    pos64 = pos.astype(np.int64)
+    pX = parts[X]
+    pY = parts[Y]
+    down = np.where(pos64[X] < pos64[Y], pX, pY)
+    # distinct (X, down) keys; down in [-1, P) so shift by +1 into [0, P]
+    P = int(parts.max(initial=0)) + 1
+    key = X * np.int64(P + 2) + (down + 1)
+    n_active = len(np.unique(X))
+    return int(len(np.unique(key)) - n_active)
+
+
+# -- the core ---------------------------------------------------------------
+
+
+class ServeCore:
+    """Resident serving state + WAL + snapshot lifecycle (socket-free).
+
+    Thread-safe: every public method takes the state lock; the heavy
+    repartition compute runs on copies outside it and swaps in under it.
+    """
+
+    def __init__(self, state_dir: str, snap: ServeSnapshot,
+                 appender: WalAppender,
+                 governor: ResourceGovernor | None = None,
+                 snap_every: int = 256,
+                 drift_frac: float = 0.1,
+                 drift_min_cut: int = 64):
+        self.state_dir = state_dir
+        self.governor = governor if governor is not None \
+            else ResourceGovernor.from_env()
+        self.snap_every = max(1, int(snap_every))
+        self.drift_frac = float(drift_frac)
+        self.drift_min_cut = max(1, int(drift_min_cut))
+        self._lock = threading.RLock()
+        self._wal = appender
+
+        self.seq = np.asarray(snap.seq, dtype=np.uint32)
+        self.parent = np.asarray(snap.parent, dtype=np.uint32).copy()
+        self.pst = np.asarray(snap.pst, dtype=np.uint32).copy()
+        self.parts = np.asarray(snap.parts, dtype=np.int64).copy()
+        self.num_parts = snap.num_parts
+        self.balance = snap.balance
+        self.applied_seqno = snap.applied_seqno
+        self.drift_cut = snap.drift_cut
+        self.baseline_ecv = snap.baseline_ecv
+        self.graph_path = snap.graph_path or None
+        self.sig = snap.sig
+        self.pos = sequence_positions(self.seq,
+                                      max(len(self.parts) - 1, 0))
+        self.ins_tail: list[int] = [int(x) for x in snap.ins_tail]
+        self.ins_head: list[int] = [int(x) for x in snap.ins_head]
+        self._inserts_since_snap = 0
+        self._subtree_cache = None
+        self.repartitions = 0
+        self.snap_failures = 0
+        # repartition ordering: a later-STARTED repartition (newer tree)
+        # must never be overwritten by an earlier-started one finishing
+        # late (the background thread racing a forced REPARTITION)
+        self._repart_ticket = 0
+        self._repart_applied = -1
+
+        self.edges_tail = None
+        self.edges_head = None
+        if self.graph_path:
+            try:
+                from ..io.edges import load_edges
+                el = load_edges(self.graph_path)
+                self.edges_tail = el.tail
+                self.edges_head = el.head
+            except (OSError, IntegrityError) as exc:
+                warnings.warn(
+                    f"serve: graph {self.graph_path} unavailable ({exc}); "
+                    f"ECV queries and drift baselines are disabled")
+                self.graph_path = None
+
+    # -- lifecycle ---------------------------------------------------------
+
+    @classmethod
+    def bootstrap(cls, state_dir: str,
+                  tre_path: str | None = None,
+                  seq_path: str | None = None,
+                  graph_path: str | None = None,
+                  parts_path: str | None = None,
+                  num_parts: int = 2,
+                  balance: float = 1.03,
+                  integrity: str | None = None,
+                  **core_kw) -> "ServeCore":
+        """First start: load artifacts through the strict integrity
+        readers, partition, seal generation 0, create the WAL, then enter
+        through :meth:`open` so bootstrap exercises the exact recovery
+        path every later restart takes."""
+        from ..io.seqfile import read_sequence
+        from ..io.trefile import read_tree
+        if (tre_path is None) != (seq_path is None):
+            raise ValueError("bootstrap needs BOTH -T tree and -s sequence "
+                             "(or neither, with a graph to build from)")
+        if tre_path is None:
+            if graph_path is None:
+                raise ValueError("bootstrap needs a tree+sequence or a "
+                                 "graph to build them from")
+            from ..core.forest import build_forest
+            from ..core.sequence import degree_sequence
+            from ..io.edges import load_edges
+            el = load_edges(graph_path)
+            seq = degree_sequence(el.tail, el.head)
+            forest = build_forest(el.tail, el.head, seq,
+                                  max_vid=el.max_vid)
+            parent, pst = forest.parent, forest.pst_weight
+            max_vid = el.max_vid
+        else:
+            seq = read_sequence(seq_path, binary="auto",
+                                integrity=integrity)
+            parent, pst = read_tree(tre_path, integrity=integrity)
+            if len(parent) != len(seq):
+                raise MalformedArtifact(
+                    f"{tre_path}: tree has {len(parent)} nodes but "
+                    f"{seq_path} orders {len(seq)} vertices — not a pair")
+            max_vid = int(seq.max()) if len(seq) else 0
+            if graph_path is not None:
+                from ..io.edges import load_edges
+                el = load_edges(graph_path)
+                max_vid = max(max_vid, el.max_vid)
+
+        n_v = max_vid + 1 if len(seq) else 0
+        if parts_path is not None:
+            from ..partition.partition import Partition
+            part = Partition.from_file(seq, parts_path)
+            parts = np.full(n_v, INVALID_PART, dtype=np.int64)
+            parts[: len(part.parts)] = part.parts[:n_v]
+            num_parts = part.num_parts
+        else:
+            jparts = partition_forest(
+                Forest(parent, pst), num_parts,
+                TreePartitionOptions(balance_factor=balance))
+            parts = np.full(n_v, INVALID_PART, dtype=np.int64)
+            parts[seq] = jparts
+
+        sig = input_signature(len(seq), seq)
+        baseline = -1
+        if graph_path is not None:
+            pos = sequence_positions(seq, n_v - 1 if n_v else None)
+            baseline = ecv_down(parts, el.tail, el.head, pos)
+
+        os.makedirs(state_dir, exist_ok=True)
+        gc_orphan_temps(state_dir)
+        snap = ServeSnapshot(
+            seq=seq, parent=parent, pst=pst, parts=parts,
+            num_parts=num_parts, applied_seqno=0,
+            ins_tail=np.empty(0, np.uint32), ins_head=np.empty(0, np.uint32),
+            drift_cut=0, baseline_ecv=baseline,
+            graph_path=os.path.abspath(graph_path) if graph_path else "",
+            sig=sig, balance=balance)
+        save_serve_snapshot(os.path.join(state_dir, snap_name(0)), snap)
+        create_wal(wal_path(state_dir), sig)
+        return cls.open(state_dir, integrity=integrity, **core_kw)
+
+    @classmethod
+    def open(cls, state_dir: str, integrity: str | None = None,
+             **core_kw) -> "ServeCore":
+        """Restart: newest loadable snapshot + WAL replay.  strict (the
+        default) refuses a torn WAL or a corrupt newest snapshot; repair
+        truncates the tear / falls back a snapshot generation, warning
+        either way."""
+        mode = resolve_policy(integrity)
+        snaps = snap_paths(state_dir)
+        if not snaps:
+            raise MalformedArtifact(
+                f"{state_dir}: no serve snapshots — not a serve state dir "
+                f"(bootstrap one with `sheep serve -d DIR <artifacts>`)")
+        snap = None
+        errors = []
+        for path in reversed(snaps):
+            try:
+                snap = load_serve_snapshot(path, integrity=mode)
+                break
+            except (IntegrityError, OSError) as exc:
+                errors.append(f"{path}: {exc}")
+                if mode == "strict":
+                    raise
+                warnings.warn(
+                    f"serve: snapshot {path} unusable ({exc}); falling "
+                    f"back a generation")
+        if snap is None:
+            raise MalformedArtifact(
+                f"{state_dir}: every snapshot generation is corrupt — "
+                + "; ".join(errors))
+
+        wpath = wal_path(state_dir)
+        if not os.path.exists(wpath):
+            if mode == "strict":
+                raise MalformedArtifact(
+                    f"{wpath}: WAL missing — any insert acknowledged after "
+                    f"the last snapshot is unrecoverable; repair mode "
+                    f"restarts from the snapshot alone")
+            warnings.warn(f"serve: {wpath} missing; restarting the log "
+                          f"from the snapshot alone (repair mode)")
+            create_wal(wpath, snap.sig)
+        elif mode != "strict":
+            dropped = repair_wal(wpath)
+            if dropped:
+                warnings.warn(f"serve: truncated {dropped} torn byte(s) "
+                              f"off {wpath}")
+        wal_sig, records, _, _ = read_wal(wpath, mode)
+        if wal_sig != snap.sig:
+            raise IntegrityError(
+                f"{wpath}: WAL belongs to a different build input "
+                f"(log sig {wal_sig[:12]}..., snapshot "
+                f"{snap.sig[:12]}...) — refusing to replay")
+
+        appender = WalAppender(wpath, expect_sig=snap.sig)
+        core = cls(state_dir, snap, appender, **core_kw)
+        for seqno, payload in records:
+            if seqno <= core.applied_seqno:
+                continue  # already folded into the snapshot
+            core._apply_pairs(decode_inserts(payload))
+            core.applied_seqno = seqno
+        # A crash between snapshot seal and WAL swap leaves a log whose
+        # last seqno <= applied; new records must still sort AFTER the
+        # snapshot or the next replay would skip them.
+        core._wal.next_seqno = max(core._wal.next_seqno,
+                                   core.applied_seqno + 1)
+        return core
+
+    def close(self) -> None:
+        self._wal.close()
+
+    # -- queries -----------------------------------------------------------
+
+    def part(self, vid: int) -> int:
+        """Part of ``vid`` (INVALID_PART = -1 when the vertex is absent
+        from the partition — including vertices first seen by insert)."""
+        with self._lock:
+            if 0 <= vid < len(self.parts):
+                return int(self.parts[vid])
+            return INVALID_PART
+
+    def parent_vid(self, vid: int):
+        """Parent VERTEX of ``vid`` in the elimination tree: a vid,
+        "root", or None when the vertex is not in the sequence."""
+        with self._lock:
+            if not (0 <= vid < len(self.pos)):
+                return None
+            j = int(self.pos[vid])
+            if j == INVALID_JNID:
+                return None
+            p = int(self.parent[j])
+            if p == INVALID_JNID:
+                return "root"
+            return int(self.seq[p])
+
+    def subtree(self, vid: int):
+        """(size, pst_total) of the subtree rooted at ``vid`` (inclusive),
+        or None when the vertex is not in the sequence.  O(n) on the first
+        query after a mutation, O(1) after (cached aggregates)."""
+        with self._lock:
+            if not (0 <= vid < len(self.pos)):
+                return None
+            j = int(self.pos[vid])
+            if j == INVALID_JNID:
+                return None
+            if self._subtree_cache is None:
+                m = len(self.parent)
+                size = np.ones(m, dtype=np.int64)
+                wsum = self.pst.astype(np.int64)
+                par = self.parent
+                for k in range(m):  # parents strictly later: one pass
+                    p = par[k]
+                    if p != INVALID_JNID:
+                        size[p] += size[k]
+                        wsum[p] += wsum[k]
+                self._subtree_cache = (size, wsum)
+            size, wsum = self._subtree_cache
+            return int(size[j]), int(wsum[j])
+
+    def ecv(self) -> dict:
+        """Exact ECV(down) over (original + inserted) edges under the
+        CURRENT partition, plus the drift accounting.  Raises
+        RuntimeError when no graph edges are resident."""
+        with self._lock:
+            if self.edges_tail is None:
+                raise RuntimeError(
+                    "no graph edges resident (serve was started without a "
+                    "graph); ECV is unavailable")
+            tail, head = self._all_edges()
+            val = ecv_down(self.parts, tail, head, self.pos)
+            return {"ecv_down": val, "baseline": self.baseline_ecv,
+                    "drift_cut": self.drift_cut,
+                    "parts": int(self.parts.max(initial=0)) + 1}
+
+    def stats(self) -> dict:
+        with self._lock:
+            linked = int((self.parent != INVALID_JNID).sum())
+            return {
+                "n": len(self.seq), "links": linked,
+                "vids": len(self.parts),
+                "wal_seqno": self._wal.next_seqno - 1,
+                "applied_seqno": self.applied_seqno,
+                "inserted": len(self.ins_tail),
+                "drift_cut": self.drift_cut,
+                "baseline_ecv": self.baseline_ecv,
+                "repartitions": self.repartitions,
+                "snap_failures": self.snap_failures,
+            }
+
+    def _all_edges(self):
+        ins_t = np.asarray(self.ins_tail, dtype=np.uint32)
+        ins_h = np.asarray(self.ins_head, dtype=np.uint32)
+        if self.edges_tail is None:
+            return ins_t, ins_h
+        return (np.concatenate([self.edges_tail, ins_t]),
+                np.concatenate([self.edges_head, ins_h]))
+
+    # -- inserts -----------------------------------------------------------
+
+    def insert(self, pairs: np.ndarray) -> int:
+        """Accept one batch of edges: WAL first (fsync'd), then apply,
+        then return the batch's seqno for the acknowledgement.  The
+        ``wal`` / ``apply`` fault sites bracket the apply (serve/faults);
+        a DiskExhausted/WriteFault from the append propagates with
+        NOTHING applied or logged — the caller refuses the insert."""
+        pairs = np.ascontiguousarray(pairs, dtype=np.uint32)
+        if pairs.ndim != 2 or pairs.shape[1] != 2:
+            raise ValueError(f"insert batch must be (k, 2), got "
+                             f"{pairs.shape}")
+        with self._lock:
+            seqno = self._wal.append(encode_inserts(pairs))
+            serve_faults.fire("wal")
+            self._apply_pairs(pairs)
+            self.applied_seqno = seqno
+            serve_faults.fire("apply")
+            self._inserts_since_snap += 1
+            if self._inserts_since_snap >= self.snap_every:
+                self.maybe_seal()
+            return seqno
+
+    def _apply_pairs(self, pairs: np.ndarray) -> None:
+        """Fold one decoded batch into the live state (also the WAL
+        replay path — keep it deterministic and side-effect-free beyond
+        the state arrays)."""
+        self._subtree_cache = None
+        for u, v in pairs:
+            u, v = int(u), int(v)
+            self._ensure_vid(max(u, v))
+            self.ins_tail.append(u)
+            self.ins_head.append(v)
+            pu = int(self.pos[u])
+            pv = int(self.pos[v])
+            if pu == pv:
+                continue  # self-loop or both endpoints absent: inert
+            lo, hi = min(pu, pv), max(pu, pv)
+            self.pst[lo] += 1  # pst counts at the present earlier endpoint
+            if hi != INVALID_JNID and hi < len(self.parent):
+                insert_link(self.parent, lo, hi)
+                # drift: a cut insert raises ECV(down) by at most one
+                part_u, part_v = int(self.parts[u]), int(self.parts[v])
+                if part_u != part_v:
+                    self.drift_cut += 1
+
+    def _ensure_vid(self, vid: int) -> None:
+        """Grow the vid-indexed tables over a never-seen vertex (absent
+        from the sequence: pst-only until a future re-sequence)."""
+        if vid < len(self.parts):
+            return
+        grow = vid + 1 - len(self.parts)
+        self.parts = np.concatenate(
+            [self.parts, np.full(grow, INVALID_PART, dtype=np.int64)])
+        self.pos = np.concatenate(
+            [self.pos, np.full(grow, INVALID_JNID, dtype=np.uint32)])
+
+    # -- snapshots ---------------------------------------------------------
+
+    def seal_snapshot(self) -> str:
+        """Seal the current state as a new snapshot generation, swap in a
+        fresh WAL, and GC old generations (keep :data:`KEEP_SNAPSHOTS`).
+        Raises on failure with the previous generation + log intact."""
+        with self._lock:
+            snap = ServeSnapshot(
+                seq=self.seq, parent=self.parent, pst=self.pst,
+                parts=self.parts, num_parts=self.num_parts,
+                applied_seqno=self.applied_seqno,
+                ins_tail=np.asarray(self.ins_tail, dtype=np.uint32),
+                ins_head=np.asarray(self.ins_head, dtype=np.uint32),
+                drift_cut=self.drift_cut, baseline_ecv=self.baseline_ecv,
+                graph_path=self.graph_path or "", sig=self.sig,
+                balance=self.balance)
+            path = os.path.join(self.state_dir,
+                                snap_name(self.applied_seqno))
+            save_serve_snapshot(path, snap, self.governor)
+            # the snapshot is durable: later records are redundant — swap
+            # in a fresh log.  A crash between the two leaves <=applied
+            # records in the old log, which replay skips by seqno.
+            create_wal(wal_path(self.state_dir), self.sig)
+            self._wal.close()
+            self._wal = WalAppender(wal_path(self.state_dir),
+                                    expect_sig=self.sig)
+            self._wal.next_seqno = self.applied_seqno + 1
+            self._inserts_since_snap = 0
+            self._gc_snapshots(keep=KEEP_SNAPSHOTS)
+            return path
+
+    def maybe_seal(self) -> str | None:
+        """Cadence-driven seal that refuses to die: a full disk or an
+        injected snap/ENOSPC fault is counted and the daemon keeps
+        serving off the WAL (which already holds every acked insert)."""
+        try:
+            return self.seal_snapshot()
+        except OSError as exc:
+            self.snap_failures += 1
+            self._inserts_since_snap = 0  # retry at the NEXT cadence
+            warnings.warn(f"serve: snapshot seal failed ({exc}); "
+                          f"continuing on the WAL")
+            return None
+
+    def _gc_snapshots(self, keep: int) -> None:
+        for path in snap_paths(self.state_dir)[:-keep]:
+            for p in (path, sidecar_path(path)):
+                try:
+                    os.unlink(p)
+                except OSError:
+                    pass
+
+    # -- repartition -------------------------------------------------------
+
+    def drift_exceeded(self) -> bool:
+        """Has insert drift crossed the re-partition threshold?  The
+        threshold is ``drift_frac`` of the baseline ECV(down) when one is
+        known, floored at ``drift_min_cut`` cut inserts."""
+        with self._lock:
+            threshold = self.drift_min_cut
+            if self.baseline_ecv > 0:
+                threshold = max(threshold,
+                                int(self.drift_frac * self.baseline_ecv))
+            return self.drift_cut >= threshold
+
+    def repartition(self) -> dict:
+        """Re-run the tree partitioner over the CURRENT tree and swap the
+        new part table in atomically.  The compute runs on copies outside
+        the lock — queries keep answering from the stale partition until
+        the swap."""
+        with self._lock:
+            forest = Forest(self.parent.copy(), self.pst.copy())
+            num_parts = self.num_parts
+            balance = self.balance
+            ticket = self._repart_ticket
+            self._repart_ticket += 1
+        jparts = partition_forest(
+            forest, num_parts, TreePartitionOptions(balance_factor=balance))
+        with self._lock:
+            if ticket <= self._repart_applied:
+                # a repartition that STARTED later (fresher tree) already
+                # swapped in; this stale result must not clobber it
+                return {"parts": int(self.parts.max(initial=0)) + 1,
+                        "baseline_ecv": self.baseline_ecv,
+                        "stale": 1}
+            self._repart_applied = ticket
+            vparts = np.full(len(self.parts), INVALID_PART, dtype=np.int64)
+            vparts[self.seq] = jparts
+            self.parts = vparts
+            self.drift_cut = 0
+            self.repartitions += 1
+            if self.edges_tail is not None:
+                tail, head = self._all_edges()
+                self.baseline_ecv = ecv_down(self.parts, tail, head,
+                                             self.pos)
+            # make the swap durable: without a seal a restart would
+            # serve the PRE-repartition parts (the snapshot's) — legal
+            # (stale-but-consistent) but a silent quality regression.
+            # Best-effort: a full disk keeps the old generation and the
+            # in-memory swap still serves.
+            self.maybe_seal()
+            return {"parts": int(vparts.max(initial=0)) + 1,
+                    "baseline_ecv": self.baseline_ecv}

@@ -73,6 +73,47 @@ def status_rows(manifest: Manifest, now: float | None = None) -> list[dict]:
     return rows
 
 
+def status_json(state_dir: str, integrity: str | None = None,
+                governor: ResourceGovernor | None = None,
+                now: float | None = None) -> dict:
+    """The operator report as one JSON-safe dict (``--status --json``):
+    what the table renders, minus the formatting — so the serve daemon's
+    liveness probe and outside monitors consume leg states, heartbeat
+    ages, and budget headroom without scraping the human table.  Same
+    read-only contract as :func:`render_status`."""
+    manifest = load_manifest(state_dir, integrity)
+    gov = governor if governor is not None else ResourceGovernor.from_env()
+    now = time.time() if now is None else now
+    rows = status_rows(manifest, now)
+    usage = dir_usage(state_dir)
+    rss = rss_bytes()
+    out = {
+        "graph": manifest.graph,
+        "state_dir": state_dir,
+        "workers": manifest.workers,
+        "reduction": manifest.reduction,
+        "done": manifest.done(),
+        "legs_done": sum(1 for r in rows if r["state"] == DONE),
+        "legs_total": len(rows),
+        "dispatches": sum(r["dispatches"] for r in rows),
+        "legs": rows,
+        "disk": {
+            "state_dir_bytes": usage,
+            "free_bytes": disk_free(state_dir),
+            "budget_bytes": gov.disk_budget,
+            "headroom_bytes": (gov.disk_budget - usage
+                               if gov.disk_budget is not None else None),
+        },
+        "mem": {
+            "rss_bytes": rss,
+            "budget_bytes": gov.mem_budget,
+            "headroom_bytes": (gov.mem_budget - rss
+                               if gov.mem_budget is not None else None),
+        },
+    }
+    return out
+
+
 def render_status(state_dir: str, integrity: str | None = None,
                   governor: ResourceGovernor | None = None,
                   now: float | None = None) -> str:
@@ -123,15 +164,23 @@ def render_status(state_dir: str, integrity: str | None = None,
     return "\n".join(lines) + "\n"
 
 
-def main_status(state_dir: str, integrity: str | None = None) -> int:
-    """The CLI face: print the report; exit 0 when the manifest loads
-    (even mid-run), 1 when the state dir has no readable manifest."""
+def main_status(state_dir: str, integrity: str | None = None,
+                as_json: bool = False) -> int:
+    """The CLI face: print the report (human table, or one JSON object
+    with ``--json``); exit 0 when the manifest loads (even mid-run), 1
+    when the state dir has no readable manifest."""
     import sys
     if not os.path.exists(manifest_path(state_dir)):
         print(f"supervise: no manifest in {state_dir}", file=sys.stderr)
         return 1
     try:
-        sys.stdout.write(render_status(state_dir, integrity))
+        if as_json:
+            import json
+            json.dump(status_json(state_dir, integrity), sys.stdout,
+                      indent=2, sort_keys=True)
+            sys.stdout.write("\n")
+        else:
+            sys.stdout.write(render_status(state_dir, integrity))
     except (ValueError, OSError) as exc:
         print(f"supervise: {exc}", file=sys.stderr)
         return 1

@@ -161,7 +161,7 @@ def test_dist_partition_script(mode):
         assert "Reduced in 0.0 seconds." not in proc.stdout
 
 
-def test_dist_partition_script_mesh_multiprocess():
+def test_dist_partition_script_mesh_multiprocess(cpu_multiprocess):
     """`dist-partition.sh -i -r` with SHEEP_PROCS=2: the script launches
     two graph2tree processes joined into one jax.distributed mesh (the
     mpiexec analog) and the quality goldens hold."""

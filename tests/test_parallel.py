@@ -115,7 +115,7 @@ def _two_process_env(repo):
 
 @pytest.mark.parametrize("mode", ["degree", "build", "stream",
                                   "chunked", "chunked_stream"])
-def test_init_distributed_two_process_cpu(tmp_path, mode):
+def test_init_distributed_two_process_cpu(tmp_path, mode, cpu_multiprocess):
     """init_distributed (parallel/mesh.py) joins a real 2-process
     coordination service on CPU — the DCN/multi-host analog of the
     reference's mpiexec across nodes (data/slurm-uk2007).  'degree' runs
@@ -138,7 +138,7 @@ def test_init_distributed_two_process_cpu(tmp_path, mode):
     assert os.path.exists(tmp_path / "ok.1")
 
 
-def test_graph2tree_cli_two_process(tmp_path):
+def test_graph2tree_cli_two_process(tmp_path, cpu_multiprocess):
     """`graph2tree -i -r` under the multi-host launcher contract
     (SHEEP_COORDINATOR/_NUM_PROCESSES/_PROCESS_ID): two processes join one
     mesh, only the leader writes, and the tree is byte-identical to the

@@ -1,0 +1,683 @@
+"""Serve-layer tests (ISSUE 6): WAL torn-tail policy at every byte
+boundary, incremental-insert parity against the batch oracle,
+kill-at-every-insert-boundary recovery, admission/deadline refusals over
+real sockets, ENOSPC-at-snapshot degradation, and insert-then-query
+parity vs a fresh rebuild on hep-th."""
+
+import os
+import shutil
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from sheep_tpu import INVALID_PART
+from sheep_tpu.core.forest import build_forest
+from sheep_tpu.core.sequence import degree_sequence, sequence_positions
+from sheep_tpu.integrity.errors import IntegrityError, MalformedArtifact
+from sheep_tpu.io import faultfs
+from sheep_tpu.io.edges import write_dat
+from sheep_tpu.resources.errors import DiskExhausted, WriteFault
+from sheep_tpu.serve import (ServeClient, ServeConfig, ServeCore,
+                             ServeDaemon, ServeError, ServeKilled,
+                             WalAppender, create_wal,
+                             parse_serve_fault_plan, read_wal, repair_wal)
+from sheep_tpu.serve import faults as serve_faults
+from sheep_tpu.serve.admission import (AdmissionController, Overloaded,
+                                       ReadOnly)
+from sheep_tpu.serve.protocol import BadRequest, parse_request
+from sheep_tpu.serve.state import ecv_down, insert_link
+from sheep_tpu.serve.wal import _HEADER, wal_path
+from sheep_tpu.utils.synth import rmat_edges
+
+from conftest import random_multigraph
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+HEP = os.path.join(REPO, "data", "hep-th.dat")
+
+SIG = "s" * 64
+
+
+@pytest.fixture(autouse=True)
+def _clean_fault_plans():
+    faultfs.clear_plan()
+    serve_faults.clear_plan()
+    yield
+    faultfs.clear_plan()
+    serve_faults.clear_plan()
+
+
+# ---------------------------------------------------------------------------
+# WAL format + torn-tail policy
+# ---------------------------------------------------------------------------
+
+
+def _wal_with_records(path, payloads):
+    create_wal(path, SIG)
+    with WalAppender(path) as w:
+        for p in payloads:
+            w.append(p)
+
+
+def test_wal_roundtrip(tmp_path):
+    p = str(tmp_path / "serve.wal")
+    payloads = [b"alpha", b"", b"x" * 1000]
+    _wal_with_records(p, payloads)
+    sig, records, end, torn = read_wal(p, "strict")
+    assert sig == SIG and not torn
+    assert [r[1] for r in records] == payloads
+    assert [r[0] for r in records] == [1, 2, 3]
+    assert end == os.path.getsize(p)
+    # appender resumes numbering after the existing chain
+    with WalAppender(p, expect_sig=SIG) as w:
+        assert w.next_seqno == 4
+
+
+def test_wal_sig_mismatch_refused(tmp_path):
+    p = str(tmp_path / "serve.wal")
+    _wal_with_records(p, [b"a"])
+    with pytest.raises(IntegrityError):
+        WalAppender(p, expect_sig="t" * 64)
+
+
+def test_wal_torn_at_every_byte_boundary(tmp_path):
+    """The acceptance property: for EVERY truncation point of a 3-record
+    log, strict refuses unless the cut lands exactly on a record
+    boundary, repair salvages exactly the records wholly before the cut,
+    and repair_wal truncates back to that boundary."""
+    full = str(tmp_path / "full.wal")
+    payloads = [b"one", b"twotwo", b"three33"]
+    _wal_with_records(full, payloads)
+    blob = open(full, "rb").read()
+    # record boundaries: header, then cumulative record extents
+    bounds = [_HEADER.size]
+    off = _HEADER.size
+    for p in payloads:
+        off += 16 + len(p)
+        bounds.append(off)
+    assert off == len(blob)
+
+    for cut in range(_HEADER.size, len(blob) + 1):
+        torn_path = str(tmp_path / "torn.wal")
+        with open(torn_path, "wb") as f:
+            f.write(blob[:cut])
+        n_complete = sum(1 for b in bounds if b <= cut) - 1
+        if cut in bounds:
+            sig, records, end, torn = read_wal(torn_path, "strict")
+            assert not torn and len(records) == n_complete
+        else:
+            with pytest.raises(MalformedArtifact):
+                read_wal(torn_path, "strict")
+            with pytest.warns(UserWarning):
+                _, records, end, torn = read_wal(torn_path, "repair")
+            assert torn and len(records) == n_complete
+            assert end == bounds[n_complete]
+            with pytest.warns(UserWarning):
+                dropped = repair_wal(torn_path)
+            assert dropped == cut - bounds[n_complete]
+            # after repair the log is strict-clean with the same prefix
+            _, records2, _, torn2 = read_wal(torn_path, "strict")
+            assert not torn2
+            assert [r[1] for r in records2] == payloads[:n_complete]
+
+
+def test_wal_midchain_corruption_never_repairs(tmp_path):
+    p = str(tmp_path / "serve.wal")
+    _wal_with_records(p, [b"aaaa", b"bbbb", b"cccc"])
+    blob = bytearray(open(p, "rb").read())
+    blob[_HEADER.size + 16 + 1] ^= 0xFF  # payload byte of record 1 of 3
+    open(p, "wb").write(bytes(blob))
+    for mode in ("strict", "repair"):
+        with pytest.raises(MalformedArtifact, match="mid-chain"):
+            read_wal(p, mode)
+
+
+def test_wal_nonmonotone_seqno_refused(tmp_path):
+    import struct
+    import zlib
+    p = str(tmp_path / "serve.wal")
+    create_wal(p, SIG)
+
+    def rec(seqno, payload):
+        head = struct.pack("<QI", seqno, len(payload))
+        crc = zlib.crc32(payload, zlib.crc32(head)) & 0xFFFFFFFF
+        return struct.pack("<QII", seqno, len(payload), crc) + payload
+
+    with open(p, "ab") as f:
+        f.write(rec(5, b"x"))
+        f.write(rec(5, b"y"))
+    with pytest.raises(MalformedArtifact, match="monotone"):
+        read_wal(p, "repair")
+
+
+@pytest.mark.faults
+def test_wal_append_fault_injection(tmp_path):
+    """ENOSPC/EIO/short at the wal site: typed refusal, the log stays
+    strict-clean at the pre-append boundary, and a retry succeeds."""
+    for kind, exc_type in (("enospc", DiskExhausted), ("eio", WriteFault),
+                           ("short", DiskExhausted)):
+        p = str(tmp_path / f"{kind}.wal")
+        _wal_with_records(p, [b"base"])
+        size0 = os.path.getsize(p)
+        faultfs.install_plan(faultfs.parse_io_fault_plan(f"{kind}@wal:0"))
+        with WalAppender(p) as w:
+            with pytest.raises(exc_type):
+                w.append(b"doomed")
+            assert os.path.getsize(p) == size0  # truncated back
+            _, records, _, torn = read_wal(p, "strict")
+            assert not torn and len(records) == 1
+            # the armed entry fired; the retry lands clean
+            assert w.append(b"retry") == 2
+        faultfs.clear_plan()
+        _, records, _, _ = read_wal(p, "strict")
+        assert [r[1] for r in records] == [b"base", b"retry"]
+
+
+# ---------------------------------------------------------------------------
+# incremental insert transform: parity with the batch oracle
+# ---------------------------------------------------------------------------
+
+
+def test_insert_link_property_random_graphs():
+    """Folding edges one at a time through insert_link reproduces the
+    batch build exactly, for any split of any random multigraph."""
+    rng = np.random.default_rng(1234)
+    for _ in range(25):
+        tail, head = random_multigraph(rng)
+        seq = degree_sequence(tail, head)
+        n = len(seq)
+        split = int(rng.integers(0, len(tail) + 1))
+        base = build_forest(tail[:split], head[:split], seq,
+                            max_vid=int(max(tail.max(), head.max())),
+                            impl="python")
+        parent = base.parent.copy()
+        pst = base.pst_weight.astype(np.int64)
+        pos = sequence_positions(seq, int(max(tail.max(), head.max())))
+        for u, v in zip(tail[split:], head[split:]):
+            pu, pv = int(pos[u]), int(pos[v])
+            if pu == pv:
+                continue
+            lo, hi = min(pu, pv), max(pu, pv)
+            pst[lo] += 1
+            if hi < n:
+                insert_link(parent, lo, hi)
+        want = build_forest(tail, head, seq,
+                            max_vid=int(max(tail.max(), head.max())),
+                            impl="python")
+        np.testing.assert_array_equal(parent, want.parent)
+        np.testing.assert_array_equal(pst, want.pst_weight.astype(np.int64))
+
+
+def test_ecv_down_matches_evaluator(tmp_path):
+    """serve's ECV(down) helper must agree with the official evaluator
+    whenever every active vertex has a part (the evaluator's domain)."""
+    from sheep_tpu.partition.evaluate import evaluate_partition
+    from sheep_tpu.partition.partition import Partition
+    from sheep_tpu.core.forest import Forest
+
+    tail, head = rmat_edges(8, 4 << 8, seed=21)
+    seq = degree_sequence(tail, head)
+    forest = build_forest(tail, head, seq)
+    part = Partition.from_forest(seq, Forest(forest.parent,
+                                             forest.pst_weight), 4,
+                                 max_vid=int(max(tail.max(), head.max())))
+    pos = sequence_positions(seq, len(part.parts) - 1)
+    want = evaluate_partition(part.parts, tail, head, seq, 4,
+                              max_vid=len(part.parts) - 1).ecv_down
+    assert ecv_down(part.parts, tail, head, pos) == want
+
+
+# ---------------------------------------------------------------------------
+# core lifecycle: bootstrap / recovery / kill-at-every-insert-boundary
+# ---------------------------------------------------------------------------
+
+
+def _tiny_state(tmp_path, name="state", seed=3, log2=7, parts=3):
+    tail, head = rmat_edges(log2, 4 << log2, seed=seed)
+    g = str(tmp_path / f"{name}.dat")
+    write_dat(g, tail, head)
+    sd = str(tmp_path / name)
+    core = ServeCore.bootstrap(sd, graph_path=g, num_parts=parts)
+    return core, sd, tail, head
+
+
+def test_core_recovery_bit_identical(tmp_path):
+    core, sd, tail, head = _tiny_state(tmp_path)
+    rng = np.random.default_rng(7)
+    ins = rng.integers(0, 140, size=(30, 2)).astype(np.uint32)
+    for row in ins:
+        core.insert(row.reshape(1, 2))
+    core.close()
+    again = ServeCore.open(sd)
+    np.testing.assert_array_equal(again.parent, core.parent)
+    np.testing.assert_array_equal(again.pst, core.pst)
+    np.testing.assert_array_equal(again.parts, core.parts)
+    assert again.applied_seqno == core.applied_seqno == 30
+    assert again.drift_cut == core.drift_cut
+    # and the tree equals the batch rebuild over (original + inserted)
+    at = np.concatenate([tail, ins[:, 0]])
+    ah = np.concatenate([head, ins[:, 1]])
+    want = build_forest(at, ah, core.seq,
+                        max_vid=len(core.parts) - 1)
+    np.testing.assert_array_equal(again.parent, want.parent)
+    again.close()
+
+
+@pytest.mark.faults
+def test_kill_at_every_insert_boundary(tmp_path):
+    """Kill (fault-plan driven) at EVERY insert boundary — before apply
+    (site wal) and before ack (site apply), for every insert index —
+    then recover: the final tree must be bit-identical to the
+    uninterrupted run, with equal ECV(down).  No acknowledged insert is
+    ever lost, and the durable-but-unacked insert at the wal boundary is
+    recovered from the log."""
+    core, sd, tail, head = _tiny_state(tmp_path, name="ref")
+    rng = np.random.default_rng(11)
+    ins = rng.integers(0, 140, size=(6, 2)).astype(np.uint32)
+    for row in ins:
+        core.insert(row.reshape(1, 2))
+    want_parent = core.parent.copy()
+    want_pst = core.pst.copy()
+    want_ecv = core.ecv()["ecv_down"]
+    core.close()
+
+    base_core, base_sd, _, _ = _tiny_state(tmp_path, name="base")
+    base_core.close()
+
+    for site in ("wal", "apply"):
+        for nth in range(len(ins)):
+            sd_n = str(tmp_path / f"kill-{site}-{nth}")
+            shutil.copytree(base_sd, sd_n)
+            victim = ServeCore.open(sd_n)
+            serve_faults.install_plan(parse_serve_fault_plan(
+                f"kill@{site}:{nth}", kill_mode="raise"))
+            killed_at = None
+            for i, row in enumerate(ins):
+                try:
+                    victim.insert(row.reshape(1, 2))
+                except ServeKilled:
+                    killed_at = i
+                    break
+            serve_faults.clear_plan()
+            assert killed_at == nth
+            victim.close()
+            # restart: replay recovers the durable insert, then the
+            # "client" continues with the NOT-yet-durable remainder
+            revived = ServeCore.open(sd_n)
+            assert revived.applied_seqno == nth + 1
+            for row in ins[nth + 1:]:
+                revived.insert(row.reshape(1, 2))
+            np.testing.assert_array_equal(revived.parent, want_parent)
+            np.testing.assert_array_equal(revived.pst, want_pst)
+            assert revived.ecv()["ecv_down"] == want_ecv
+            revived.close()
+
+
+def test_open_strict_refuses_torn_wal_repair_truncates(tmp_path):
+    core, sd, _, _ = _tiny_state(tmp_path)
+    core.insert(np.array([[1, 2]], np.uint32))
+    core.close()
+    # tear the trailing record mid-payload
+    w = wal_path(sd)
+    blob = open(w, "rb").read()
+    open(w, "wb").write(blob[:-3])
+    with pytest.raises(MalformedArtifact):
+        ServeCore.open(sd)  # strict: refused
+    with pytest.warns(UserWarning):
+        revived = ServeCore.open(sd, integrity="repair")
+    # the torn (never-acknowledged) insert is gone; state = snapshot
+    assert revived.applied_seqno == 0
+    _, records, _, torn = read_wal(w, "strict")
+    assert not torn and not records  # physically truncated
+    revived.close()
+
+
+@pytest.mark.faults
+def test_enospc_on_snapshot_keeps_serving(tmp_path):
+    """An injected ENOSPC at the snap site fails the cadence seal; the
+    daemon keeps serving off the WAL and the state stays recoverable."""
+    core, sd, _, _ = _tiny_state(tmp_path)
+    core.snap_every = 2
+    faultfs.install_plan(faultfs.parse_io_fault_plan("enospc@snap:0"))
+    with pytest.warns(UserWarning, match="snapshot seal failed"):
+        core.insert(np.array([[1, 2]], np.uint32))
+        core.insert(np.array([[3, 4]], np.uint32))
+    faultfs.clear_plan()
+    assert core.snap_failures == 1
+    assert core.applied_seqno == 2  # both inserts acked + applied
+    core.close()
+    revived = ServeCore.open(sd)
+    np.testing.assert_array_equal(revived.parent, core.parent)
+    assert revived.applied_seqno == 2
+    revived.close()
+
+
+def test_seal_gc_keeps_two_generations(tmp_path):
+    from sheep_tpu.serve.state import snap_paths
+    core, sd, _, _ = _tiny_state(tmp_path)
+    for i in range(4):
+        core.insert(np.array([[i, i + 1]], np.uint32))
+        core.seal_snapshot()
+    snaps = snap_paths(sd)
+    assert len(snaps) == 2
+    assert snaps[-1].endswith("snap-000000000004.snap")
+    core.close()
+
+
+# ---------------------------------------------------------------------------
+# admission + protocol + deadlines (sockets)
+# ---------------------------------------------------------------------------
+
+
+def test_admission_controller_policy():
+    adm = AdmissionController(max_inflight=4)
+    assert adm.insert_watermark == 2
+    with adm.admit("query"), adm.admit("query"):
+        # 2 in flight: inserts are past their watermark, queries are not
+        with pytest.raises(Overloaded):
+            with adm.admit("insert"):
+                pass
+        with adm.admit("query"):
+            pass
+    assert adm.inflight == 0
+    assert adm.shed == 1
+    ro = AdmissionController(max_inflight=4, read_only=True)
+    with pytest.raises(ReadOnly):
+        with ro.admit("insert"):
+            pass
+    with ro.admit("query"):
+        pass
+
+
+def test_admission_readonly_under_memory_pressure():
+    from sheep_tpu.resources.governor import ResourceGovernor
+    gov = ResourceGovernor(mem_budget=1)  # rss >> 1 byte: hard pressure
+    adm = AdmissionController(max_inflight=4, governor=gov)
+    with pytest.raises(ReadOnly):
+        with adm.admit("insert"):
+            pass
+    with adm.admit("query"):  # reads still served
+        pass
+
+
+def test_parse_request_grammar():
+    r = parse_request("DEADLINE=0.5 PART 1 2 3")
+    assert (r.verb, r.args, r.deadline_s) == ("PART", ["1", "2", "3"], 0.5)
+    assert parse_request("insert 1 2").kind == "insert"
+    for bad in ("", "DEADLINE=x PART 1", "DEADLINE=1", "NOPE 1",
+                "DEADLINE=-1 PING"):
+        with pytest.raises(BadRequest):
+            parse_request(bad)
+
+
+def test_serve_fault_plan_grammar():
+    plan = parse_serve_fault_plan("kill@wal:3, hang@req:0")
+    assert len(plan.faults) == 2
+    for bad in ("kill@wal", "boom@wal:1", "kill@nowhere:1"):
+        with pytest.raises(ValueError):
+            parse_serve_fault_plan(bad)
+
+
+@pytest.fixture
+def daemon(tmp_path):
+    core, sd, tail, head = _tiny_state(tmp_path, name="srv", seed=5)
+    d = ServeDaemon(core, ServeConfig(deadline_s=10.0, max_inflight=2,
+                                      hang_cap_s=0.6)).start()
+    yield d, core, tail, head
+    d.shutdown()
+
+
+def test_daemon_query_insert_roundtrip(daemon):
+    d, core, tail, head = daemon
+    h, p = d.address
+    with ServeClient(h, p) as c:
+        # batched part query, absent vid -> -1
+        parts = c.part([0, 1, 2, 10 ** 6])
+        assert parts[:3] == [core.part(0), core.part(1), core.part(2)]
+        assert parts[3] == INVALID_PART
+        seq1 = c.insert([(2, 9), (3, 7)])
+        assert seq1 == 1
+        st = c.kv("STATS")
+        assert st["applied_seqno"] == 1 and st["inserted"] == 2
+        assert st["read_only"] == 0
+        ecv = c.kv("ECV")
+        assert ecv["ecv_down"] >= 0
+        rep = c.kv("REPARTITION")
+        assert rep["parts"] >= 1
+        sub = c.kv("SUBTREE " + str(int(core.seq[0])))
+        assert sub["size"] >= 1
+        with pytest.raises(ServeError) as ei:
+            c.part([])
+        assert ei.value.code == "badreq"
+        with pytest.raises(ServeError) as ei:
+            c.kv("SUBTREE 999999")
+        assert ei.value.code == "notfound"
+        assert c.request("QUIT") == "OK bye"
+
+
+def test_daemon_deadline_timeout_typed(daemon):
+    d, *_ = daemon
+    h, p = d.address
+    with ServeClient(h, p) as c:
+        resp = c.request("DEADLINE=0 PART 1")
+        assert resp.startswith("ERR timeout")
+        # an injected hang eats the budget -> typed timeout, not a stall
+        serve_faults.install_plan(parse_serve_fault_plan(
+            "hang@query:0", kill_mode="raise"))
+        t0 = time.monotonic()
+        resp = c.request("DEADLINE=0.2 PART 1")
+        assert resp.startswith("ERR timeout")
+        assert time.monotonic() - t0 < 5.0
+        assert d.counters["timeouts"] == 2
+
+
+def test_daemon_slow_client_sheds(daemon):
+    """A hang-faulted request occupies its admission slot; with
+    max_inflight=2 a concurrent query is refused typed-overload."""
+    d, *_ = daemon
+    h, p = d.address
+    serve_faults.install_plan(parse_serve_fault_plan(
+        "hang@query:0,hang@query:1", kill_mode="raise"))
+    results = {}
+
+    def slow(name):
+        with ServeClient(h, p) as c:
+            results[name] = c.request("DEADLINE=0.5 PART 1")
+
+    threads = [threading.Thread(target=slow, args=(f"s{i}",))
+               for i in range(2)]
+    for t in threads:
+        t.start()
+    time.sleep(0.2)  # both hang-faulted requests now hold the 2 slots
+    with ServeClient(h, p) as c:
+        resp = c.request("PART 1")
+    for t in threads:
+        t.join()
+    assert resp.startswith("ERR overload")
+    assert d.admission.shed >= 1
+    for r in results.values():  # the slow requests resolved typed too
+        assert r.startswith(("ERR timeout", "OK"))
+
+
+def test_daemon_readonly_refuses_inserts(tmp_path):
+    core, sd, _, _ = _tiny_state(tmp_path, name="ro")
+    d = ServeDaemon(core, ServeConfig(read_only=True)).start()
+    try:
+        h, p = d.address
+        with ServeClient(h, p) as c:
+            with pytest.raises(ServeError) as ei:
+                c.insert([(1, 2)])
+            assert ei.value.code == "readonly"
+            assert c.part([0])  # queries unaffected
+            assert c.kv("STATS")["read_only"] == 1
+    finally:
+        d.shutdown()
+
+
+def test_daemon_drift_triggers_background_repartition(tmp_path):
+    core, sd, tail, head = _tiny_state(tmp_path, name="drift")
+    core.drift_min_cut = 1
+    core.drift_frac = 0.0001
+    d = ServeDaemon(core, ServeConfig()).start()
+    try:
+        h, p = d.address
+        with ServeClient(h, p) as c:
+            # insert until one lands cut (drift >= threshold)
+            rng = np.random.default_rng(3)
+            for _ in range(50):
+                u, v = rng.integers(0, 100, size=2)
+                c.insert([(int(u), int(v))])
+                if core.drift_cut or core.repartitions:
+                    break
+            deadline = time.monotonic() + 10
+            while core.repartitions == 0 and time.monotonic() < deadline:
+                time.sleep(0.02)
+        assert core.repartitions >= 1
+        assert core.drift_cut == 0  # reset by the swap
+    finally:
+        d.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# the ISSUE acceptance: insert-then-query parity vs a fresh rebuild (hep-th)
+# ---------------------------------------------------------------------------
+
+
+def test_hepth_insert_then_query_parity(tmp_path):
+    """Serve hep-th minus its last 100 records, insert them live, force
+    the repartition, and compare part(v) for EVERY vertex plus ECV(down)
+    against a fresh batch rebuild over the full graph with the same
+    sequence and partitioner parameters."""
+    from sheep_tpu.core.forest import Forest
+    from sheep_tpu.io.edges import load_edges
+    from sheep_tpu.partition.tree_partition import (TreePartitionOptions,
+                                                    partition_forest)
+
+    el = load_edges(HEP)
+    hold = 100
+    bt, bh = el.tail[:-hold], el.head[:-hold]
+    base = str(tmp_path / "hep-base.dat")
+    write_dat(base, bt, bh)
+    sd = str(tmp_path / "hep-state")
+    core = ServeCore.bootstrap(sd, graph_path=base, num_parts=8)
+    d = ServeDaemon(core, ServeConfig()).start()
+    try:
+        h, p = d.address
+        with ServeClient(h, p) as c:
+            held = list(zip(el.tail[-hold:].tolist(),
+                            el.head[-hold:].tolist()))
+            for i in range(0, hold, 20):  # batched inserts
+                c.insert(held[i:i + 20])
+            c.kv("REPARTITION")
+
+            # fresh rebuild: same sequence, same partitioner parameters
+            want_forest = build_forest(el.tail, el.head, core.seq,
+                                       max_vid=el.max_vid)
+            np.testing.assert_array_equal(core.parent, want_forest.parent)
+            np.testing.assert_array_equal(core.pst,
+                                          want_forest.pst_weight)
+            jparts = partition_forest(
+                Forest(want_forest.parent, want_forest.pst_weight), 8,
+                TreePartitionOptions(balance_factor=core.balance))
+            want_parts = np.full(el.max_vid + 1, INVALID_PART, np.int64)
+            want_parts[core.seq] = jparts
+
+            # same part(v) for every vertex, through the wire
+            got = []
+            vids = list(range(el.max_vid + 1))
+            for i in range(0, len(vids), 1024):
+                got.extend(c.part(vids[i:i + 1024]))
+            np.testing.assert_array_equal(np.array(got), want_parts)
+
+            # equal ECV(down)
+            pos = sequence_positions(core.seq, el.max_vid)
+            want_ecv = ecv_down(want_parts, el.tail, el.head, pos)
+            assert c.kv("ECV")["ecv_down"] == want_ecv
+    finally:
+        d.shutdown()
+
+    # and a restart recovers the exact same serving state
+    revived = ServeCore.open(sd)
+    np.testing.assert_array_equal(revived.parent, core.parent)
+    np.testing.assert_array_equal(revived.parts, core.parts)
+    revived.close()
+
+
+# ---------------------------------------------------------------------------
+# the real thing: bin/serve subprocess, kill -9, restart, parity
+# ---------------------------------------------------------------------------
+
+
+def _read_addr(sd, timeout=30.0):
+    deadline = time.monotonic() + timeout
+    addr_file = os.path.join(sd, "serve.addr")
+    while time.monotonic() < deadline:
+        try:
+            host, port = open(addr_file).read().split()
+            return host, int(port)
+        except (OSError, ValueError):
+            time.sleep(0.05)
+    raise TimeoutError("serve.addr never appeared")
+
+
+def _spawn_serve(sd, *args, env_extra=None):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env.update(env_extra or {})
+    return subprocess.Popen(
+        [sys.executable, "-m", "sheep_tpu.cli.serve", "-d", sd, *args],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        env=env, cwd=REPO)
+
+
+@pytest.mark.faults
+def test_serve_cli_kill9_recovery(tmp_path):
+    """The daemon as a real subprocess: bootstrap, insert over the wire,
+    SIGKILL, restart from the same state dir — every acknowledged insert
+    survives and the tree matches the batch oracle."""
+    from sheep_tpu.serve.protocol import connect_retry
+
+    tail, head = rmat_edges(7, 4 << 7, seed=13)
+    g = str(tmp_path / "g.dat")
+    write_dat(g, tail, head)
+    sd = str(tmp_path / "state")
+    proc = _spawn_serve(sd, "-g", g, "-k", "3")
+    try:
+        host, port = _read_addr(sd)
+        c = connect_retry(host, port, timeout_s=30)
+        acked = []
+        rng = np.random.default_rng(5)
+        for _ in range(8):
+            u, v = (int(x) for x in rng.integers(0, 140, size=2))
+            c.insert([(u, v)])
+            acked.append((u, v))
+        c.close()
+    finally:
+        proc.kill()  # SIGKILL: no flush, no atexit
+        proc.wait(timeout=30)
+
+    os.unlink(os.path.join(sd, "serve.addr"))  # stale (ephemeral) port
+    proc2 = _spawn_serve(sd)  # restart: snapshot + WAL replay
+    try:
+        host, port = _read_addr(sd)
+        c = connect_retry(host, port, timeout_s=30)
+        st = c.kv("STATS")
+        assert st["applied_seqno"] == len(acked)
+        assert st["inserted"] == len(acked)
+        # spot-check served parents against the batch oracle
+        at = np.concatenate([tail, np.array([u for u, _ in acked],
+                                            np.uint32)])
+        ah = np.concatenate([head, np.array([v for _, v in acked],
+                                            np.uint32)])
+        core = ServeCore.open(sd)  # read the same state dir directly
+        want = build_forest(at, ah, core.seq, max_vid=len(core.parts) - 1)
+        np.testing.assert_array_equal(core.parent, want.parent)
+        core.close()
+        c.request("QUIT")
+        c.close()
+    finally:
+        proc2.terminate()
+        proc2.wait(timeout=30)

@@ -490,3 +490,36 @@ def test_single_worker_degenerates_to_one_map(small_graph, tmp_path):
     assert manifest.leg("r0.00").output == manifest.final_tree
     parent, _ = read_tree(manifest.final_tree)
     np.testing.assert_array_equal(parent, want.parent)
+
+
+def test_status_json_machine_readable(small_graph, tmp_path, capsys):
+    """`sheep supervise --status --json` (ISSUE 6 satellite): one JSON
+    object with leg states, dispatch counts, and budget headroom — the
+    contract the serve daemon's liveness probe and outside monitors
+    consume instead of scraping the operator table."""
+    import json
+
+    from sheep_tpu.cli.supervise import main as supervise_main
+    from sheep_tpu.supervisor.status import status_json
+
+    graph, tail, head, seq, want = small_graph
+    manifest, _ = _run(graph, tmp_path / "s")
+
+    rec = status_json(str(tmp_path / "s"))
+    assert rec["done"] is True
+    assert rec["legs_done"] == rec["legs_total"] == len(manifest.legs)
+    assert rec["dispatches"] == sum(leg.dispatches for leg in manifest.legs)
+    states = {leg["key"]: leg["state"] for leg in rec["legs"]}
+    assert all(s == "done" for s in states.values())
+    assert rec["disk"]["state_dir_bytes"] > 0
+    assert rec["mem"]["rss_bytes"] > 0
+
+    # the CLI face emits parseable JSON and exits 0
+    capsys.readouterr()  # drop the supervised run's phase grammar
+    rc = supervise_main(["--status", "--json", "-d", str(tmp_path / "s")])
+    out = capsys.readouterr().out
+    assert rc == 0
+    parsed = json.loads(out)
+    assert parsed["legs_total"] == rec["legs_total"]
+    # --json outside --status is a usage error, not a silent ignore
+    assert supervise_main(["--json", graph]) == 2

@@ -20,10 +20,23 @@ REAL ``bin/serve`` subprocess over real sockets:
 The record embeds ``env_capture`` (utils/envinfo.py) like every bench
 artifact since r06, so a slow host explains itself.
 
-Usage: python scripts/servebench.py [graph] [out.json]
-Defaults: data/hep-th.dat, SERVEBENCH_r01.json at the repo root.  All
-published numbers must come from serialized runs on the bench host
-(ROADMAP "Known bench context").
+``--failover`` (SERVEBENCH_r02, ISSUE 7) measures the replicated
+cluster instead: 1 leader + 2 wire-bootstrapped followers over real
+``bin/serve`` subprocesses —
+
+  insert_per_sec_repl       acked insert throughput where every OK is
+                            leader WAL fsync + >=1 follower ack
+  leader_qps / cluster_qps  read scale-out: the same query burst on the
+                            leader alone vs spread over all 3 nodes
+                            concurrently (read_scaleout = ratio)
+  promotion_s               kill -9 the leader at full state -> a
+                            follower reports role=leader (epoch bumped)
+  recovered_applied_seqno   asserted == every acked insert (zero lost)
+
+Usage: python scripts/servebench.py [--failover] [graph] [out.json]
+Defaults: data/hep-th.dat, SERVEBENCH_r01.json (r02 for --failover) at
+the repo root.  All published numbers must come from serialized runs on
+the bench host (ROADMAP "Known bench context").
 """
 
 from __future__ import annotations
@@ -88,11 +101,140 @@ def _query_burst(client, vids, n_requests, batch=16):
     return lat
 
 
+def failover_bench(graph: str, out: str) -> int:
+    """SERVEBENCH_r02: the replicated cluster under load and kill -9."""
+    import tempfile
+    from sheep_tpu.io.edges import load_edges
+
+    n_queries = int(os.environ.get("SERVEBENCH_QUERIES", "2000"))
+    n_inserts = int(os.environ.get("SERVEBENCH_INSERTS", "300"))
+    work = tempfile.mkdtemp(prefix="servebench-r02-")
+    lead_d = os.path.join(work, "lead")
+    fol_ds = [os.path.join(work, f"f{i}") for i in range(2)]
+    el = load_edges(graph)
+    max_vid = el.max_vid
+    vids = list(range(0, max_vid + 1, max(1, (max_vid + 1) // 4096)))
+    rec = {"bench": "SERVEBENCH", "round": 2, "arm": "failover",
+           "graph": graph, "records": el.num_edges,
+           "queries": n_queries, "inserts": n_inserts,
+           "followers": len(fol_ds), "env": env_capture()}
+
+    env = {"SHEEP_SERVE_REPL_HB_S": "0.2", "SHEEP_SERVE_FAILOVER_S": "1"}
+    t0 = time.perf_counter()
+    procs = {}
+    procs["lead"] = _spawn(lead_d, "-g", graph, "-k", "8", "--role",
+                           "leader", "--node-id", "lead", "--peers",
+                           ",".join(fol_ds), env_extra=env)
+    lh, lp = _addr(lead_d)
+    for i, fd in enumerate(fol_ds):
+        peers = ",".join([lead_d] + [d for d in fol_ds if d != fd])
+        procs[f"f{i}"] = _spawn(fd, "--role", "follower", "--node-id",
+                                f"f{i}", "--peers", peers, env_extra=env)
+    c = connect_retry(lh, lp, timeout_s=120)
+    # wait until both followers are attached (bootstrap + stream)
+    deadline = time.monotonic() + 120
+    while time.monotonic() < deadline:
+        if c.kv("STATS").get("followers", 0) == len(fol_ds):
+            break
+        time.sleep(0.2)
+    rec["cluster_start_s"] = round(time.perf_counter() - t0, 3)
+
+    # -- replicated insert throughput (OK = leader fsync + >=1 f-ack) ----
+    pairs = [((7 * i) % (max_vid + 1), (13 * i + 1) % (max_vid + 1))
+             for i in range(n_inserts)]
+    t0 = time.perf_counter()
+    for i in range(0, n_inserts, 10):
+        c.insert(pairs[i:i + 10])
+    rec["insert_per_sec_repl"] = round(
+        n_inserts / (time.perf_counter() - t0), 1)
+    acked_batches = (n_inserts + 9) // 10
+
+    # -- read scale-out: leader-only vs all three nodes ------------------
+    t0 = time.perf_counter()
+    lat = _query_burst(c, vids, n_queries)
+    rec["leader_qps"] = round(n_queries / (time.perf_counter() - t0), 1)
+    rec["leader_p50_ms"], rec["leader_p99_ms"] = _quantiles(lat)
+    addrs = [(lh, lp)] + [_addr(fd) for fd in fol_ds]
+    counts = [0] * len(addrs)
+    stop = threading.Event()
+
+    def reader(k):
+        with ServeClient(*addrs[k]) as rc:
+            i = 0
+            while not stop.is_set():
+                batch = [vids[(i * 16 + j) % len(vids)]
+                         for j in range(16)]
+                rc.part(batch)
+                counts[k] += 1
+                i += 1
+
+    threads = [threading.Thread(target=reader, args=(k,), daemon=True)
+               for k in range(len(addrs))]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    time.sleep(max(2.0, n_queries / max(rec["leader_qps"], 1.0)))
+    stop.set()
+    wall = time.perf_counter() - t0
+    for t in threads:
+        t.join(timeout=10)
+    rec["cluster_qps"] = round(sum(counts) / wall, 1)
+    rec["read_scaleout"] = round(rec["cluster_qps"]
+                                 / max(rec["leader_qps"], 1e-9), 2)
+    total_acked = c.kv("STATS")["applied_seqno"]
+    rec["acked_before_kill"] = total_acked
+
+    # -- kill -9 the leader: time to promoted follower -------------------
+    c.close()
+    procs["lead"].kill()
+    procs["lead"].wait(timeout=60)
+    os.unlink(os.path.join(lead_d, "serve.addr"))
+    t0 = time.perf_counter()
+    promoted = None
+    deadline = time.monotonic() + 120
+    while promoted is None and time.monotonic() < deadline:
+        for fd in fol_ds:
+            try:
+                with ServeClient(*_addr(fd, timeout=5)) as fc:
+                    st = fc.kv("STATS")
+                    if st.get("role") == "leader":
+                        promoted = (fd, st)
+                        break
+            except Exception:
+                continue
+        time.sleep(0.05)
+    assert promoted is not None, "no follower promoted"
+    rec["promotion_s"] = round(time.perf_counter() - t0, 3)
+    rec["promoted_epoch"] = promoted[1]["epoch"]
+    rec["recovered_applied_seqno"] = promoted[1]["applied_seqno"]
+    assert promoted[1]["applied_seqno"] == total_acked, \
+        f"acked inserts lost: {promoted[1]['applied_seqno']} != " \
+        f"{total_acked}"
+    del acked_batches
+    for name, p in procs.items():
+        if name != "lead":
+            p.send_signal(signal.SIGTERM)
+            p.wait(timeout=60)
+
+    with open(out, "w") as f:
+        json.dump(rec, f, indent=1)
+        f.write("\n")
+    print(json.dumps({k: v for k, v in rec.items() if k != "env"},
+                     indent=1))
+    print(f"servebench: failover record written to {out}")
+    return 0
+
+
 def main() -> int:
-    graph = sys.argv[1] if len(sys.argv) > 1 \
+    args = [a for a in sys.argv[1:] if a != "--failover"]
+    failover = "--failover" in sys.argv[1:]
+    graph = args[0] if len(args) > 0 \
         else os.path.join(REPO, "data", "hep-th.dat")
-    out = sys.argv[2] if len(sys.argv) > 2 \
-        else os.path.join(REPO, "SERVEBENCH_r01.json")
+    default_out = "SERVEBENCH_r02.json" if failover \
+        else "SERVEBENCH_r01.json"
+    out = args[1] if len(args) > 1 else os.path.join(REPO, default_out)
+    if failover:
+        return failover_bench(graph, out)
     n_queries = int(os.environ.get("SERVEBENCH_QUERIES", "2000"))
     n_inserts = int(os.environ.get("SERVEBENCH_INSERTS", "500"))
 

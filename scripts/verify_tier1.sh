@@ -257,4 +257,97 @@ then
 fi
 # -------------------------------------------------------------------------
 
+# --- failover smoke (replicated serve, ISSUE 7) --------------------------
+# A real 2-node cluster of bin/serve subprocesses: wire-bootstrapped
+# follower, synchronously-replicated inserts, kill -9 the leader, assert
+# the follower promotes (epoch bumped) with ZERO acked inserts lost and
+# identical answers, then the fenced ex-leader rejoins as a follower and
+# write availability returns.  Seconds of work; a regression anywhere in
+# the replication/failover stack fails the gate before pytest even runs.
+if ! python - <<'EOF'
+import os, signal, subprocess, sys, tempfile, time
+REPO = os.getcwd()
+sys.path.insert(0, REPO)
+from sheep_tpu.io.edges import write_dat
+from sheep_tpu.serve.protocol import ServeClient, connect_retry
+from sheep_tpu.utils.synth import rmat_edges
+
+work = tempfile.mkdtemp()
+tail, head = rmat_edges(7, 4 << 7, seed=29)
+write_dat(work + "/g.dat", tail, head)
+lead_d, fol_d = work + "/lead", work + "/fol"
+env = dict(os.environ)
+env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+env["SHEEP_SERVE_REPL_HB_S"] = "0.1"
+env["SHEEP_SERVE_FAILOVER_S"] = "1"
+
+def addr(d, timeout=60.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        try:
+            host, port = open(d + "/serve.addr").read().split()
+            return host, int(port)
+        except (OSError, ValueError):
+            time.sleep(0.05)
+    raise SystemExit(f"{d}/serve.addr never appeared")
+
+def spawn(d, *args):
+    return subprocess.Popen(
+        [sys.executable, "-m", "sheep_tpu.cli.serve", "-d", d, *args],
+        env=env, cwd=REPO)
+
+lead = spawn(lead_d, "-g", work + "/g.dat", "-k", "3",
+             "--role", "leader", "--node-id", "lead", "--peers", fol_d)
+lh, lp = addr(lead_d)
+fol = spawn(fol_d, "--role", "follower", "--node-id", "fol",
+            "--peers", lead_d)
+c = connect_retry(lh, lp, timeout_s=60)
+deadline = time.monotonic() + 60
+while c.kv("STATS").get("followers", 0) < 1:
+    assert time.monotonic() < deadline, "follower never attached"
+    time.sleep(0.1)
+for i in range(5):  # every OK = leader fsync + follower ack
+    c.insert([(int(tail[i]), int(head[(i + 3) % len(head)]))])
+pre_parts = c.part(list(range(100)))
+assert c.kv("STATS")["applied_seqno"] == 5
+c.close()
+lead.send_signal(signal.SIGKILL)   # kill -9: no flush, no goodbye
+lead.wait(timeout=60)
+os.unlink(lead_d + "/serve.addr")
+
+fc = connect_retry(*addr(fol_d), timeout_s=60)
+deadline = time.monotonic() + 60
+while fc.kv("STATS").get("role") != "leader":
+    assert time.monotonic() < deadline, "follower never promoted"
+    time.sleep(0.1)
+st = fc.kv("STATS")
+assert st["applied_seqno"] == 5, ("acked insert lost across failover", st)
+assert st["epoch"] == 1, ("promotion must bump the epoch", st)
+assert fc.part(list(range(100))) == pre_parts, "promoted parts diverged"
+
+# fenced ex-leader rejoins: demotes, catches up, restores write quorum
+ex = spawn(lead_d, "--role", "leader", "--node-id", "lead",
+           "--peers", fol_d)
+deadline = time.monotonic() + 60
+while fc.kv("STATS").get("followers", 0) < 1:
+    assert time.monotonic() < deadline, "ex-leader never rejoined"
+    time.sleep(0.1)
+fc.insert([(int(tail[7]), int(head[2]))])  # write availability is back
+assert fc.kv("STATS")["applied_seqno"] == 6
+ec = connect_retry(*addr(lead_d), timeout_s=60)
+st = ec.kv("STATS")
+assert st["role"] == "follower", ("ex-leader split-brained", st)
+ec.request("QUIT"); ec.close()
+fc.request("QUIT"); fc.close()
+for p in (ex, fol):
+    p.send_signal(signal.SIGTERM)
+    p.wait(timeout=60)
+EOF
+then
+  echo "FAILOVER SMOKE FAILED: leader kill -9 did not promote a" \
+       "lossless fenced follower" >&2
+  exit 1
+fi
+# -------------------------------------------------------------------------
+
 set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=${PIPESTATUS[0]}; echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c); exit $rc

@@ -1,4 +1,4 @@
-"""sheep serve: run the crash-safe partition service.
+"""sheep serve: run the crash-safe, replicated partition service.
 
 No reference counterpart — the reference answers nothing without a cold
 build; this daemon keeps the tree + partition resident and serves
@@ -8,13 +8,18 @@ line protocol (sheep_tpu.serve.protocol).
     bin/serve -d state/ -g graph.dat -k 8          # bootstrap + serve
     bin/serve -d state/ -T g.tre -s g.seq -g g.dat # serve existing build
     bin/serve -d state/                            # restart: snapshot+WAL
+    bin/serve -d lead/ -g g.dat --role leader --peers f1/,f2/
+    bin/serve -d f1/ --role follower --peers lead/,f2/   # joins + streams
 
 First start (artifact flags given) bootstraps the state dir: artifacts
 load through the strict integrity readers, generation-0 snapshot seals
 sidecar-first, an empty WAL is created.  Restart (no artifact flags)
 recovers: newest loadable snapshot + WAL replay — bit-identical to the
 pre-crash tree; a torn trailing WAL record is refused in strict mode and
-truncated under ``-m repair``.
+truncated under ``-m repair``.  A FOLLOWER with an empty state dir and
+no artifacts bootstraps over the wire instead: it fetches the leader's
+sealed snapshot (crc-verified, resealed locally) and then tails the WAL
+stream (serve/replicate.py).
 
 Options:
   -d DIR     state dir (required): snapshots + WAL + serve.addr/serve.hb
@@ -28,11 +33,21 @@ Options:
   -H HOST    bind host (default 127.0.0.1)
   -m MODE    integrity policy for recovery: strict (default) / repair
   -b F       partition balance factor (default 1.03)
+  --role R   leader | follower (default SHEEP_SERVE_ROLE or leader)
+  --peers L  comma list of peers: host:port, a peer's state dir, or an
+             addr file (default SHEEP_SERVE_PEERS)
+  --node-id N  this node's id for election tie-breaks and lag reports
+             (default SHEEP_SERVE_NODE_ID or host:port)
 
 Env: SHEEP_SERVE_DEADLINE_S, SHEEP_SERVE_MAX_INFLIGHT,
 SHEEP_SERVE_SNAP_EVERY, SHEEP_SERVE_DRIFT, SHEEP_SERVE_DRIFT_MIN,
-SHEEP_SERVE_FAULT_PLAN (serve/faults.py), SHEEP_IO_FAULT_PLAN sites
-``wal``/``snap``, SHEEP_MEM_BUDGET (read-only degradation).
+SHEEP_SERVE_ROLE, SHEEP_SERVE_PEERS, SHEEP_SERVE_NODE_ID,
+SHEEP_SERVE_REPL_ACKS (follower acks per insert OK, default 1),
+SHEEP_SERVE_REPL_HB_S, SHEEP_SERVE_FAILOVER_S, SHEEP_SERVE_MAX_LAG
+(bounded staleness for follower reads), SHEEP_SERVE_FAULT_PLAN
+(serve/faults.py), SHEEP_SERVE_NETFAULT_PLAN (serve/netfaults.py),
+SHEEP_IO_FAULT_PLAN sites ``wal``/``snap``, SHEEP_MEM_BUDGET (read-only
+degradation).
 
 Exit codes: 0 clean shutdown, 1 startup/recovery failure, 2 usage error.
 """
@@ -49,13 +64,15 @@ from ..integrity.sidecar import POLICIES
 
 USAGE = ("USAGE: serve -d state_dir [-g graph] [-T tree -s seq] [-P parts]"
          " [-k num_parts] [-p port] [-H host] [-m strict|repair]"
-         " [-b balance]")
+         " [-b balance] [--role leader|follower] [--peers p1,p2]"
+         " [--node-id id]")
 
 
 def main(argv: list[str] | None = None) -> int:
     argv = sys.argv[1:] if argv is None else argv
     try:
-        opts, args = getopt.gnu_getopt(argv, "d:g:T:s:P:k:p:H:m:b:")
+        opts, args = getopt.gnu_getopt(argv, "d:g:T:s:P:k:p:H:m:b:",
+                                       ["role=", "peers=", "node-id="])
     except getopt.GetoptError as exc:
         print(f"Unknown option character '{(exc.opt or '?')[:1]}'.")
         return 2
@@ -67,6 +84,7 @@ def main(argv: list[str] | None = None) -> int:
     host = "127.0.0.1"
     mode = None
     balance = 1.03
+    cluster_kw: dict = {}
     for o, a in opts:
         if o == "-d":
             state_dir = a
@@ -92,21 +110,56 @@ def main(argv: list[str] | None = None) -> int:
             mode = a
         elif o == "-b":
             balance = float(a)
+        elif o == "--role":
+            cluster_kw["role"] = a.strip().lower()
+        elif o == "--peers":
+            cluster_kw["peers"] = [p.strip() for p in a.split(",")
+                                   if p.strip()]
+        elif o == "--node-id":
+            cluster_kw["node_id"] = a.strip()
 
     if state_dir is None or args:
         print(USAGE)
         return 2
 
-    from ..serve import ServeConfig, ServeCore, ServeDaemon
+    from ..serve import (ClusterConfig, ServeConfig, ServeCore,
+                         ServeDaemon)
     from ..serve.state import snap_paths
 
     config = ServeConfig.from_env(host=host, port=port)
+    try:
+        cluster = ClusterConfig.from_env(**cluster_kw)
+    except ValueError as exc:
+        print(f"serve: {exc}", file=sys.stderr)
+        return 2
     core_kw = dict(snap_every=config.snap_every,
                    drift_frac=config.drift_frac,
                    drift_min_cut=config.drift_min_cut)
     try:
         bootstrap = not snap_paths(state_dir) if os.path.isdir(state_dir) \
             else True
+        if bootstrap and graph is None and tre is None \
+                and cluster.clustered and cluster.role == "follower":
+            # over-the-wire bootstrap: fetch the leader's snapshot, then
+            # enter through the exact restart path
+            from ..serve.cluster import find_leader
+            from ..serve.replicate import bootstrap_state_dir
+            found = None
+            deadline = 60.0
+            import time as _time
+            t0 = _time.monotonic()
+            while found is None and _time.monotonic() - t0 < deadline:
+                found = find_leader(cluster.peers,
+                                    cluster.poll_timeout_s)
+                if found is None:
+                    _time.sleep(0.2)
+            if found is None:
+                print(f"serve: follower bootstrap found no reachable "
+                      f"leader among {cluster.peers}", file=sys.stderr)
+                return 1
+            lhost, _, lport = found[0].rpartition(":")
+            bootstrap_state_dir(state_dir, lhost, int(lport))
+            bootstrap = False
         if bootstrap:
             if graph is None and tre is None:
                 print(f"serve: {state_dir} holds no snapshots and no "
@@ -123,11 +176,12 @@ def main(argv: list[str] | None = None) -> int:
         print(f"serve: {exc}", file=sys.stderr)
         return 1
 
-    daemon = ServeDaemon(core, config).start()
+    daemon = ServeDaemon(core, config, cluster=cluster).start()
     h, p = daemon.address
     st = core.stats()
     print(f"serve: listening on {h}:{p}", flush=True)
-    print(f"serve: ready n={st['n']} links={st['links']} "
+    print(f"serve: ready role={daemon.role} epoch={st['epoch']} "
+          f"n={st['n']} links={st['links']} "
           f"applied={st['applied_seqno']} inserted={st['inserted']}",
           flush=True)
 

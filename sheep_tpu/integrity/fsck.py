@@ -69,33 +69,91 @@ def _fsck_npz(path: str, mode: str) -> str:
 
 
 def _fsck_wal(path: str, mode: str) -> str:
-    """Verify the serve WAL chain: header magic/version, per-record
-    crc32, strictly monotone sequence numbers, and — when a sibling
-    snapshot generation is readable — that the log and snapshot belong to
-    the same build input (the snapshot+WAL recovery chain, ISSUE 6).
-    Strict refuses a torn tail; repair reports the salvageable prefix."""
+    """Verify the serve WAL chain: header magic/version/epoch,
+    per-record crc32, strictly monotone sequence numbers, and the
+    cross-artifact chain (ISSUES 6+7) — the log and its sibling
+    snapshot must name the same build input, a log whose epoch differs
+    from the snapshot's must respect the promotion boundary (an
+    earlier-epoch log may never reach PAST the later epoch's sealed
+    seqno: that is a fenced ex-leader's divergent tail), and two sibling
+    logs of different epochs must cover disjoint seqno ranges.  Strict
+    refuses a torn tail; repair reports the salvageable prefix."""
     from ..serve.wal import read_wal
 
-    sig, records, _, torn = read_wal(path, mode)
+    sig, epoch, records, _, torn = read_wal(path, mode)
     last = records[-1][0] if records else 0
-    detail = f"records={len(records)} last_seqno={last}"
+    first = records[0][0] if records else 0
+    detail = f"records={len(records)} last_seqno={last} epoch={epoch}"
     if torn:
         detail += " torn_tail=truncatable"
-    # chain check against the newest loadable sibling snapshot
+    here = os.path.dirname(path) or "."
+    # chain check against the newest loadable sibling snapshot — by
+    # epoch first: a promotion crash window can leave the later term
+    # under a lower applied-seqno filename (serve/state.py open)
     from ..serve.state import load_serve_snapshot, snap_paths
-    for snap_path in reversed(snap_paths(os.path.dirname(path) or ".")):
+    best = None
+    for snap_path in snap_paths(here):
         try:
             snap = load_serve_snapshot(snap_path, integrity="trust")
         except (IntegrityError, OSError):
             continue
+        if best is None or ((snap.epoch, snap.applied_seqno)
+                            > (best[1].epoch, best[1].applied_seqno)):
+            best = (snap_path, snap)
+    if best is not None:
+        snap_path, snap = best
         if snap.sig != sig:
             raise MalformedArtifact(
                 f"{path}: WAL signature {sig[:12]}... does not match "
                 f"snapshot {os.path.basename(snap_path)} "
                 f"({snap.sig[:12]}...) — log and snapshot are not one "
                 f"recovery chain")
+        if epoch < snap.epoch and records and last > snap.applied_seqno:
+            raise MalformedArtifact(
+                f"{path}: cross-epoch seqno overlap — the epoch-{epoch} "
+                f"log reaches seqno {last}, past the epoch-{snap.epoch} "
+                f"snapshot boundary {snap.applied_seqno} "
+                f"({os.path.basename(snap_path)}); a fenced log may "
+                f"never extend a later epoch's history")
+        if epoch > snap.epoch:
+            raise MalformedArtifact(
+                f"{path}: WAL epoch {epoch} is ahead of every readable "
+                f"snapshot (newest is epoch {snap.epoch}, "
+                f"{os.path.basename(snap_path)}) — the promotion that "
+                f"sealed epoch {epoch} left no loadable snapshot; the "
+                f"chain cannot replay across that boundary")
         detail += f" chain={os.path.basename(snap_path)}"
-        break
+    # sibling logs: different epochs must cover DISJOINT seqno ranges
+    # (the archived pre-promotion log vs the live one)
+    from ..serve.wal import archived_wal_paths, wal_path
+    siblings = set(archived_wal_paths(here))
+    live = wal_path(here)
+    if os.path.exists(live):
+        siblings.add(live)
+    siblings.discard(os.path.abspath(path))
+    siblings.discard(path)
+    for other in sorted(siblings):
+        try:
+            o_sig, o_epoch, o_records, _, _ = read_wal(other, "repair")
+        except (IntegrityError, OSError):
+            continue  # the sibling fails on its own fsck line
+        if o_sig != sig:
+            raise MalformedArtifact(
+                f"{path}: sibling log {os.path.basename(other)} names a "
+                f"different build input ({o_sig[:12]}... vs "
+                f"{sig[:12]}...) — one state dir, two histories")
+        if o_epoch == epoch or not records or not o_records:
+            continue
+        o_first, o_last = o_records[0][0], o_records[-1][0]
+        lo_last, hi_first = ((last, o_first) if epoch < o_epoch
+                             else (o_last, first))
+        if hi_first <= lo_last:
+            raise MalformedArtifact(
+                f"{path}: cross-epoch seqno overlap with "
+                f"{os.path.basename(other)} — epoch {min(epoch, o_epoch)}"
+                f" ends at seqno {lo_last} but epoch "
+                f"{max(epoch, o_epoch)} starts at {hi_first}; epochs "
+                f"must hand off disjoint seqno ranges")
     return detail
 
 
@@ -106,7 +164,7 @@ def _fsck_snap(path: str, mode: str) -> str:
     from .. import INVALID_JNID
     links = int((snap.parent != INVALID_JNID).sum())
     return (f"n={len(snap.seq)} links={links} "
-            f"applied={snap.applied_seqno} "
+            f"applied={snap.applied_seqno} epoch={snap.epoch} "
             f"inserted={len(snap.ins_tail)} parts={snap.num_parts}")
 
 

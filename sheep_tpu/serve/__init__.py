@@ -1,36 +1,56 @@
-"""sheep serve: the crash-safe long-lived partition service (ISSUE 6).
+"""sheep serve: the crash-safe, replicated partition service (ISSUES 6+7).
 
 Until now every caller paid a cold build; this package keeps the tree +
 partition resident and answers over a line protocol, with incremental
 edge inserts folded in by the same union-find transform the batch build
-uses — WAL-first, so nothing acknowledged is ever lost.
+uses — WAL-first, so nothing acknowledged is ever lost.  ISSUE 7 ships
+that WAL to followers: a leader streams acked records over the same
+protocol, followers apply them through the same insert path and serve
+reads, and failover is epoch-fenced promotion — no acknowledged insert
+dies with the leader.
 
-  wal.py        checksummed, fsync'd write-ahead log (torn-tail policy)
+  wal.py        checksummed, fsync'd, epoch-stamped write-ahead log
   state.py      ServeCore: snapshot format, recovery (snapshot+replay),
                 the incremental insert transform, queries, drift-
-                triggered repartition
+                triggered repartition, replicated apply + epoch fences
   admission.py  slot + memory-pressure shedding (inserts shed first,
                 read-only under pressure)
-  protocol.py   the wire grammar + reference client
-  daemon.py     sockets, deadlines, fault hooks, heartbeat liveness
+  protocol.py   the wire grammar + reference client (REPL verbs)
+  daemon.py     selectors I/O loop, deadlines, fault hooks, heartbeat
+                liveness, cluster roles
+  replicate.py  WAL shipping: frame codec, leader hub, follower applier
+  cluster.py    membership, leader discovery, epoch-fenced failover
   faults.py     SHEEP_SERVE_FAULT_PLAN (kill/hang/slow @ request sites)
+  netfaults.py  SHEEP_SERVE_NETFAULT_PLAN (drop/partition/slow/dup @
+                replication frame sites)
 
 Operational face: ``bin/serve`` / ``sheep_tpu.cli.serve``; state dirs
-are fsck-able (``sheep fsck state-dir/`` knows .wal and .snap).
+are fsck-able (``sheep fsck state-dir/`` knows .wal and .snap, including
+epoch chains across promotion boundaries).
 """
 
 from .admission import AdmissionController, Overloaded, ReadOnly
+from .cluster import ClusterConfig, choose_successor, find_leader
 from .daemon import ServeConfig, ServeDaemon
 from .faults import (SERVE_FAULT_PLAN_ENV, ServeKilled,
                      parse_serve_fault_plan)
+from .netfaults import NETFAULT_PLAN_ENV, parse_netfault_plan
 from .protocol import ServeClient, ServeError, connect_retry
-from .state import ServeCore, ecv_down, insert_link
+from .replicate import (ReplApplier, ReplicationHub, Replicator,
+                        bootstrap_state_dir, encode_append, parse_frame)
+from .state import (ReplicationGap, ServeCore, ecv_down, insert_link)
 from .wal import WalAppender, create_wal, read_wal, repair_wal
 
 __all__ = [
     "AdmissionController",
+    "ClusterConfig",
+    "NETFAULT_PLAN_ENV",
     "Overloaded",
     "ReadOnly",
+    "ReplApplier",
+    "ReplicationGap",
+    "ReplicationHub",
+    "Replicator",
     "SERVE_FAULT_PLAN_ENV",
     "ServeClient",
     "ServeConfig",
@@ -39,10 +59,16 @@ __all__ = [
     "ServeError",
     "ServeKilled",
     "WalAppender",
+    "bootstrap_state_dir",
+    "choose_successor",
     "connect_retry",
     "create_wal",
     "ecv_down",
+    "encode_append",
+    "find_leader",
     "insert_link",
+    "parse_frame",
+    "parse_netfault_plan",
     "parse_serve_fault_plan",
     "read_wal",
     "repair_wal",

@@ -1,18 +1,22 @@
 """The long-lived partition service: sockets around a ServeCore.
 
-Thread shapes: one accept loop, one handler thread per connection (each
-connection serializes its own requests — the batching unit is the line),
-one optional background repartition thread, and the supervisor-machinery
-heartbeat (supervisor/heartbeat.HeartbeatWriter beating
-``<state-dir>/serve.hb``) so the same ``is_stale`` deadline the
-tournament supervisor applies to workers answers "is the daemon alive?"
-for outside monitors — including `sheep supervise --status --json`
-consumers watching a shared state tree.
+Thread shapes (ISSUE 7 replaced the PR-6 thread-per-connection model):
+ONE ``selectors``-based I/O loop owns every socket — accept, non-blocking
+reads, buffered non-blocking writes — and hands complete request lines to
+a bounded worker pool.  One slow client can no longer stall anything: a
+reader that sends bytes slowly only delays its own lines, a client that
+stops draining responses fills its own bounded output buffer and is
+disconnected, and replication peers are just more registered sockets on
+the same loop.  Each connection still serializes its OWN requests (the
+batching unit is the line; responses never reorder), but connections are
+fully independent.
 
 Request lifecycle (the order is the contract)::
 
-    read line -> parse -> admission slot -> fault hooks (serve/faults:
-    req/query/insert sites) -> deadline check -> dispatch -> respond
+    io loop: read line -> queue on the connection
+    worker:  parse -> admission slot -> fault hooks (serve/faults:
+             req/query/insert sites) -> deadline check -> dispatch
+    io loop: flush the response
 
 Admission holds its slot across the fault hooks on purpose: an injected
 ``slow``/``hang`` occupies capacity exactly like a real slow client, so
@@ -20,37 +24,64 @@ the shedding paths are exercised by the same plan grammar that kills the
 process.  The deadline check runs AFTER the hooks — a handler that lost
 its budget answers ``ERR timeout``, it does not answer late.
 
+Replication (serve/replicate.py) rides the same loop: a follower's
+``REPL HELLO`` converts its connection into a push stream owned by the
+:class:`ReplicationHub`; inbound ACK/NACK/FENCED lines route straight to
+the hub without touching admission.  Roles: a ``leader`` accepts writes
+(each insert waits for ``repl_acks`` follower acknowledgements before
+its OK when the cluster is configured — an acknowledged insert is on at
+least that many replicas, which is what makes failover lossless); a
+``follower`` serves reads with a bounded-staleness guarantee and
+redirects writes with ``ERR notleader <addr>``.  Failover transitions
+(serve/cluster.py) are epoch-fenced: promotion seals the boundary
+durably before the first write, and a fenced ex-leader demotes instead
+of split-braining.
+
 Every insert is durable (WAL fsync) before its ``OK`` leaves the process;
 a kill -9 anywhere in the lifecycle loses at most inserts that were never
 acknowledged — the restart contract tests/test_serve.py and the tier-1
-smoke enforce.
+smoke enforce, now cluster-wide (tests/test_replicate.py).
 """
 
 from __future__ import annotations
 
+import json
 import os
+import selectors
 import socket
 import sys
 import threading
 import time
+from collections import deque
+from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass, field
 
 from ..resources.errors import ResourceError
 from ..supervisor.heartbeat import HeartbeatWriter, maybe_start_from_env
 from . import faults as serve_faults
 from .admission import AdmissionController, AdmissionRefused
+from .cluster import ClusterConfig, FailoverWatcher, find_leader
 from .protocol import (MAX_LINE, BadRequest, err_line, ok_kv, ok_line,
-                       parse_request, parse_vids)
+                       parse_kv_args, parse_request, parse_vids)
+from .replicate import ReplicationHub, Replicator, payload_crc
 from .state import ServeCore
 
 ADDR_FILE = "serve.addr"
 HEARTBEAT_FILE = "serve.hb"
+STATUS_FILE = "serve.status.json"
 
 DEADLINE_ENV = "SHEEP_SERVE_DEADLINE_S"
 MAX_INFLIGHT_ENV = "SHEEP_SERVE_MAX_INFLIGHT"
 SNAP_EVERY_ENV = "SHEEP_SERVE_SNAP_EVERY"
 DRIFT_ENV = "SHEEP_SERVE_DRIFT"
 DRIFT_MIN_ENV = "SHEEP_SERVE_DRIFT_MIN"
+
+#: a connection whose un-flushed responses exceed this is a slow
+#: consumer and is closed (replication peers get snapshot-sized room)
+OUTBUF_CAP = 8 << 20
+#: per-connection queued-line backpressure: past this many undrained
+#: requests the loop stops READING that connection until it catches up
+PENDING_CAP = 256
 
 
 @dataclass
@@ -84,28 +115,70 @@ class ServeConfig:
         return cls(**kw)
 
 
-class ServeDaemon:
-    """Sockets + admission + deadlines + fault hooks around one core."""
+class _Conn:
+    """One client on the I/O loop.  All mutable fields are guarded by
+    the daemon's ``_io_lock`` except ``inbuf``, which only the loop
+    thread touches."""
 
-    def __init__(self, core: ServeCore, config: ServeConfig | None = None):
+    __slots__ = ("sock", "inbuf", "outbuf", "pending", "busy", "repl",
+                 "paused", "close_after_flush", "abort", "closed",
+                 "outbuf_cap")
+
+    def __init__(self, sock: socket.socket):
+        self.sock = sock
+        self.inbuf = bytearray()
+        self.outbuf = bytearray()
+        self.pending: deque = deque()
+        self.busy = False          # a worker owns this conn's queue
+        self.repl = False          # converted to a replication stream
+        self.paused = False        # read interest dropped (backpressure)
+        self.close_after_flush = False
+        self.abort = False         # close NOW, drop unflushed bytes
+        self.closed = False
+        self.outbuf_cap = OUTBUF_CAP
+
+
+class ServeDaemon:
+    """Selectors loop + worker pool + admission + deadlines + fault
+    hooks + replication roles around one core."""
+
+    def __init__(self, core: ServeCore, config: ServeConfig | None = None,
+                 cluster: ClusterConfig | None = None):
         self.core = core
         self.config = config or ServeConfig.from_env()
+        self.cluster = cluster or ClusterConfig.from_env()
+        self.role = self.cluster.role
+        self.node_id = self.cluster.node_id  # finalized at bind
         self.admission = AdmissionController(
             max_inflight=self.config.max_inflight,
             governor=core.governor,
             read_only=self.config.read_only)
         self._listener: socket.socket | None = None
+        self._sel: selectors.DefaultSelector | None = None
+        self._wake_r: socket.socket | None = None
+        self._wake_w: socket.socket | None = None
+        self._io_thread: threading.Thread | None = None
+        self._pool: ThreadPoolExecutor | None = None
         self._stop = threading.Event()
-        self._threads: list[threading.Thread] = []
-        self._conns: set = set()
-        self._conns_lock = threading.Lock()
+        self._io_lock = threading.Lock()
+        self._conns: dict[int, _Conn] = {}
+        self._dirty: set[int] = set()
         self._hb: HeartbeatWriter | None = None
         self._env_hb = None
         self._repartitioning = threading.Lock()
-        self.started_at = time.time()
+        self._role_lock = threading.RLock()
+        self.started_at = time.monotonic()
+        self._status_written = 0.0
         self.counters = {"requests": 0, "queries": 0, "inserts": 0,
                          "shed": 0, "timeouts": 0, "readonly": 0,
-                         "errors": 0, "faults": 0}
+                         "errors": 0, "faults": 0, "notleader": 0,
+                         "stale": 0, "repl_quorum_fails": 0}
+        self.hub = ReplicationHub(core, send=self._send_async,
+                                  close=self._abort_async,
+                                  hb_s=self.cluster.hb_s,
+                                  on_fenced=self._on_fenced)
+        self.replicator: Replicator | None = None
+        self.watcher: FailoverWatcher | None = None
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -115,15 +188,17 @@ class ServeDaemon:
         return self._listener.getsockname()[:2]
 
     def start(self) -> "ServeDaemon":
-        """Bind, publish the address, start beating, spawn the accept
-        loop.  Returns self so tests can ``daemon = ServeDaemon(...)
-        .start()``."""
+        """Bind, publish the address, start beating, spawn the I/O loop
+        and worker pool, join the cluster.  Returns self so tests can
+        ``daemon = ServeDaemon(...).start()``."""
         self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._listener.bind((self.config.host, self.config.port))
         self._listener.listen(128)
-        self._listener.settimeout(0.2)
+        self._listener.setblocking(False)
         host, port = self.address
+        if not self.node_id:
+            self.node_id = f"{host}:{port}"
         # address discovery for scripts: plain tiny file, rewritten on
         # every start (ephemeral ports move across restarts)
         with open(os.path.join(self.core.state_dir, ADDR_FILE), "w") as f:
@@ -131,10 +206,40 @@ class ServeDaemon:
         self._hb = HeartbeatWriter(
             os.path.join(self.core.state_dir, HEARTBEAT_FILE)).start()
         self._env_hb = maybe_start_from_env()  # supervisor-launched case
-        t = threading.Thread(target=self._accept_loop, daemon=True,
-                             name="serve-accept")
-        t.start()
-        self._threads.append(t)
+
+        self._sel = selectors.DefaultSelector()
+        self._sel.register(self._listener, selectors.EVENT_READ, "accept")
+        self._wake_r, self._wake_w = socket.socketpair()
+        self._wake_r.setblocking(False)
+        self._wake_w.setblocking(False)
+        self._sel.register(self._wake_r, selectors.EVENT_READ, "wakeup")
+        # spare threads past the slot budget so a request that will be
+        # REFUSED by admission always finds a thread to refuse it on —
+        # that is what keeps "ERR overload" prompt while hang-faulted
+        # requests squat on their slots
+        self._pool = ThreadPoolExecutor(
+            max_workers=self.config.max_inflight + 8,
+            thread_name_prefix="serve-worker")
+        self._io_thread = threading.Thread(target=self._io_loop,
+                                           daemon=True, name="serve-io")
+        self._io_thread.start()
+
+        if self.cluster.clustered:
+            if self.role == "leader":
+                # a returning ex-leader must discover its fencing BEFORE
+                # accepting a single write (split-brain rejoin guard)
+                other = find_leader(self.cluster.peers,
+                                    self.cluster.poll_timeout_s,
+                                    min_epoch=self.core.epoch + 1)
+                if other is not None:
+                    self.role = "follower"
+                    self.config.events.append(
+                        ("fenced_at_start",
+                         int(other[1].get("epoch", 0))))
+            if self.role == "follower":
+                self._start_replicator()
+            self.watcher = FailoverWatcher(self, self.cluster).start()
+        self._write_status(force=True)
         return self
 
     def run_forever(self) -> None:
@@ -144,81 +249,455 @@ class ServeDaemon:
 
     def shutdown(self) -> None:
         self._stop.set()
+        self._wake()
+        if self.watcher is not None:
+            self.watcher.stop()
+        if self.replicator is not None:
+            self.replicator.stop()
+        self.hub.stop()
+        if self._io_thread is not None:
+            self._io_thread.join(timeout=5.0)
+        if self._pool is not None:
+            self._pool.shutdown(wait=False)
         if self._listener is not None:
             try:
                 self._listener.close()
-            except OSError:
-                pass
-        with self._conns_lock:
-            conns = list(self._conns)
-        for c in conns:
-            try:
-                c.close()
             except OSError:
                 pass
         if self._hb is not None:
             self._hb.stop()
         if self._env_hb is not None:
             self._env_hb.stop()
+        self._write_status(force=True)
         self.core.close()
 
-    # -- connection handling -----------------------------------------------
+    # -- cluster role transitions ------------------------------------------
 
-    def _accept_loop(self) -> None:
+    def _start_replicator(self) -> None:
+        if self.replicator is not None:
+            return
+        self.replicator = Replicator(
+            self.core, self.node_id, self._discover_leader,
+            hb_s=self.cluster.hb_s,
+            events=self.config.events).start()
+
+    def _discover_leader(self) -> tuple[str, int] | None:
+        """Replication discovery: the reachable peer that is leader at
+        our epoch or later (a stale-epoch claimant is ignored)."""
+        found = find_leader(self.cluster.peers,
+                            self.cluster.poll_timeout_s,
+                            min_epoch=self.core.epoch)
+        if found is None:
+            return None
+        host, _, port = found[0].rpartition(":")
+        return host, int(port)
+
+    def leader_addr(self) -> str:
+        """Where writes should go, as ``host:port`` (``-`` unknown)."""
+        if self.role == "leader":
+            h, p = self.address
+            return f"{h}:{p}"
+        rep = self.replicator
+        if rep is not None and rep.connected_to is not None:
+            return f"{rep.connected_to[0]}:{rep.connected_to[1]}"
+        return "-"
+
+    def promote(self, new_epoch: int) -> None:
+        """Epoch-fenced promotion (the election winner's side): stop
+        following, seal the boundary DURABLY, only then start taking
+        writes.  A failed seal leaves this node a follower."""
+        with self._role_lock:
+            if self.role == "leader" or self._stop.is_set():
+                return
+            if self.replicator is not None:
+                self.replicator.stop()
+                self.replicator = None
+            self.core.advance_epoch(new_epoch)
+            self.role = "leader"
+            self.config.events.append(("promote", new_epoch))
+            self._write_status(force=True)
+
+    def demote(self, leader_addr: str | None, fenced_by: int) -> None:
+        """The fence: a later epoch exists, this node's term is over.
+        Drop the follower streams (they must rediscover the real
+        leader) and rejoin as a follower; any divergent unacknowledged
+        tail is rolled back by snapshot re-sync on reconnect."""
+        with self._role_lock:
+            if self.role == "follower" or self._stop.is_set():
+                return
+            self.role = "follower"
+            self.hub.disconnect_all()
+            self.config.events.append(("demote", fenced_by))
+            self._start_replicator()
+            self._write_status(force=True)
+
+    def _on_fenced(self, fenced_by: int) -> None:
+        """Hub callback: a follower answered REPL FENCED — a later
+        epoch exists even if no peer poll has seen it yet."""
+        self.demote(None, fenced_by)
+
+    # -- the I/O loop ------------------------------------------------------
+
+    def _wake(self) -> None:
+        try:
+            self._wake_w.send(b"x")
+        except (OSError, AttributeError):
+            pass
+
+    def _send_async(self, conn: _Conn, data: bytes) -> bool:
+        """Queue bytes for one connection (any thread).  False = the
+        connection is gone or over its buffer cap (slow consumer)."""
+        with self._io_lock:
+            if conn.closed or conn.abort:
+                return False
+            if len(conn.outbuf) + len(data) > conn.outbuf_cap:
+                conn.abort = True  # slow consumer: cut it loose
+                self._dirty.add(id(conn))
+                self._wake()
+                return False
+            conn.outbuf.extend(data)
+            self._dirty.add(id(conn))
+        self._wake()
+        return True
+
+    def _abort_async(self, conn: _Conn) -> None:
+        with self._io_lock:
+            conn.abort = True
+            self._dirty.add(id(conn))
+        self._wake()
+
+    def _io_loop(self) -> None:
+        sel = self._sel
         while not self._stop.is_set():
             try:
-                conn, _ = self._listener.accept()
-            except socket.timeout:
-                continue
+                events = sel.select(0.2)
             except OSError:
-                return  # listener closed: shutting down
-            with self._conns_lock:
-                self._conns.add(conn)
-            t = threading.Thread(target=self._handle_conn, args=(conn,),
-                                 daemon=True, name="serve-conn")
-            t.start()
-
-    def _handle_conn(self, conn: socket.socket) -> None:
-        conn.settimeout(None)
+                break
+            for key, mask in events:
+                if key.data == "accept":
+                    self._accept()
+                elif key.data == "wakeup":
+                    try:
+                        while self._wake_r.recv(4096):
+                            pass
+                    except OSError:
+                        pass
+                else:
+                    conn: _Conn = key.data
+                    if mask & selectors.EVENT_READ:
+                        self._on_readable(conn)
+                    if mask & selectors.EVENT_WRITE and not conn.closed:
+                        self._on_writable(conn)
+            self._apply_dirty()
+            self._write_status()
+        # shutdown: close everything the loop owns
+        for conn in list(self._conns.values()):
+            self._close_conn(conn)
         try:
-            rf = conn.makefile("rb")
-            while not self._stop.is_set():
-                line = rf.readline(MAX_LINE + 1)
-                if not line:
-                    return  # client went away
-                if len(line) > MAX_LINE:
-                    self._send(conn, err_line(
-                        "badreq", f"request line exceeds {MAX_LINE} bytes"))
-                    return
-                try:
-                    text = line.decode("ascii").strip()
-                except UnicodeDecodeError:
-                    self._send(conn, err_line("badreq",
-                                              "non-ascii request line"))
-                    continue
-                if not text:
-                    continue
-                resp, close = self._handle_request(text)
-                if not self._send(conn, resp) or close:
-                    return
-        finally:
-            with self._conns_lock:
-                self._conns.discard(conn)
+            sel.close()
+        except OSError:
+            pass
+        for s in (self._wake_r, self._wake_w):
             try:
-                conn.close()
+                s.close()
             except OSError:
                 pass
 
-    def _send(self, conn: socket.socket, resp: str) -> bool:
-        try:
-            # replace, never raise: a non-ascii character smuggled into an
-            # error message must not kill the connection handler
-            conn.sendall(resp.encode("ascii", "replace") + b"\n")
-            return True
-        except OSError:
-            return False
+    def _accept(self) -> None:
+        while True:
+            try:
+                sock, _ = self._listener.accept()
+            except (BlockingIOError, socket.timeout):
+                return
+            except OSError:
+                return
+            sock.setblocking(False)
+            conn = _Conn(sock)
+            self._conns[id(conn)] = conn
+            self._sel.register(sock, selectors.EVENT_READ, conn)
 
-    # -- request lifecycle ---------------------------------------------------
+    def _interest(self, conn: _Conn) -> int:
+        ev = 0
+        if not conn.paused and not conn.close_after_flush:
+            ev |= selectors.EVENT_READ
+        if conn.outbuf:
+            ev |= selectors.EVENT_WRITE
+        return ev
+
+    def _apply_dirty(self) -> None:
+        """Fold worker-thread state changes (queued bytes, aborts,
+        pauses) into selector interests — only the loop thread touches
+        the selector."""
+        with self._io_lock:
+            dirty = [self._conns.get(cid) for cid in self._dirty]
+            self._dirty.clear()
+        for conn in dirty:
+            if conn is None or conn.closed:
+                continue
+            if conn.abort:
+                self._close_conn(conn)
+                continue
+            ev = self._interest(conn)
+            try:
+                if ev:
+                    self._sel.modify(conn.sock, ev, conn)
+                else:
+                    self._sel.unregister(conn.sock)
+            except (KeyError, ValueError, OSError):
+                pass
+
+    def _close_conn(self, conn: _Conn) -> None:
+        with self._io_lock:
+            if conn.closed:
+                return
+            conn.closed = True
+            self._conns.pop(id(conn), None)
+        if conn.repl:
+            self.hub.detach(conn)
+        try:
+            self._sel.unregister(conn.sock)
+        except (KeyError, ValueError, OSError):
+            pass
+        try:
+            conn.sock.close()
+        except OSError:
+            pass
+
+    def _on_readable(self, conn: _Conn) -> None:
+        try:
+            data = conn.sock.recv(1 << 16)
+        except (BlockingIOError, InterruptedError):
+            return
+        except OSError:
+            self._close_conn(conn)
+            return
+        if not data:
+            # client went away; flush what it is owed, then close
+            if conn.outbuf:
+                conn.close_after_flush = True
+                self._update_interest(conn)
+            else:
+                self._close_conn(conn)
+            return
+        conn.inbuf.extend(data)
+        if len(conn.inbuf) > MAX_LINE and b"\n" not in conn.inbuf:
+            self._send_async(conn, (err_line(
+                "badreq", f"request line exceeds {MAX_LINE} bytes")
+                + "\n").encode("ascii"))
+            conn.close_after_flush = True
+            conn.inbuf.clear()
+            self._update_interest(conn)
+            return
+        submit = False
+        while True:
+            nl = conn.inbuf.find(b"\n")
+            if nl < 0:
+                break
+            raw = bytes(conn.inbuf[:nl])
+            del conn.inbuf[: nl + 1]
+            if len(raw) > MAX_LINE:
+                self._send_async(conn, (err_line(
+                    "badreq", f"request line exceeds {MAX_LINE} bytes")
+                    + "\n").encode("ascii"))
+                conn.close_after_flush = True
+                break
+            if conn.repl:
+                # stream connection: ACK/NACK/FENCED go straight to the
+                # hub — never through admission, never to the pool
+                try:
+                    self.hub.on_line(conn, raw.decode("ascii").strip())
+                except UnicodeDecodeError:
+                    pass
+                continue
+            with self._io_lock:
+                conn.pending.append(raw)
+                if not conn.busy:
+                    conn.busy = True
+                    submit = True
+                if len(conn.pending) > PENDING_CAP:
+                    conn.paused = True  # backpressure: stop reading
+        self._update_interest(conn)
+        if submit:
+            self._pool.submit(self._drain, conn)
+
+    def _update_interest(self, conn: _Conn) -> None:
+        if conn.closed:
+            return
+        ev = self._interest(conn)
+        try:
+            if ev:
+                self._sel.modify(conn.sock, ev, conn)
+            else:
+                self._sel.unregister(conn.sock)
+        except (KeyError, ValueError, OSError):
+            pass
+
+    def _on_writable(self, conn: _Conn) -> None:
+        with self._io_lock:
+            buf = bytes(conn.outbuf)
+        if buf:
+            try:
+                sent = conn.sock.send(buf)
+            except (BlockingIOError, InterruptedError):
+                return
+            except OSError:
+                self._close_conn(conn)
+                return
+            with self._io_lock:
+                del conn.outbuf[:sent]
+                drained = not conn.outbuf
+        else:
+            drained = True
+        if drained and conn.close_after_flush:
+            self._close_conn(conn)
+        else:
+            self._update_interest(conn)
+
+    # -- worker side -------------------------------------------------------
+
+    def _drain(self, conn: _Conn) -> None:
+        """Serialize one connection's queued lines (a pool worker owns
+        the queue until it runs dry — responses never reorder within a
+        connection, and other connections drain on other workers)."""
+        while True:
+            with self._io_lock:
+                if conn.closed or conn.abort or not conn.pending:
+                    conn.busy = False
+                    return
+                raw = conn.pending.popleft()
+                if conn.paused and len(conn.pending) <= PENDING_CAP // 2:
+                    conn.paused = False
+                    self._dirty.add(id(conn))
+                    self._wake()
+            try:
+                text = raw.decode("ascii").strip()
+            except UnicodeDecodeError:
+                self._send_async(conn, (err_line(
+                    "badreq", "non-ascii request line") + "\n")
+                    .encode("ascii"))
+                continue
+            if not text:
+                continue
+            if text[:5].upper() in ("REPL ", "REPL"):
+                if self._handle_repl(conn, text):
+                    # the connection now belongs to the hub
+                    with self._io_lock:
+                        conn.busy = False
+                    return
+                continue
+            resp, close = self._handle_request(text)
+            alive = self._send_async(conn, (resp + "\n").encode("ascii"))
+            if close:
+                with self._io_lock:
+                    conn.close_after_flush = True
+                    self._dirty.add(id(conn))
+                self._wake()
+            if not alive or close:
+                with self._io_lock:
+                    conn.busy = False
+                return
+
+    # -- replication handshakes --------------------------------------------
+
+    def _handle_repl(self, conn: _Conn, text: str) -> bool:
+        """One ``REPL ...`` line on a line-mode connection.  Returns
+        True when the connection was converted to a stream (the caller
+        stops draining it)."""
+        toks = text.split()
+        sub = toks[1].upper() if len(toks) > 1 else ""
+        try:
+            if sub == "HELLO":
+                return self._repl_hello(conn, toks[2:])
+            if sub == "SNAPSHOT":
+                self._repl_snapshot(conn)
+                return False
+            self._send_async(conn, (err_line(
+                "badrepl", f"unknown replication request {sub!r}")
+                + "\n").encode("ascii"))
+        except (BadRequest, ValueError) as exc:
+            self._send_async(conn, (err_line("badrepl", str(exc)) + "\n")
+                             .encode("ascii"))
+        except ResourceError as exc:
+            self._send_async(conn, (err_line("unavailable", str(exc))
+                                    + "\n").encode("ascii"))
+        return False
+
+    def _repl_hello(self, conn: _Conn, args: list[str]) -> bool:
+        kv = parse_kv_args(args)
+        node = kv.get("node", "?")
+        epoch = int(kv.get("epoch", 0))
+        seqno = int(kv.get("seqno", 0))
+        sig = kv.get("sig", "-")
+        if self.role != "leader":
+            self.counters["notleader"] += 1
+            self._send_async(conn, (err_line(
+                "notleader", self.leader_addr()) + "\n").encode("ascii"))
+            return False
+        core = self.core
+        if sig != "-" and sig != core.sig:
+            self._send_async(conn, (err_line(
+                "badrepl", f"replica belongs to a different build input "
+                f"(sig {sig[:12]}..., ours {core.sig[:12]}...)")
+                + "\n").encode("ascii"))
+            return False
+        if epoch > core.epoch:
+            # the caller lives in a later term than we do: we are the
+            # stale one.  Refuse typed and let the fence check demote us.
+            self._send_async(conn, (err_line(
+                "fenced", f"epoch={core.epoch}") + "\n").encode("ascii"))
+            self.config.events.append(("fence_hint", epoch))
+            return False
+        # stream iff the replica's position is inside our retention
+        # window AND (same epoch, or at/before the promotion boundary —
+        # past it an old-epoch replica may carry a divergent tail)
+        can_stream = (core.records_from(seqno) is not None
+                      and seqno <= core.applied_seqno
+                      and (epoch == core.epoch
+                           or seqno <= core.epoch_base))
+        if can_stream:
+            self._send_async(conn, (ok_kv(
+                mode="stream", epoch=core.epoch,
+                seqno=core.applied_seqno) + "\n").encode("ascii"))
+            from_seqno = seqno
+        else:
+            blob, snap_seqno, snap_epoch = core.snapshot_bytes()
+            with self._io_lock:
+                conn.outbuf_cap = max(conn.outbuf_cap,
+                                      len(blob) + OUTBUF_CAP)
+            header = ok_kv(mode="snapshot", bytes=len(blob),
+                           seqno=snap_seqno, epoch=snap_epoch,
+                           crc=payload_crc(blob)) + "\n"
+            if not self._send_async(conn,
+                                    header.encode("ascii") + blob):
+                return False
+            from_seqno = snap_seqno
+        with self._io_lock:
+            conn.repl = True
+            # re-queue any lines the client pipelined behind HELLO so
+            # the hub sees them (normally none)
+            leftover = list(conn.pending)
+            conn.pending.clear()
+        for raw in leftover:
+            try:
+                self.hub.on_line(conn, raw.decode("ascii").strip())
+            except UnicodeDecodeError:
+                pass
+        self.hub.attach(conn, node, from_seqno)
+        self.config.events.append(("repl_attach", node))
+        return True
+
+    def _repl_snapshot(self, conn: _Conn) -> None:
+        """Bootstrap fetch: one snapshot blob, connection stays
+        line-mode (the follower opens its stream separately)."""
+        core = self.core
+        blob, seqno, epoch = core.snapshot_bytes()
+        with self._io_lock:
+            conn.outbuf_cap = max(conn.outbuf_cap, len(blob) + OUTBUF_CAP)
+        header = ok_kv(bytes=len(blob), seqno=seqno, epoch=epoch,
+                       crc=payload_crc(blob), sig=core.sig) + "\n"
+        self._send_async(conn, header.encode("ascii") + blob)
+
+    # -- request lifecycle -------------------------------------------------
 
     def _handle_request(self, text: str) -> tuple[str, bool]:
         """One request -> (response line, close-connection?)."""
@@ -276,6 +755,22 @@ class ServeDaemon:
             return err_line("internal", f"{type(exc).__name__}: {exc}"), \
                 False
 
+    def _check_staleness(self) -> str | None:
+        """Follower bounded-staleness guarantee: None = fresh enough to
+        answer, else the typed refusal line."""
+        if self.role != "follower" or self.cluster.max_lag is None:
+            return None
+        rep = self.replicator
+        lag = rep.lag if rep is not None else 0
+        if rep is None or rep.connected_to is None:
+            lag = max(lag, 1)  # disconnected: staleness is unbounded
+        if lag > self.cluster.max_lag:
+            self.counters["stale"] += 1
+            return err_line(
+                "stale", f"lag={lag} exceeds the {self.cluster.max_lag}-"
+                f"record staleness bound; retry or read the leader")
+        return None
+
     def _dispatch(self, req, deadline: float) -> tuple[str, bool]:
         core = self.core
         verb = req.verb
@@ -283,6 +778,10 @@ class ServeDaemon:
             return ok_line("pong"), False
         if verb == "QUIT":
             return ok_line("bye"), True
+        if verb in ("PART", "PARENT", "SUBTREE", "ECV"):
+            stale = self._check_staleness()
+            if stale is not None:
+                return stale, False
         if verb == "PART":
             vids = parse_vids(req.args)
             return ok_line(*[core.part(v) for v in vids]), False
@@ -308,33 +807,106 @@ class ServeDaemon:
             except RuntimeError as exc:
                 return err_line("unavailable", str(exc)), False
         if verb == "STATS":
-            rec = core.stats()
-            rec.update(self.counters)
-            rec["inflight"] = self.admission.inflight
-            rec["uptime_s"] = round(time.time() - self.started_at, 3)
-            rec["read_only"] = int(self.admission.read_only
-                                   or core.governor.mem_pressure())
-            return ok_kv(**rec), False
+            return self._stats_line(), False
         if verb == "INSERT":
+            if self.role != "leader":
+                self.counters["notleader"] += 1
+                return err_line("notleader", self.leader_addr()), False
             vids = parse_vids(req.args, want_pairs=True)
             pairs = [(vids[i], vids[i + 1])
                      for i in range(0, len(vids), 2)]
             import numpy as np
             seqno = core.insert(np.asarray(pairs, dtype=np.uint32))
-            if time.monotonic() > deadline:
-                # the insert IS durable and applied; saying "timeout"
-                # now would teach the client to retry a success.  Honest
-                # answer: OK, late — the deadline bounded the wait for
-                # admission+WAL, which it made.
-                pass
+            if self.cluster.clustered and self.cluster.repl_acks > 0:
+                # the replication quorum: the OK means this insert is
+                # durable on repl_acks followers too, so failover to the
+                # best-caught-up replica cannot lose it
+                left = max(0.05, deadline - time.monotonic())
+                if not self.hub.wait_acks(seqno, self.cluster.repl_acks,
+                                          left):
+                    self.counters["repl_quorum_fails"] += 1
+                    return err_line(
+                        "unavailable",
+                        f"replication quorum not reached (need "
+                        f"{self.cluster.repl_acks} follower ack(s) for "
+                        f"seqno {seqno}); the insert is durable locally "
+                        f"and will replicate, but is NOT acknowledged"), \
+                        False
             self._maybe_background_repartition()
             return ok_kv(seq=seqno, applied=len(pairs)), False
         if verb == "SNAPSHOT":
             path = core.seal_snapshot()
             return ok_kv(snap=os.path.basename(path)), False
         if verb == "REPARTITION":
+            if self.role != "leader":
+                self.counters["notleader"] += 1
+                return err_line("notleader", self.leader_addr()), False
             return ok_kv(**core.repartition()), False
         raise BadRequest(f"unhandled verb {verb!r}")  # unreachable
+
+    def _stats_line(self) -> str:
+        rec = self.core.stats()
+        rec.update(self.counters)
+        rec["inflight"] = self.admission.inflight
+        rec["uptime_s"] = round(time.monotonic() - self.started_at, 3)
+        rec["read_only"] = int(self.admission.read_only
+                               or self.core.governor.mem_pressure())
+        rec["role"] = self.role
+        rec["node"] = self.node_id
+        rec["leader"] = self.leader_addr()
+        if self.role == "leader":
+            lags = self.hub.lag_report()
+            rec["followers"] = len(lags)
+            rec["repl_lag"] = max((f["lag"] for f in lags.values()),
+                                  default=0)
+            for node, f in sorted(lags.items()):
+                rec[f"lag_{node}"] = f["lag"]
+        else:
+            rep = self.replicator
+            rec["followers"] = 0
+            rec["repl_lag"] = rep.lag if rep is not None else 0
+            rec["leader_seqno"] = (rep.leader_seqno if rep is not None
+                                   else self.core.applied_seqno)
+        return ok_kv(**rec)
+
+    # -- status file (the dead-daemon face of STATS) -----------------------
+
+    def status_dict(self) -> dict:
+        """Machine-readable role/epoch/lag snapshot — what STATS says on
+        the wire, persisted for monitors that outlive the process
+        (supervisor/status.py renders it when the daemon is down)."""
+        core = self.core
+        out = {
+            "t": time.time(),
+            "role": self.role,
+            "node": self.node_id,
+            "epoch": core.epoch,
+            "applied_seqno": core.applied_seqno,
+            "leader": self.leader_addr(),
+            "peers": list(self.cluster.peers),
+        }
+        if self.role == "leader":
+            out["followers"] = self.hub.lag_report()
+        else:
+            rep = self.replicator
+            out["repl_lag"] = rep.lag if rep is not None else 0
+            out["stream_age_s"] = (rep.stream_age_s()
+                                   if rep is not None else None)
+        return out
+
+    def _write_status(self, force: bool = False) -> None:
+        now = time.monotonic()
+        if not force and now - self._status_written < 1.0:
+            return
+        self._status_written = now
+        path = os.path.join(self.core.state_dir, STATUS_FILE)
+        try:
+            tmp = path + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(self.status_dict(), f)
+            os.replace(tmp, path)
+        except OSError:
+            pass  # status is advisory; never let it hurt serving
 
     def _maybe_background_repartition(self) -> None:
         """Kick the drift-triggered repartition exactly once at a time;
@@ -355,4 +927,3 @@ class ServeDaemon:
         t = threading.Thread(target=work, daemon=True,
                              name="serve-repartition")
         t.start()
-        self._threads.append(t)

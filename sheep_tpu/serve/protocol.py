@@ -12,11 +12,29 @@ so a reader never blocks mid-response.  Requests::
     ECV                  -> OK ecv_down=<n> baseline=<n> drift_cut=<n>
                             parts=<k>
     INSERT u v [u v...]  -> OK seq=<wal seqno> applied=<k>
-    STATS                -> OK key=value ...
+    STATS                -> OK key=value ...  (role/epoch/lag included)
     SNAPSHOT             -> OK snap=<filename>
     REPARTITION          -> OK parts=<k> baseline=<n>
     PING                 -> OK pong
     QUIT                 -> OK bye (connection closes)
+
+Replication (ISSUE 7) speaks the same line grammar under one verb; see
+serve/replicate.py for the frame codec and the stream lifecycle::
+
+    REPL HELLO node=<id> epoch=<e> seqno=<s> sig=<sig>
+        -> OK mode=stream epoch=<E> seqno=<S>     (conn becomes a stream)
+        -> OK mode=snapshot bytes=<n> seqno=<S> epoch=<E> crc=<c>
+           followed by <n> raw snapshot bytes, then the stream
+    REPL SNAPSHOT        -> OK bytes=<n> seqno=<S> epoch=<E> crc=<c>
+                            sig=<sig>, followed by <n> raw bytes
+                            (bootstrap fetch; conn stays line-mode)
+    leader -> follower stream frames (one line each):
+        REPL APPEND epoch=<E> seqno=<n> crc=<c> data=<base64>
+        REPL PING epoch=<E> seqno=<S>
+    follower -> leader on the stream connection:
+        REPL ACK seqno=<n>        (everything <= n durable + applied here)
+        REPL NACK expect=<n>      (gap/corrupt frame; re-stream from n)
+        REPL FENCED epoch=<e>     (your term is over: I live in epoch e)
 
 ``DEADLINE=`` overrides the daemon's default per-request deadline; a
 request that cannot finish inside it gets ``ERR timeout ...`` — a typed
@@ -30,8 +48,19 @@ Errors are ``ERR <code> <message>`` with codes::
     overload    admission shed this request (retry with backoff)
     readonly    inserts refused: explicit flag or memory pressure
     notfound    the named vertex is not in the sequence
+    notleader   this node is a follower; the payload is the leader's
+                ``host:port`` (or ``-`` while unknown) — writes redirect
+                there instead of splitting the brain
+    stale       this follower's replication lag exceeds the configured
+                bound (SHEEP_SERVE_MAX_LAG); reads refuse rather than
+                silently answer from the past
+    fenced      a replication peer spoke with a LATER epoch: this
+                node's term is over and it is demoting
+    badrepl     a replication handshake/frame this node cannot honor
+                (sig mismatch, unparseable frame)
     unavailable a dependency is missing (no graph edges for ECV; the
-                disk refused a WAL append or snapshot)
+                disk refused a WAL append or snapshot; a replication
+                quorum did not acknowledge in time)
     internal    unexpected server-side failure (bug; logged server-side)
 
 PART and INSERT batch naturally: many vertices / edge pairs per line, one
@@ -52,6 +81,10 @@ INSERT_VERBS = ("INSERT",)
 #: operator verbs (admitted as queries; SNAPSHOT/REPARTITION do their own
 #: locking in the core)
 ADMIN_VERBS = ("SNAPSHOT", "REPARTITION", "QUIT")
+#: the replication family (serve/replicate.py): handled OUTSIDE admission
+#: — a configured replica is cluster plumbing, not client load, and
+#: shedding it would turn an overload into a lag spiral
+REPL_VERBS = ("REPL",)
 
 _DEADLINE_PREFIX = "DEADLINE="
 
@@ -96,9 +129,20 @@ def parse_request(line: str) -> Request:
         if not toks:
             raise BadRequest("deadline with no request")
     verb = toks[0].upper()
-    if verb not in QUERY_VERBS + INSERT_VERBS + ADMIN_VERBS:
+    if verb not in QUERY_VERBS + INSERT_VERBS + ADMIN_VERBS + REPL_VERBS:
         raise BadRequest(f"unknown verb {toks[0]!r}")
     return Request(verb=verb, args=toks[1:], deadline_s=deadline)
+
+
+def parse_kv_args(args: list[str]) -> dict:
+    """``key=value`` argument tokens -> dict (REPL frames, HELLO)."""
+    out = {}
+    for tok in args:
+        k, sep, v = tok.partition("=")
+        if not sep or not k:
+            raise BadRequest(f"expected key=value, got {tok!r}")
+        out[k] = v
+    return out
 
 
 def parse_vids(args: list[str], want_pairs: bool = False) -> list[int]:

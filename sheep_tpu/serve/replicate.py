@@ -1,0 +1,677 @@
+"""WAL shipping: the leader streams acked records to followers.
+
+The PR-6 WAL is already an ordered, checksummed, sig-fenced record
+stream; replication (ISSUE 7) ships exactly those records over the line
+protocol and replays them through the exact insert path the leader ran —
+so a follower is bit-identical by construction, the same way WAL replay
+after a crash is.  The pieces:
+
+  frame codec     one APPEND frame per WAL record — ascii line, base64
+                  payload, crc32 over the RAW payload bytes (a frame
+                  torn mid-line never parses; a frame corrupted in
+                  flight fails its crc; both trigger re-sync, never a
+                  partial apply)
+  ReplApplier     the follower-side frame consumer.  Socket-free on
+                  purpose: the torn-stream property test feeds it byte
+                  prefixes directly (tests/test_replicate.py), the same
+                  discipline as the WAL torn-tail sweep.
+  ReplicationHub  the leader side: one sender thread per attached
+                  follower, woken by ServeCore.on_append, double-
+                  buffered in the Pipelined-Workflow sense — the leader
+                  keeps acking local WAL appends while senders drain the
+                  tail to followers concurrently.  Cumulative ACKs feed
+                  the per-follower lag report and the insert quorum wait.
+  Replicator      the follower's connection owner: discover the leader,
+                  HELLO, stream (or snapshot-bootstrap when the leader's
+                  WAL moved past us), reconnect on any failure.
+
+Delivery contract: frames can be lost, duplicated, delayed, or the
+connection cut at ANY byte (SHEEP_SERVE_NETFAULT_PLAN rehearses each) —
+the seqno chain makes every case safe: duplicates drop idempotently,
+gaps NACK a re-stream, and a follower only ever ACKs what is durable in
+its OWN WAL.
+"""
+
+from __future__ import annotations
+
+import base64
+import binascii
+import os
+import socket
+import threading
+import time
+import zlib
+
+from ..integrity.errors import IntegrityError
+from . import netfaults
+from .protocol import BadRequest, parse_kv_args
+from .state import ReplicationGap, ServeCore, load_serve_snapshot
+from .wal import MAX_PAYLOAD
+
+#: replication stream heartbeat cadence (leader PING when idle) and the
+#: socket read timeout followers derive from it
+REPL_HB_ENV = "SHEEP_SERVE_REPL_HB_S"
+DEFAULT_HB_S = 1.0
+
+
+class ReplProtocolError(RuntimeError):
+    """A replication frame this node cannot honor (maps to badrepl)."""
+
+
+# -- frame codec ------------------------------------------------------------
+
+
+def payload_crc(payload: bytes) -> int:
+    return zlib.crc32(payload) & 0xFFFFFFFF
+
+
+def encode_append(epoch: int, seqno: int, payload: bytes) -> str:
+    """One WAL record -> one APPEND frame line (no trailing newline)."""
+    data = base64.b64encode(payload).decode("ascii")
+    return (f"REPL APPEND epoch={epoch} seqno={seqno} "
+            f"crc={payload_crc(payload)} data={data}")
+
+
+def encode_ping(epoch: int, seqno: int) -> str:
+    return f"REPL PING epoch={epoch} seqno={seqno}"
+
+
+def encode_hello(node: str, epoch: int, seqno: int, sig: str) -> str:
+    return f"REPL HELLO node={node} epoch={epoch} seqno={seqno} sig={sig}"
+
+
+def encode_ack(seqno: int) -> str:
+    """Cumulative: everything <= seqno is durable + applied here."""
+    return f"REPL ACK seqno={seqno}"
+
+
+def encode_nack(expect: int) -> str:
+    return f"REPL NACK expect={expect}"
+
+
+def encode_fenced(epoch: int) -> str:
+    return f"REPL FENCED epoch={epoch}"
+
+
+class ReplFrame:
+    __slots__ = ("kind", "kv", "payload")
+
+    def __init__(self, kind: str, kv: dict, payload: bytes | None = None):
+        self.kind = kind
+        self.kv = kv
+        self.payload = payload
+
+    def seqno(self) -> int:
+        return int(self.kv["seqno"])
+
+    def epoch(self) -> int:
+        return int(self.kv["epoch"])
+
+
+def parse_frame(line: str) -> ReplFrame:
+    """Parse one ``REPL ...`` line into a typed frame; raises
+    :class:`ReplProtocolError` on anything malformed (bad base64, crc
+    mismatch, missing fields) — the caller re-syncs, it never guesses."""
+    toks = line.split()
+    if len(toks) < 2 or toks[0].upper() != "REPL":
+        raise ReplProtocolError(f"not a replication frame: {line!r}")
+    kind = toks[1].upper()
+    try:
+        kv = parse_kv_args(toks[2:])
+    except BadRequest as exc:
+        raise ReplProtocolError(f"bad {kind} frame: {exc}")
+    payload = None
+    if kind == "APPEND":
+        for field in ("epoch", "seqno", "crc", "data"):
+            if field not in kv:
+                raise ReplProtocolError(f"APPEND frame missing {field}=")
+        try:
+            payload = base64.b64decode(kv["data"].encode("ascii"),
+                                       validate=True)
+        except (binascii.Error, ValueError) as exc:
+            raise ReplProtocolError(f"APPEND frame payload is not valid "
+                                    f"base64 ({exc})")
+        if len(payload) > MAX_PAYLOAD:
+            raise ReplProtocolError(
+                f"APPEND frame claims {len(payload)} payload bytes "
+                f"(cap {MAX_PAYLOAD})")
+        try:
+            want = int(kv["crc"])
+        except ValueError:
+            raise ReplProtocolError(f"APPEND frame crc {kv['crc']!r} is "
+                                    f"not an integer")
+        if payload_crc(payload) != want:
+            raise ReplProtocolError(
+                f"APPEND frame for seqno {kv.get('seqno')} fails its "
+                f"crc32 — corrupted in flight")
+    elif kind == "PING":
+        for field in ("epoch", "seqno"):
+            if field not in kv:
+                raise ReplProtocolError(f"PING frame missing {field}=")
+    elif kind == "ACK":
+        if "seqno" not in kv:
+            raise ReplProtocolError("ACK frame missing seqno=")
+    elif kind == "NACK":
+        if "expect" not in kv:
+            raise ReplProtocolError("NACK frame missing expect=")
+    elif kind in ("HELLO", "FENCED", "SNAPSHOT"):
+        pass
+    else:
+        raise ReplProtocolError(f"unknown replication frame {kind!r}")
+    for field in ("epoch", "seqno", "expect"):
+        if field in kv:
+            try:
+                if int(kv[field]) < 0:
+                    raise ValueError
+            except ValueError:
+                raise ReplProtocolError(
+                    f"{kind} frame {field}={kv[field]!r} is not a "
+                    f"non-negative integer")
+    return ReplFrame(kind, kv, payload)
+
+
+# -- follower side ----------------------------------------------------------
+
+
+class ReplApplier:
+    """Consume the leader's byte stream and apply complete, crc-valid
+    frames to a follower core — nothing else, ever.
+
+    Socket-free: ``feed`` takes raw bytes (any split), buffers the
+    incomplete tail, and hands complete frames to the core; outbound
+    ACK/NACK/FENCED lines go through the injected ``send`` callable.  A
+    stream cut at ANY byte boundary leaves at most an incomplete line in
+    the buffer — no partial record can reach the tree (property-swept in
+    tests/test_replicate.py, mirroring the PR-6 torn-WAL sweep).
+    """
+
+    def __init__(self, core: ServeCore, send, on_epoch=None):
+        self.core = core
+        self._send = send
+        #: adopt a later leader epoch (default: seal the boundary
+        #: locally via core.advance_epoch)
+        self._on_epoch = on_epoch or core.advance_epoch
+        self._buf = bytearray()
+        self.leader_seqno = core.applied_seqno
+        self.last_frame_t: float | None = None
+        self.applied = 0
+        self.dups = 0
+        self.gaps = 0
+        self.frame_errors = 0
+
+    @property
+    def lag(self) -> int:
+        return max(0, self.leader_seqno - self.core.applied_seqno)
+
+    def feed(self, data: bytes) -> None:
+        """Buffer ``data`` and handle every COMPLETE line in it."""
+        self._buf.extend(data)
+        while True:
+            nl = self._buf.find(b"\n")
+            if nl < 0:
+                return
+            raw = bytes(self._buf[:nl])
+            del self._buf[: nl + 1]
+            try:
+                text = raw.decode("ascii").strip()
+            except UnicodeDecodeError:
+                self.frame_errors += 1
+                self._send(encode_nack(self.core.applied_seqno + 1))
+                continue
+            if text:
+                self.handle_line(text)
+
+    def handle_line(self, text: str) -> None:
+        self.last_frame_t = time.monotonic()
+        try:
+            frame = parse_frame(text)
+        except ReplProtocolError:
+            # a frame that parses wrong is indistinguishable from lost
+            # bytes: ask for a re-stream from our applied position
+            self.frame_errors += 1
+            self._send(encode_nack(self.core.applied_seqno + 1))
+            return
+        if frame.kind not in ("APPEND", "PING"):
+            return  # HELLO responses etc. are the Replicator's business
+        epoch = frame.epoch()
+        if epoch < self.core.epoch:
+            # a fenced ex-leader is still streaming at us: tell it its
+            # term is over instead of applying history that lost
+            self._send(encode_fenced(self.core.epoch))
+            return
+        if epoch > self.core.epoch:
+            self._on_epoch(epoch)
+        self.leader_seqno = max(self.leader_seqno, frame.seqno())
+        if frame.kind == "APPEND":
+            try:
+                out = self.core.apply_replicated(frame.seqno(),
+                                                 frame.payload)
+            except ReplicationGap as gap:
+                self.gaps += 1
+                self._send(encode_nack(gap.expected))
+                return
+            if out == "dup":
+                self.dups += 1
+            else:
+                self.applied += 1
+            self._send(encode_ack(self.core.applied_seqno))
+        else:  # PING carries the leader's latest seqno: gap detector
+            if self.leader_seqno > self.core.applied_seqno:
+                self.gaps += 1
+                self._send(encode_nack(self.core.applied_seqno + 1))
+            else:
+                self._send(encode_ack(self.core.applied_seqno))
+
+
+# -- leader side ------------------------------------------------------------
+
+
+class _FollowerState:
+    __slots__ = ("conn", "node", "acked", "next_send", "last_ack_t",
+                 "attached_at", "alive", "thread")
+
+    def __init__(self, conn, node: str, next_send: int):
+        self.conn = conn
+        self.node = node
+        self.acked = 0
+        self.next_send = next_send
+        self.last_ack_t: float | None = None
+        self.attached_at = time.monotonic()
+        self.alive = True
+        self.thread: threading.Thread | None = None
+
+
+class ReplicationHub:
+    """The leader's fan-out: per-follower sender threads draining the
+    WAL tail, cumulative-ACK bookkeeping, and the quorum wait an insert
+    blocks on before it is acknowledged to the client.
+
+    Transport-agnostic: the daemon injects ``send(conn, data: bytes) ->
+    bool`` and ``close(conn)``; the hub never touches a socket API, so
+    property tests drive it with in-memory pipes.
+    """
+
+    def __init__(self, core: ServeCore, send, close,
+                 hb_s: float = DEFAULT_HB_S, on_fenced=None):
+        self.core = core
+        self._send = send
+        self._close = close
+        self.hb_s = hb_s
+        self.on_fenced = on_fenced
+        self._cv = threading.Condition()
+        self._followers: dict[int, _FollowerState] = {}
+        self._stopped = False
+        core.on_append = self.notify
+
+    # -- membership --------------------------------------------------------
+
+    def attach(self, conn, node: str, from_seqno: int) -> None:
+        """Register one follower stream starting after ``from_seqno``
+        and spawn its sender.  The caller (daemon) already decided
+        stream-vs-snapshot; a sender that later finds the WAL moved past
+        its position closes the connection so the follower re-HELLOs."""
+        fs = _FollowerState(conn, node, from_seqno + 1)
+        fs.acked = from_seqno
+        with self._cv:
+            self._followers[id(conn)] = fs
+            self._cv.notify_all()
+        t = threading.Thread(target=self._sender, args=(fs,), daemon=True,
+                             name=f"repl-send:{node}")
+        fs.thread = t
+        t.start()
+
+    def detach(self, conn) -> None:
+        with self._cv:
+            fs = self._followers.pop(id(conn), None)
+            if fs is not None:
+                fs.alive = False
+            self._cv.notify_all()
+
+    def stop(self) -> None:
+        with self._cv:
+            self._stopped = True
+            for fs in self._followers.values():
+                fs.alive = False
+            self._followers.clear()
+            self._cv.notify_all()
+
+    def disconnect_all(self) -> None:
+        """Drop every follower stream but stay usable (a demoted leader
+        cuts its followers loose so they rediscover the real one; a
+        re-promotion attaches fresh streams)."""
+        with self._cv:
+            dropped = list(self._followers.values())
+            for fs in dropped:
+                fs.alive = False
+            self._followers.clear()
+            self._cv.notify_all()
+        for fs in dropped:
+            self._close(fs.conn)
+
+    def notify(self) -> None:
+        """ServeCore.on_append hook: a record landed — wake senders and
+        quorum waiters.  Runs under the core lock; must never block."""
+        with self._cv:
+            self._cv.notify_all()
+
+    # -- inbound (follower -> leader lines on a stream conn) ---------------
+
+    def on_line(self, conn, text: str) -> None:
+        try:
+            frame = parse_frame(text)
+        except ReplProtocolError:
+            return  # a garbled ack is only a missed wakeup, never state
+        with self._cv:
+            fs = self._followers.get(id(conn))
+            if fs is None:
+                return
+            if frame.kind == "ACK":
+                fs.acked = max(fs.acked, frame.seqno())
+                fs.last_ack_t = time.monotonic()
+                self._cv.notify_all()
+            elif frame.kind == "NACK":
+                expect = int(frame.kv["expect"])
+                fs.next_send = min(fs.next_send, expect)
+                self._cv.notify_all()
+            elif frame.kind == "FENCED":
+                fenced_by = int(frame.kv.get("epoch", 0))
+                fs.alive = False
+                self._cv.notify_all()
+                if self.on_fenced is not None:
+                    self.on_fenced(fenced_by)
+
+    # -- outbound ----------------------------------------------------------
+
+    def _transmit(self, fs: _FollowerState, line: str, site: str) -> bool:
+        """One frame through the netfault plan to one follower.  Returns
+        False when the connection is gone (caller detaches)."""
+        kind = netfaults.arm(site)
+        if kind == "drop":
+            return True  # the wire ate it; the seqno chain will notice
+        if kind == "partition":
+            self._close(fs.conn)
+            return False
+        if kind == "slow":
+            time.sleep(netfaults.SLOW_S)
+        data = (line + "\n").encode("ascii")
+        if not self._send(fs.conn, data):
+            return False
+        if kind == "dup":
+            self._send(fs.conn, data)
+        return True
+
+    def _sender(self, fs: _FollowerState) -> None:
+        """One follower's drain loop: ship the backlog, then block on
+        the append condition; PING with the latest seqno when idle so
+        the follower can detect gaps and the watcher can see liveness."""
+        last_sent_t = 0.0
+        while fs.alive and not self._stopped:
+            recs = self.core.records_from(fs.next_send - 1)
+            if recs is None:
+                # the WAL was sealed past this follower: it needs a
+                # snapshot bootstrap, which needs a fresh HELLO
+                self._close(fs.conn)
+                self.detach(fs.conn)
+                return
+            sent_any = False
+            for seqno, payload in recs:
+                if not fs.alive or self._stopped:
+                    return
+                line = encode_append(self.core.epoch, seqno, payload)
+                if not self._transmit(fs, line, "repl"):
+                    self.detach(fs.conn)
+                    return
+                fs.next_send = seqno + 1
+                sent_any = True
+            if sent_any:
+                last_sent_t = time.monotonic()
+                continue  # more may have landed while we were sending
+            with self._cv:
+                if fs.next_send <= self.core.applied_seqno:
+                    continue  # a NACK rewound us while unlocked
+                self._cv.wait(self.hb_s)
+            if not fs.alive or self._stopped:
+                return
+            if (time.monotonic() - last_sent_t >= self.hb_s
+                    and fs.next_send > self.core.applied_seqno):
+                line = encode_ping(self.core.epoch,
+                                   self.core.applied_seqno)
+                if not self._transmit(fs, line, "hb"):
+                    self.detach(fs.conn)
+                    return
+                last_sent_t = time.monotonic()
+
+    # -- queries -----------------------------------------------------------
+
+    def wait_acks(self, seqno: int, need: int, timeout_s: float) -> bool:
+        """Block until ``need`` followers have cumulatively acked
+        ``seqno`` (their copy is durable + applied), or the deadline
+        passes.  The replication quorum an insert rides on."""
+        if need <= 0:
+            return True
+        deadline = time.monotonic() + timeout_s
+        with self._cv:
+            while True:
+                acked = sum(1 for fs in self._followers.values()
+                            if fs.acked >= seqno)
+                if acked >= need:
+                    return True
+                left = deadline - time.monotonic()
+                if left <= 0 or self._stopped:
+                    return False
+                self._cv.wait(min(left, 0.1))
+
+    def follower_count(self) -> int:
+        with self._cv:
+            return len(self._followers)
+
+    def lag_report(self) -> dict:
+        """node -> {acked, lag, ack_age_s} for STATS and the status
+        file; lag is in records against the leader's applied seqno."""
+        now = time.monotonic()
+        applied = self.core.applied_seqno
+        with self._cv:
+            return {
+                fs.node: {
+                    "acked": fs.acked,
+                    "lag": max(0, applied - fs.acked),
+                    "ack_age_s": (round(now - fs.last_ack_t, 3)
+                                  if fs.last_ack_t is not None else None),
+                }
+                for fs in self._followers.values()
+            }
+
+
+# -- snapshot bootstrap (client side) ---------------------------------------
+
+
+def recv_exact(rf, n: int) -> bytes:
+    out = bytearray()
+    while len(out) < n:
+        chunk = rf.read(n - len(out))
+        if not chunk:
+            raise ConnectionError(
+                f"replication peer closed mid-snapshot "
+                f"({len(out)}/{n} bytes)")
+        out.extend(chunk)
+    return bytes(out)
+
+
+def parse_snapshot_header(line: str) -> dict:
+    toks = line.split()
+    if not toks or toks[0] != "OK":
+        raise ReplProtocolError(f"snapshot fetch refused: {line!r}")
+    kv = parse_kv_args(toks[1:])
+    for field in ("bytes", "seqno", "epoch", "crc"):
+        if field not in kv:
+            raise ReplProtocolError(
+                f"snapshot header missing {field}=: {line!r}")
+    return kv
+
+
+def fetch_snapshot(host: str, port: int, timeout_s: float = 60.0):
+    """Bootstrap fetch: ``REPL SNAPSHOT`` against a leader.  Returns
+    ``(blob, seqno, epoch, sig)`` with the crc already verified."""
+    with socket.create_connection((host, port), timeout=timeout_s) as s:
+        rf = s.makefile("rb")
+        s.sendall(b"REPL SNAPSHOT\n")
+        line = rf.readline().decode("ascii").strip()
+        kv = parse_snapshot_header(line)
+        blob = recv_exact(rf, int(kv["bytes"]))
+    if payload_crc(blob) != int(kv["crc"]):
+        raise IntegrityError(
+            "replication snapshot failed its crc32 in flight")
+    return blob, int(kv["seqno"]), int(kv["epoch"]), kv.get("sig", "")
+
+
+def bootstrap_state_dir(state_dir: str, host: str, port: int,
+                        timeout_s: float = 60.0) -> int:
+    """First start of a follower with an EMPTY state dir: fetch the
+    leader's snapshot, seal it locally (sidecar resealed — the blob was
+    crc-verified in flight), lay down a fresh WAL at the leader's epoch.
+    Returns the snapshot's applied seqno; the caller then enters through
+    ServeCore.open — the exact restart path, same as bootstrap."""
+    from ..integrity.sidecar import write_sidecar
+    from .state import snap_name
+    from .wal import create_wal, wal_path
+    blob, seqno, epoch, sig = fetch_snapshot(host, port, timeout_s)
+    os.makedirs(state_dir, exist_ok=True)
+    path = os.path.join(state_dir, snap_name(seqno))
+    tmp = path + ".fetch"
+    with open(tmp, "wb") as f:
+        f.write(blob)
+        f.flush()
+        os.fsync(f.fileno())
+    snap = load_serve_snapshot(tmp, integrity="trust")
+    snap.validate()
+    if sig and snap.sig != sig:
+        raise IntegrityError(
+            f"replication snapshot sig {snap.sig[:12]}... does not match "
+            f"the advertised {sig[:12]}...")
+    os.replace(tmp, path)
+    write_sidecar(path)
+    create_wal(wal_path(state_dir), snap.sig, epoch=epoch)
+    return seqno
+
+
+# -- the follower's connection owner ----------------------------------------
+
+
+class Replicator:
+    """Own the follower->leader connection for one daemon: discover the
+    leader, HELLO, then pump bytes into a :class:`ReplApplier` until the
+    stream dies — and reconnect.  ``discover`` is injected
+    (serve/cluster.py): it returns the current leader's (host, port) or
+    None, so failover re-pointing is just discovery returning a new
+    address."""
+
+    def __init__(self, core: ServeCore, node_id: str, discover,
+                 hb_s: float = DEFAULT_HB_S, retry_s: float = 0.2,
+                 events: list | None = None):
+        self.core = core
+        self.node_id = node_id
+        self.discover = discover
+        self.hb_s = hb_s
+        self.retry_s = retry_s
+        self.events = events if events is not None else []
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self.applier: ReplApplier | None = None
+        self.connected_to: tuple[str, int] | None = None
+        self.last_frame_t: float | None = None
+        self.resyncs = 0
+
+    @property
+    def lag(self) -> int:
+        a = self.applier
+        return a.lag if a is not None else 0
+
+    @property
+    def leader_seqno(self) -> int:
+        a = self.applier
+        return a.leader_seqno if a is not None else self.core.applied_seqno
+
+    def start(self) -> "Replicator":
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name=f"replicator:{self.node_id}")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+
+    def stream_age_s(self) -> float | None:
+        """Seconds since the last frame arrived (None = never streamed)
+        — the staleness signal the failover watcher deadlines."""
+        t = self.last_frame_t
+        return None if t is None else max(0.0, time.monotonic() - t)
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            target = self.discover()
+            if target is None:
+                self._stop.wait(self.retry_s)
+                continue
+            try:
+                self._stream_once(target)
+            except (OSError, ConnectionError, ReplProtocolError,
+                    IntegrityError) as exc:
+                self.events.append(("repl_error", str(exc)))
+                self._stop.wait(self.retry_s)
+            finally:
+                self.connected_to = None
+
+    def _stream_once(self, target: tuple[str, int]) -> None:
+        host, port = target
+        with socket.create_connection((host, port),
+                                      timeout=max(1.0, 3 * self.hb_s)) \
+                as sock:
+            rf = sock.makefile("rb")
+            hello = encode_hello(self.node_id, self.core.epoch,
+                                 self.core.applied_seqno, self.core.sig)
+            sock.sendall((hello + "\n").encode("ascii"))
+            line = rf.readline().decode("ascii").strip()
+            toks = line.split()
+            if not toks or toks[0] != "OK":
+                raise ReplProtocolError(f"HELLO refused: {line!r}")
+            kv = parse_kv_args(toks[1:])
+            if kv.get("mode") == "snapshot":
+                self.resyncs += 1
+                self.events.append(("repl_resync", int(kv["seqno"])))
+                blob = recv_exact(rf, int(kv["bytes"]))
+                if payload_crc(blob) != int(kv["crc"]):
+                    raise IntegrityError("replication snapshot failed "
+                                         "its crc32 in flight")
+                tmp = os.path.join(self.core.state_dir, "resync.fetch")
+                with open(tmp, "wb") as f:
+                    f.write(blob)
+                try:
+                    snap = load_serve_snapshot(tmp, integrity="trust")
+                    self.core.reset_from_snapshot(snap)
+                finally:
+                    try:
+                        os.unlink(tmp)
+                    except OSError:
+                        pass
+            elif kv.get("mode") != "stream":
+                raise ReplProtocolError(f"unknown HELLO mode: {line!r}")
+            self.connected_to = target
+            self.events.append(("repl_connected", f"{host}:{port}"))
+
+            def send_up(text: str) -> None:
+                sock.sendall((text + "\n").encode("ascii"))
+
+            applier = ReplApplier(self.core, send_up)
+            self.applier = applier
+            sock.settimeout(max(0.2, 3 * self.hb_s))
+            while not self._stop.is_set():
+                try:
+                    data = sock.recv(1 << 16)
+                except socket.timeout:
+                    continue  # staleness is the watcher's deadline call
+                if not data:
+                    return  # leader went away: rediscover + reconnect
+                applier.feed(data)
+                self.last_frame_t = time.monotonic()

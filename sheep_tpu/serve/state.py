@@ -58,7 +58,8 @@ from ..partition.tree_partition import (TreePartitionOptions,
 from ..resources import ResourceGovernor, gc_orphan_temps
 from ..runtime.snapshot import input_signature
 from . import faults as serve_faults
-from .wal import WalAppender, create_wal, read_wal, repair_wal, wal_path
+from .wal import (WalAppender, archived_wal_name, create_wal, read_wal,
+                  repair_wal, wal_path)
 
 SNAP_VERSION = 1
 SNAP_RE = re.compile(r"^snap-(\d{12})\.snap$")
@@ -66,6 +67,10 @@ SNAP_RE = re.compile(r"^snap-(\d{12})\.snap$")
 #: serve state dirs keep this many sealed snapshots (the live one plus a
 #: fallback the repair policy can reach for if the newest goes bad)
 KEEP_SNAPSHOTS = 2
+
+#: how many recent records the in-memory replication window retains; a
+#: follower further behind than this bootstraps from a snapshot instead
+REPL_TAIL_KEEP = 4096
 
 
 def snap_name(applied_seqno: int) -> str:
@@ -115,11 +120,11 @@ def decode_inserts(payload: bytes) -> np.ndarray:
 class ServeSnapshot:
     """One sealed serving state (see module docstring for why this tuple
     is complete): tree + partition + cumulative inserted edges + the WAL
-    seqno folded in so far."""
+    seqno folded in so far + the replication epoch that sealed it."""
 
     def __init__(self, seq, parent, pst, parts, num_parts, applied_seqno,
                  ins_tail, ins_head, drift_cut, baseline_ecv, graph_path,
-                 sig, balance):
+                 sig, balance, epoch=0, epoch_base=0):
         self.seq = seq
         self.parent = parent
         self.pst = pst
@@ -133,9 +138,17 @@ class ServeSnapshot:
         self.graph_path = graph_path
         self.sig = sig
         self.balance = float(balance)
+        self.epoch = int(epoch)
+        #: the applied seqno at which this epoch began (the promotion
+        #: boundary): an old-epoch replica at or below it shares our
+        #: record prefix and may stream; past it, it may have a
+        #: divergent tail and must snapshot-resync
+        self.epoch_base = int(epoch_base)
 
     def validate(self) -> None:
         problems = []
+        if self.epoch < 0:
+            problems.append(f"negative epoch {self.epoch}")
         m = len(self.seq)
         if len(self.parent) != m or len(self.pst) != m:
             problems.append(
@@ -198,6 +211,8 @@ def save_serve_snapshot(path: str, snap: ServeSnapshot,
             graph_path=np.str_(snap.graph_path or ""),
             sig=np.str_(snap.sig),
             balance=np.float64(snap.balance),
+            epoch=np.int64(snap.epoch),
+            epoch_base=np.int64(snap.epoch_base),
         )
 
 
@@ -227,7 +242,11 @@ def load_serve_snapshot(path: str,
                 drift_cut=int(z["drift_cut"]),
                 baseline_ecv=int(z["baseline_ecv"]),
                 graph_path=str(z["graph_path"]), sig=str(z["sig"]),
-                balance=float(z["balance"]))
+                balance=float(z["balance"]),
+                # pre-replication snapshots predate epochs: term 0
+                epoch=int(z["epoch"]) if "epoch" in z.files else 0,
+                epoch_base=(int(z["epoch_base"])
+                            if "epoch_base" in z.files else 0))
     except IntegrityError:
         raise
     except Exception as exc:  # BadZipFile / KeyError / OSError / ValueError
@@ -236,6 +255,17 @@ def load_serve_snapshot(path: str,
             f"({type(exc).__name__}: {exc})")
     snap.validate()
     return snap
+
+
+class ReplicationGap(RuntimeError):
+    """A replicated record would leave a hole in the seqno chain; the
+    follower must re-sync from its applied seqno (serve/replicate.py)."""
+
+    def __init__(self, expected: int, got: int):
+        super().__init__(f"replication gap: expected seqno {expected}, "
+                         f"stream delivered {got}")
+        self.expected = expected
+        self.got = got
 
 
 # -- the incremental transform ----------------------------------------------
@@ -315,7 +345,27 @@ class ServeCore:
         self.drift_min_cut = max(1, int(drift_min_cut))
         self._lock = threading.RLock()
         self._wal = appender
+        #: replication hook (serve/replicate.py): called with no args,
+        #: under the state lock, after every durable append — the hub
+        #: wakes its per-follower senders off it.  Never does I/O.
+        self.on_append = None
+        #: whether THIS core fires the SHEEP_SERVE_FAULT_PLAN sites; the
+        #: multi-core in-process harnesses (tests) disable it on all but
+        #: the node under test so "kill@wal:3" names one node's boundary
+        self.fire_faults = True
+        self.repartitions = 0
+        self.snap_failures = 0
+        # repartition ordering: a later-STARTED repartition (newer tree)
+        # must never be overwritten by an earlier-started one finishing
+        # late (the background thread racing a forced REPARTITION)
+        self._repart_ticket = 0
+        self._repart_applied = -1
+        self._load_snapshot(snap)
 
+    def _load_snapshot(self, snap: ServeSnapshot) -> None:
+        """(Re)build every piece of in-memory serving state from one
+        snapshot — the shared tail of __init__ and the follower full
+        resync (:meth:`reset_from_snapshot`)."""
         self.seq = np.asarray(snap.seq, dtype=np.uint32)
         self.parent = np.asarray(snap.parent, dtype=np.uint32).copy()
         self.pst = np.asarray(snap.pst, dtype=np.uint32).copy()
@@ -327,19 +377,23 @@ class ServeCore:
         self.baseline_ecv = snap.baseline_ecv
         self.graph_path = snap.graph_path or None
         self.sig = snap.sig
+        self.epoch = snap.epoch
+        self.epoch_base = snap.epoch_base
         self.pos = sequence_positions(self.seq,
                                       max(len(self.parts) - 1, 0))
         self.ins_tail: list[int] = [int(x) for x in snap.ins_tail]
         self.ins_head: list[int] = [int(x) for x in snap.ins_head]
         self._inserts_since_snap = 0
         self._subtree_cache = None
-        self.repartitions = 0
-        self.snap_failures = 0
-        # repartition ordering: a later-STARTED repartition (newer tree)
-        # must never be overwritten by an earlier-started one finishing
-        # late (the background thread racing a forced REPARTITION)
-        self._repart_ticket = 0
-        self._repart_applied = -1
+        # replication bookkeeping: an in-memory window of recent records
+        # (seqno, payload) follower senders stream from without touching
+        # the file.  Deliberately DECOUPLED from the WAL swap: a seal
+        # must not strand a follower that is one record behind, so the
+        # window survives seals and is trimmed by count instead
+        # (repl_floor = the seqno just before the oldest retained
+        # record; anything at or below it needs a snapshot bootstrap).
+        self._wal_tail: list[tuple[int, bytes]] = []
+        self.repl_floor = snap.applied_seqno
 
         self.edges_tail = None
         self.edges_head = None
@@ -449,12 +503,11 @@ class ServeCore:
             raise MalformedArtifact(
                 f"{state_dir}: no serve snapshots — not a serve state dir "
                 f"(bootstrap one with `sheep serve -d DIR <artifacts>`)")
-        snap = None
+        loaded = []
         errors = []
         for path in reversed(snaps):
             try:
-                snap = load_serve_snapshot(path, integrity=mode)
-                break
+                loaded.append(load_serve_snapshot(path, integrity=mode))
             except (IntegrityError, OSError) as exc:
                 errors.append(f"{path}: {exc}")
                 if mode == "strict":
@@ -462,10 +515,14 @@ class ServeCore:
                 warnings.warn(
                     f"serve: snapshot {path} unusable ({exc}); falling "
                     f"back a generation")
-        if snap is None:
+        if not loaded:
             raise MalformedArtifact(
                 f"{state_dir}: every snapshot generation is corrupt — "
                 + "; ".join(errors))
+        # the epoch is the senior key: a promotion or follower re-sync
+        # that crashed mid-swap can leave a HIGHER-epoch snapshot under a
+        # lower applied-seqno filename, and the later term always wins
+        snap = max(loaded, key=lambda s: (s.epoch, s.applied_seqno))
 
         wpath = wal_path(state_dir)
         if not os.path.exists(wpath):
@@ -482,12 +539,39 @@ class ServeCore:
             if dropped:
                 warnings.warn(f"serve: truncated {dropped} torn byte(s) "
                               f"off {wpath}")
-        wal_sig, records, _, _ = read_wal(wpath, mode)
+        wal_sig, wal_epoch, records, _, _ = read_wal(wpath, mode)
         if wal_sig != snap.sig:
             raise IntegrityError(
                 f"{wpath}: WAL belongs to a different build input "
                 f"(log sig {wal_sig[:12]}..., snapshot "
                 f"{snap.sig[:12]}...) — refusing to replay")
+        if wal_epoch > snap.epoch:
+            # only reachable when repair mode fell back a snapshot
+            # generation ACROSS a promotion: the epoch-E log starts after
+            # the epoch-E snapshot this dir no longer has a readable copy
+            # of, so replaying it onto the older snapshot would skip the
+            # gap silently.  No mode can bridge that.
+            raise MalformedArtifact(
+                f"{wpath}: WAL epoch {wal_epoch} is ahead of snapshot "
+                f"epoch {snap.epoch} — the snapshot that sealed epoch "
+                f"{wal_epoch} is missing or unreadable; recovery cannot "
+                f"bridge a promotion boundary")
+        if wal_epoch < snap.epoch:
+            if records and records[-1][0] > snap.applied_seqno:
+                raise MalformedArtifact(
+                    f"{wpath}: cross-epoch seqno overlap — epoch "
+                    f"{wal_epoch} log reaches seqno {records[-1][0]} past "
+                    f"the epoch-{snap.epoch} snapshot boundary "
+                    f"{snap.applied_seqno}; a fenced log may never extend "
+                    f"a later epoch's history")
+            # benign crash window between the promotion seal and the WAL
+            # swap: every surviving record is already in the snapshot
+            warnings.warn(
+                f"serve: {wpath} carries the pre-promotion epoch "
+                f"{wal_epoch} (snapshot is {snap.epoch}); swapping in a "
+                f"fresh epoch-{snap.epoch} log")
+            create_wal(wpath, snap.sig, epoch=snap.epoch)
+            records = []
 
         appender = WalAppender(wpath, expect_sig=snap.sig)
         core = cls(state_dir, snap, appender, **core_kw)
@@ -496,6 +580,7 @@ class ServeCore:
                 continue  # already folded into the snapshot
             core._apply_pairs(decode_inserts(payload))
             core.applied_seqno = seqno
+            core._tail_push(seqno, payload)
         # A crash between snapshot seal and WAL swap leaves a log whose
         # last seqno <= applied; new records must still sort AFTER the
         # snapshot or the next replay would skip them.
@@ -575,6 +660,7 @@ class ServeCore:
             return {
                 "n": len(self.seq), "links": linked,
                 "vids": len(self.parts),
+                "epoch": self.epoch,
                 "wal_seqno": self._wal.next_seqno - 1,
                 "applied_seqno": self.applied_seqno,
                 "inserted": len(self.ins_tail),
@@ -605,15 +691,72 @@ class ServeCore:
             raise ValueError(f"insert batch must be (k, 2), got "
                              f"{pairs.shape}")
         with self._lock:
-            seqno = self._wal.append(encode_inserts(pairs))
-            serve_faults.fire("wal")
+            payload = encode_inserts(pairs)
+            seqno = self._wal.append(payload)
+            self._fire("wal")
             self._apply_pairs(pairs)
             self.applied_seqno = seqno
-            serve_faults.fire("apply")
+            self._tail_push(seqno, payload)
+            if self.on_append is not None:
+                self.on_append()  # wake the replication senders
+            self._fire("apply")
             self._inserts_since_snap += 1
             if self._inserts_since_snap >= self.snap_every:
                 self.maybe_seal()
             return seqno
+
+    def _fire(self, site: str) -> None:
+        if self.fire_faults:
+            serve_faults.fire(site)
+
+    def _tail_push(self, seqno: int, payload: bytes) -> None:
+        self._wal_tail.append((seqno, payload))
+        if len(self._wal_tail) > REPL_TAIL_KEEP:
+            drop = len(self._wal_tail) - REPL_TAIL_KEEP
+            del self._wal_tail[:drop]
+            self.repl_floor = self._wal_tail[0][0] - 1
+
+    def apply_replicated(self, seqno: int, payload: bytes) -> str:
+        """Fold one record shipped by the leader into a FOLLOWER's state
+        (serve/replicate.py).  The record lands in the local WAL under
+        the leader's seqno (same durability order as :meth:`insert`:
+        append + fsync -> apply), so a follower crash recovers through
+        the exact snapshot+replay path a leader does.
+
+        Returns ``"applied"`` or ``"dup"`` (seqno already applied — a
+        re-sent frame, dropped idempotently).  A seqno that would leave
+        a gap raises :class:`ReplicationGap`: the stream lost a record
+        (injected ``drop`` or a real torn connection) and the follower
+        must re-sync from its applied seqno instead of corrupting order.
+        """
+        with self._lock:
+            if seqno <= self.applied_seqno:
+                return "dup"
+            if seqno != self.applied_seqno + 1:
+                raise ReplicationGap(self.applied_seqno + 1, seqno)
+            pairs = decode_inserts(payload)  # refuse garbage pre-append
+            self._wal.append_at(seqno, payload)
+            self._fire("wal")
+            self._apply_pairs(pairs)
+            self.applied_seqno = seqno
+            self._tail_push(seqno, payload)
+            if self.on_append is not None:
+                self.on_append()  # chained replication / status hooks
+            self._fire("apply")
+            self._inserts_since_snap += 1
+            if self._inserts_since_snap >= self.snap_every:
+                self.maybe_seal()
+            return "applied"
+
+    def records_from(self, seqno: int):
+        """Replication backlog: every retained record with a seqno
+        beyond ``seqno``, or None when the request predates the
+        retention window (the follower needs a snapshot bootstrap, not
+        a stream)."""
+        with self._lock:
+            if seqno < self.repl_floor:
+                return None
+            return [(s, p) for s, p in self._wal_tail if s > seqno]
 
     def _apply_pairs(self, pairs: np.ndarray) -> None:
         """Fold one decoded batch into the live state (also the WAL
@@ -664,19 +807,24 @@ class ServeCore:
                 ins_head=np.asarray(self.ins_head, dtype=np.uint32),
                 drift_cut=self.drift_cut, baseline_ecv=self.baseline_ecv,
                 graph_path=self.graph_path or "", sig=self.sig,
-                balance=self.balance)
+                balance=self.balance, epoch=self.epoch,
+                epoch_base=self.epoch_base)
             path = os.path.join(self.state_dir,
                                 snap_name(self.applied_seqno))
             save_serve_snapshot(path, snap, self.governor)
             # the snapshot is durable: later records are redundant — swap
             # in a fresh log.  A crash between the two leaves <=applied
             # records in the old log, which replay skips by seqno.
-            create_wal(wal_path(self.state_dir), self.sig)
+            create_wal(wal_path(self.state_dir), self.sig,
+                       epoch=self.epoch)
             self._wal.close()
             self._wal = WalAppender(wal_path(self.state_dir),
                                     expect_sig=self.sig)
             self._wal.next_seqno = self.applied_seqno + 1
             self._inserts_since_snap = 0
+            # the replication window deliberately survives the swap:
+            # followers one record behind keep streaming (trim is by
+            # count, _tail_push), only the on-disk log starts fresh
             self._gc_snapshots(keep=KEEP_SNAPSHOTS)
             return path
 
@@ -700,6 +848,110 @@ class ServeCore:
                     os.unlink(p)
                 except OSError:
                     pass
+
+    # -- replication epoch transitions -------------------------------------
+
+    def advance_epoch(self, new_epoch: int) -> str:
+        """Move this state into a later replication term: archive the
+        outgoing epoch's log (the fsck audit trail for the promotion
+        boundary), bump the epoch, and seal a snapshot so the boundary
+        is durable before anyone is told about it.  Used by a follower
+        PROMOTING to leader and by a follower ADOPTING a new leader's
+        epoch mid-stream (serve/cluster.py, serve/replicate.py).
+
+        Raises with the epoch UNCHANGED if the seal fails — a promotion
+        that cannot persist its fence must not claim it."""
+        with self._lock:
+            if new_epoch <= self.epoch:
+                raise ValueError(
+                    f"epoch must advance: {new_epoch} <= {self.epoch}")
+            wpath = wal_path(self.state_dir)
+            arch = os.path.join(self.state_dir,
+                                archived_wal_name(self.epoch))
+            try:
+                import shutil
+                shutil.copyfile(wpath, arch)
+                with open(arch, "rb") as f:
+                    os.fsync(f.fileno())
+            except OSError as exc:
+                # the archive is an audit artifact, not a recovery
+                # dependency (every record is in the sealed snapshot)
+                warnings.warn(f"serve: could not archive epoch-"
+                              f"{self.epoch} WAL ({exc})")
+            old = self.epoch
+            old_base = self.epoch_base
+            self.epoch = new_epoch
+            self.epoch_base = self.applied_seqno
+            try:
+                return self.seal_snapshot()
+            except BaseException:
+                self.epoch = old
+                self.epoch_base = old_base
+                raise
+
+    def reset_from_snapshot(self, snap: ServeSnapshot) -> None:
+        """Follower full re-sync: discard the local chain and adopt a
+        snapshot shipped by the leader (the stream could not be resumed
+        — the follower lagged past the leader's WAL, or carries a fenced
+        ex-leader's divergent tail).  Every intermediate crash window
+        re-opens consistently: the local log is emptied FIRST (the local
+        history is being discarded either way), the adopted snapshot is
+        sealed under its own epoch, and only then is the stale chain
+        removed — :meth:`open` prefers the higher epoch throughout."""
+        snap.validate()
+        with self._lock:
+            if snap.sig != self.sig:
+                raise IntegrityError(
+                    f"replication snapshot belongs to a different build "
+                    f"input (sig {snap.sig[:12]}..., ours "
+                    f"{self.sig[:12]}...) — refusing to adopt")
+            if (snap.epoch, snap.applied_seqno) < (self.epoch,
+                                                   self.applied_seqno):
+                raise IntegrityError(
+                    f"replication snapshot (epoch {snap.epoch}, seqno "
+                    f"{snap.applied_seqno}) is older than the local state "
+                    f"(epoch {self.epoch}, seqno {self.applied_seqno}) — "
+                    f"refusing to roll back")
+            old_snaps = snap_paths(self.state_dir)
+            from .wal import archived_wal_paths
+            for p in archived_wal_paths(self.state_dir):
+                try:
+                    os.unlink(p)
+                except OSError:
+                    pass
+            # 1. empty the local log (old epoch): the divergent/lagged
+            #    tail is discarded by design, and the dir still opens
+            self._wal.close()
+            create_wal(wal_path(self.state_dir), self.sig,
+                       epoch=self.epoch)
+            # 2. seal the adopted snapshot; open() now picks it by epoch
+            path = os.path.join(self.state_dir,
+                                snap_name(snap.applied_seqno))
+            save_serve_snapshot(path, snap, self.governor)
+            # 3. fresh log for the adopted epoch, then drop stale chain
+            create_wal(wal_path(self.state_dir), self.sig,
+                       epoch=snap.epoch)
+            self._wal = WalAppender(wal_path(self.state_dir),
+                                    expect_sig=self.sig)
+            self._wal.next_seqno = snap.applied_seqno + 1
+            for p in old_snaps:
+                if p != path:
+                    for q in (p, sidecar_path(p)):
+                        try:
+                            os.unlink(q)
+                        except OSError:
+                            pass
+            self._load_snapshot(snap)
+
+    def snapshot_bytes(self) -> tuple[bytes, int, int]:
+        """Seal the current state and return ``(blob, applied_seqno,
+        epoch)`` — the bootstrap payload a leader ships to a follower
+        that cannot be served from the live WAL (serve/replicate.py)."""
+        with self._lock:
+            path = self.seal_snapshot()
+            with open(path, "rb") as f:
+                blob = f.read()
+            return blob, self.applied_seqno, self.epoch
 
     # -- repartition -------------------------------------------------------
 

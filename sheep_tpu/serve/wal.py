@@ -13,7 +13,15 @@ bit-identical to the pre-crash tree at every insert boundary.
 On-disk format (little-endian throughout)::
 
     header   "SHEEPWAL" | uint32 version | 64-byte ascii input signature
+             | uint64 epoch                                  (version 2)
     record   uint64 seqno | uint32 payload_len | uint32 crc32 | payload
+
+``epoch`` (ISSUE 7) stamps the log with the replication term that wrote
+it: every leader promotion bumps the epoch and swaps in a fresh log, so
+two logs with different epochs must cover DISJOINT seqno ranges — the
+fence that makes a rejoining ex-leader's divergent tail detectable
+instead of silently merged (``sheep fsck`` refuses cross-epoch seqno
+overlap).  Version-1 logs (pre-replication state dirs) read as epoch 0.
 
 ``crc32`` (zlib, pinned — the WAL must verify on any host, so the algo is
 not environment-gated like sidecars) covers seqno + payload_len + payload.
@@ -40,6 +48,7 @@ never acknowledged.
 from __future__ import annotations
 
 import os
+import re
 import struct
 import warnings
 import zlib
@@ -52,10 +61,12 @@ from ..resources.governor import ResourceGovernor
 
 WAL_NAME = "serve.wal"
 _MAGIC = b"SHEEPWAL"
-_VERSION = 1
+_VERSION = 2
 _SIG_BYTES = 64  # ascii sha256 hexdigest
 
-_HEADER = struct.Struct(f"<8sI{_SIG_BYTES}s")
+_HEADER_V1 = struct.Struct(f"<8sI{_SIG_BYTES}s")
+#: v2 appends the replication epoch; new logs always write v2
+_HEADER = struct.Struct(f"<8sI{_SIG_BYTES}sQ")
 _RECORD = struct.Struct("<QII")  # seqno, payload_len, crc32
 
 #: refuse absurd record claims up front (a corrupt length field must not
@@ -68,28 +79,48 @@ def wal_path(state_dir: str) -> str:
     return os.path.join(state_dir, WAL_NAME)
 
 
+def archived_wal_name(epoch: int) -> str:
+    return f"serve-e{epoch:06d}.wal"
+
+
+def archived_wal_paths(state_dir: str) -> list[str]:
+    """Epoch-archived logs in the dir, oldest epoch first.  A promotion
+    (state.ServeCore.advance_epoch) copies the outgoing epoch's log aside
+    before sealing, so the seqno hand-off across the promotion boundary
+    stays auditable by ``sheep fsck``."""
+    import glob
+    out = []
+    for path in glob.glob(os.path.join(glob.escape(state_dir),
+                                       "serve-e*.wal")):
+        if re.match(r"^serve-e\d{6}\.wal$", os.path.basename(path)):
+            out.append(path)
+    return sorted(out)
+
+
 def _record_crc(seqno: int, payload: bytes) -> int:
     head = struct.pack("<QI", seqno, len(payload))
     return zlib.crc32(payload, zlib.crc32(head)) & 0xFFFFFFFF
 
 
-def create_wal(path: str, sig: str, base_seqno: int = 0) -> None:
+def create_wal(path: str, sig: str, epoch: int = 0) -> None:
     """Write a fresh, empty WAL (crash-safely — the old log, if any, stays
-    intact until the new one is complete).  ``base_seqno`` is advisory
-    context for humans; replay ordering comes from the records."""
+    intact until the new one is complete), stamped with the replication
+    ``epoch`` that owns it (0 = never promoted / standalone)."""
     sig_b = sig.encode("ascii")
     if len(sig_b) != _SIG_BYTES:
         raise ValueError(f"input signature must be {_SIG_BYTES} ascii "
                          f"chars, got {len(sig_b)}")
+    if epoch < 0:
+        raise ValueError(f"negative WAL epoch {epoch}")
     with atomic_write(path, "wb", expect_bytes=_HEADER.size) as f:
-        f.write(_HEADER.pack(_MAGIC, _VERSION, sig_b))
+        f.write(_HEADER.pack(_MAGIC, _VERSION, sig_b, epoch))
 
 
 def read_wal(path: str, mode: str | None = None):
-    """Parse the whole log.  Returns ``(sig, records, clean_end, torn)``:
-    ``records`` is a list of (seqno, payload) in log order, ``clean_end``
-    the byte offset after the last intact record, ``torn`` whether bytes
-    follow it.  Never mutates the file (fsck uses this too).
+    """Parse the whole log.  Returns ``(sig, epoch, records, clean_end,
+    torn)``: ``records`` is a list of (seqno, payload) in log order,
+    ``clean_end`` the byte offset after the last intact record, ``torn``
+    whether bytes follow it.  Never mutates the file (fsck uses this too).
 
     Policy (``mode``: strict/repair/trust, default SHEEP_INTEGRITY):
     strict raises MalformedArtifact on a torn tail; repair/trust warn and
@@ -100,17 +131,27 @@ def read_wal(path: str, mode: str | None = None):
     mode = resolve_policy(mode)
     with open(path, "rb") as f:
         data = f.read()
-    if len(data) < _HEADER.size:
+    if len(data) < _HEADER_V1.size:
         raise MalformedArtifact(
             f"{path}: corrupt WAL — {len(data)} bytes is shorter than the "
-            f"{_HEADER.size}-byte header")
-    magic, version, sig_b = _HEADER.unpack_from(data, 0)
+            f"{_HEADER_V1.size}-byte header")
+    magic, version, sig_b = _HEADER_V1.unpack_from(data, 0)
     if magic != _MAGIC:
         raise MalformedArtifact(
             f"{path}: corrupt WAL — bad magic {magic!r}")
     if version > _VERSION:
         raise MalformedArtifact(
             f"{path}: WAL version {version} > supported {_VERSION}")
+    if version >= 2:
+        if len(data) < _HEADER.size:
+            raise MalformedArtifact(
+                f"{path}: corrupt WAL — v2 log of {len(data)} bytes is "
+                f"shorter than the {_HEADER.size}-byte epoch header")
+        magic, version, sig_b, epoch = _HEADER.unpack_from(data, 0)
+        header_size = _HEADER.size
+    else:
+        epoch = 0  # pre-replication log: never promoted
+        header_size = _HEADER_V1.size
     try:
         sig = sig_b.decode("ascii")
     except UnicodeDecodeError:
@@ -118,7 +159,7 @@ def read_wal(path: str, mode: str | None = None):
                                 f"input signature in header")
 
     records: list[tuple[int, bytes]] = []
-    off = _HEADER.size
+    off = header_size
     bad_at = None  # (offset, reason) of the first unreadable record
     last_seqno = None
     while off < len(data):
@@ -149,7 +190,7 @@ def read_wal(path: str, mode: str | None = None):
         off += _RECORD.size + length
 
     if bad_at is None:
-        return sig, records, off, False
+        return sig, epoch, records, off, False
 
     # A bad record is only a TEAR if nothing valid follows it; scan for a
     # clean record past the damage — finding one means mid-chain rot.
@@ -176,7 +217,7 @@ def read_wal(path: str, mode: str | None = None):
             msg + "; refusing in strict mode (repair mode truncates the "
                   "torn tail)")
     warnings.warn(msg + "; salvaging the clean prefix")
-    return sig, records, tail_off, True
+    return sig, epoch, records, tail_off, True
 
 
 def repair_wal(path: str) -> int:
@@ -184,7 +225,7 @@ def repair_wal(path: str) -> int:
     ServeCore.open).  Returns the number of bytes removed (0 when the log
     was already clean).  Mid-chain corruption still raises — truncation
     can only ever amputate a tear, never resurrect rot."""
-    _, _, clean_end, torn = read_wal(path, "repair")
+    _, _, _, clean_end, torn = read_wal(path, "repair")
     if not torn:
         return 0
     size = os.path.getsize(path)
@@ -206,7 +247,7 @@ class WalAppender:
 
     def __init__(self, path: str, expect_sig: str | None = None,
                  governor: ResourceGovernor | None = None):
-        sig, records, clean_end, _ = read_wal(path, "strict")
+        sig, epoch, records, clean_end, _ = read_wal(path, "strict")
         if expect_sig is not None and sig != expect_sig:
             raise IntegrityError(
                 f"{path}: WAL belongs to a different build input "
@@ -214,6 +255,7 @@ class WalAppender:
                 f"refusing to append")
         self.path = path
         self.sig = sig
+        self.epoch = epoch
         self.next_seqno = (records[-1][0] + 1) if records else 1
         self.governor = governor if governor is not None \
             else ResourceGovernor.from_env()
@@ -227,10 +269,21 @@ class WalAppender:
         boundary and the error re-raises typed (DiskExhausted/WriteFault
         for ENOSPC/EIO, real or injected): a failed append leaves no
         trace, so it can be retried or refused without a repair pass."""
+        return self.append_at(self.next_seqno, payload)
+
+    def append_at(self, seqno: int, payload: bytes) -> int:
+        """Append one record under a CALLER-chosen seqno (the follower
+        apply path, serve/replicate.py: a replica logs records under the
+        leader's numbering so the two logs stay comparable).  ``seqno``
+        must keep the chain strictly monotone; same durability contract
+        as :meth:`append`."""
         if len(payload) > MAX_PAYLOAD:
             raise ValueError(f"WAL payload of {len(payload)} bytes exceeds "
                              f"the {MAX_PAYLOAD} cap")
-        seqno = self.next_seqno
+        if seqno < self.next_seqno:
+            raise ValueError(
+                f"{self.path}: append_at seqno {seqno} would break the "
+                f"strictly-monotone chain (next is {self.next_seqno})")
         rec = _RECORD.pack(seqno, len(payload),
                            _record_crc(seqno, payload)) + payload
         start = self._f.tell()

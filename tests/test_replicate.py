@@ -1,0 +1,690 @@
+"""Replicated-serve tests (ISSUE 7): torn replication streams at every
+byte boundary, kill-the-leader-at-every-insert-boundary failover with
+bit-identical promoted state, epoch fencing (divergent ex-leader tails
+roll back, cross-epoch seqno overlap refused by fsck), deterministic
+network fault injection (drop/dup/partition), snapshot bootstrap, and
+the live leader/follower cluster over real sockets."""
+
+import os
+import shutil
+import time
+
+import numpy as np
+import pytest
+
+from sheep_tpu.core.forest import build_forest
+from sheep_tpu.integrity.errors import IntegrityError
+from sheep_tpu.integrity.fsck import fsck_paths
+from sheep_tpu.io import faultfs
+from sheep_tpu.io.edges import write_dat
+from sheep_tpu.serve import faults as serve_faults
+from sheep_tpu.serve import netfaults
+from sheep_tpu.serve.cluster import (ClusterConfig, choose_successor,
+                                     resolve_peer)
+from sheep_tpu.serve.daemon import ServeConfig, ServeDaemon
+from sheep_tpu.serve.faults import ServeKilled, parse_serve_fault_plan
+from sheep_tpu.serve.netfaults import parse_netfault_plan
+from sheep_tpu.serve.protocol import ServeClient, ServeError
+from sheep_tpu.serve.replicate import (ReplApplier, ReplProtocolError,
+                                       bootstrap_state_dir, encode_append,
+                                       encode_ping, parse_frame,
+                                       payload_crc)
+from sheep_tpu.serve.state import (ServeCore, encode_inserts,
+                                   load_serve_snapshot)
+from sheep_tpu.utils.synth import rmat_edges
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean_fault_plans():
+    faultfs.clear_plan()
+    serve_faults.clear_plan()
+    netfaults.clear_plan()
+    yield
+    faultfs.clear_plan()
+    serve_faults.clear_plan()
+    netfaults.clear_plan()
+
+
+def _wait_until(cond, timeout_s=15.0, poll_s=0.02, what="condition"):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if cond():
+            return
+        time.sleep(poll_s)
+    raise TimeoutError(f"{what} not reached in {timeout_s}s")
+
+
+def _make_state(tmp_path, name, seed=5, log2=7, parts=3):
+    tail, head = rmat_edges(log2, 4 << log2, seed=seed)
+    g = str(tmp_path / f"{name}.dat")
+    write_dat(g, tail, head)
+    sd = str(tmp_path / name)
+    core = ServeCore.bootstrap(sd, graph_path=g, num_parts=parts)
+    return core, sd, tail, head
+
+
+# ---------------------------------------------------------------------------
+# frame codec + plan grammars
+# ---------------------------------------------------------------------------
+
+
+def test_netfault_plan_grammar():
+    plan = parse_netfault_plan("drop@repl:3, dup@hb:0,partition@*:1")
+    assert len(plan.faults) == 3
+    assert plan.take("repl", 3) == "drop"
+    assert plan.take("repl", 3) is None  # entries fire once
+    for bad in ("drop@repl", "boom@repl:1", "drop@nowhere:1"):
+        with pytest.raises(ValueError):
+            parse_netfault_plan(bad)
+
+
+def test_frame_codec_roundtrip():
+    payload = encode_inserts(np.array([[3, 9], [1, 4]], np.uint32))
+    line = encode_append(2, 17, payload)
+    f = parse_frame(line)
+    assert (f.kind, f.epoch(), f.seqno()) == ("APPEND", 2, 17)
+    assert f.payload == payload
+    p = parse_frame(encode_ping(1, 5))
+    assert (p.kind, p.epoch(), p.seqno()) == ("PING", 1, 5)
+    # corruption: flip a payload character -> crc refuses
+    bad = line.replace("data=", "data=A", 1)
+    with pytest.raises(ReplProtocolError):
+        parse_frame(bad)
+    for bad in ("PART 1", "REPL APPEND epoch=0", "REPL WHAT a=1",
+                "REPL APPEND epoch=0 seqno=-1 crc=0 data="):
+        with pytest.raises(ReplProtocolError):
+            parse_frame(bad)
+
+
+def test_choose_successor_rule():
+    # highest (applied_seqno, node_id) wins, totally ordered
+    assert choose_successor([(5, "a"), (7, "b"), (7, "a")]) == "b"
+    assert choose_successor([(7, "a")]) == "a"
+    with pytest.raises(ValueError):
+        choose_successor([])
+
+
+def test_cluster_config(monkeypatch):
+    monkeypatch.setenv("SHEEP_SERVE_ROLE", "follower")
+    monkeypatch.setenv("SHEEP_SERVE_PEERS", "a:1, b/dir ,")
+    monkeypatch.setenv("SHEEP_SERVE_REPL_ACKS", "2")
+    monkeypatch.setenv("SHEEP_SERVE_MAX_LAG", "16")
+    cfg = ClusterConfig.from_env()
+    assert cfg.role == "follower"
+    assert cfg.peers == ["a:1", "b/dir"]
+    assert cfg.repl_acks == 2 and cfg.max_lag == 16 and cfg.clustered
+    with pytest.raises(ValueError):
+        ClusterConfig(role="king")
+
+
+def test_resolve_peer(tmp_path):
+    assert resolve_peer("127.0.0.1:901") == ("127.0.0.1", 901)
+    assert resolve_peer(":902") == ("127.0.0.1", 902)
+    sd = tmp_path / "node"
+    sd.mkdir()
+    assert resolve_peer(str(sd)) is None  # no addr published yet
+    (sd / "serve.addr").write_text("10.0.0.7 4242\n")
+    assert resolve_peer(str(sd)) == ("10.0.0.7", 4242)
+    assert resolve_peer(str(sd / "serve.addr")) == ("10.0.0.7", 4242)
+    assert resolve_peer("not-a-port") is None
+
+
+# ---------------------------------------------------------------------------
+# the follower applier: torn streams, duplicates, gaps
+# ---------------------------------------------------------------------------
+
+
+def test_torn_stream_at_every_byte_boundary(tmp_path):
+    """Cut the leader->follower byte stream at EVERY byte boundary of a
+    3-record frame sequence: the follower applies exactly the frames
+    wholly before the cut — never a partial record — and its tree is
+    bit-identical to the oracle over the delivered prefix (the
+    replication mirror of the PR-6 torn-WAL sweep)."""
+    leader, lsd, tail, head = _make_state(tmp_path, "lead")
+    ins = np.array([[2, 9], [3, 7], [1, 11]], np.uint32)
+    frames = []
+    for row in ins:
+        seqno = leader.insert(row.reshape(1, 2))
+        payload = leader.records_from(seqno - 1)[0][1]
+        frames.append(encode_append(leader.epoch, seqno, payload))
+    blob = ("\n".join(frames) + "\n").encode("ascii")
+    bounds = []
+    off = 0
+    for fr in frames:
+        off += len(fr) + 1
+        bounds.append(off)
+
+    base, bsd, _, _ = _make_state(tmp_path, "base")
+    base.close()
+    # reference trees per delivered-prefix length
+    want = []
+    for k in range(len(ins) + 1):
+        at = np.concatenate([tail, ins[:k, 0]])
+        ah = np.concatenate([head, ins[:k, 1]])
+        want.append(build_forest(at, ah, base.seq,
+                                 max_vid=len(base.parts) - 1).parent)
+
+    for cut in range(len(blob) + 1):
+        sd_n = str(tmp_path / f"cut-{cut}")
+        shutil.copytree(bsd, sd_n)
+        fol = ServeCore.open(sd_n)
+        sent = []
+        applier = ReplApplier(fol, sent.append)
+        applier.feed(blob[:cut])
+        n_complete = sum(1 for b in bounds if b <= cut)
+        assert fol.applied_seqno == n_complete, f"cut at byte {cut}"
+        np.testing.assert_array_equal(fol.parent, want[n_complete])
+        # every applied record was ACKed, cumulative
+        acks = [s for s in sent if s.startswith("REPL ACK")]
+        assert len(acks) == n_complete
+        # the remainder of the stream completes the replica exactly
+        applier.feed(blob[cut:])
+        assert fol.applied_seqno == len(ins)
+        np.testing.assert_array_equal(fol.parent, want[-1])
+        fol.close()
+    leader.close()
+
+
+def test_corrupt_frame_nacks_without_apply(tmp_path):
+    leader, _, _, _ = _make_state(tmp_path, "lead")
+    seqno = leader.insert(np.array([[2, 9]], np.uint32))
+    payload = leader.records_from(0)[0][1]
+    line = encode_append(leader.epoch, seqno, payload)
+    follower, _, _, _ = _make_state(tmp_path, "fol")
+    before = follower.parent.copy()
+    sent = []
+    applier = ReplApplier(follower, sent.append)
+    # flip one payload byte inside the base64: crc must refuse, the
+    # follower must NOT apply, and must ask for a re-stream
+    broken = line.replace("data=", "data=Q", 1) + "\n"
+    applier.feed(broken.encode("ascii"))
+    assert follower.applied_seqno == 0
+    np.testing.assert_array_equal(follower.parent, before)
+    assert applier.frame_errors == 1
+    assert sent and sent[-1] == "REPL NACK expect=1"
+    # the clean retransmission lands
+    applier.feed((line + "\n").encode("ascii"))
+    assert follower.applied_seqno == 1
+    leader.close()
+    follower.close()
+
+
+def test_dup_and_gap_handling(tmp_path):
+    leader, _, _, _ = _make_state(tmp_path, "lead")
+    payloads = []
+    for i in range(3):
+        seqno = leader.insert(np.array([[i, i + 5]], np.uint32))
+        payloads.append((seqno, leader.records_from(seqno - 1)[0][1]))
+    follower, _, _, _ = _make_state(tmp_path, "fol")
+    sent = []
+    applier = ReplApplier(follower, sent.append)
+
+    def frame(i):
+        s, p = payloads[i]
+        return (encode_append(0, s, p) + "\n").encode("ascii")
+
+    applier.feed(frame(0) + frame(0))  # duplicate: applied once
+    assert follower.applied_seqno == 1 and applier.dups == 1
+    applier.feed(frame(2))  # gap: seqno 3 without 2 -> NACK, no apply
+    assert follower.applied_seqno == 1 and applier.gaps == 1
+    assert sent[-1] == "REPL NACK expect=2"
+    applier.feed(frame(1) + frame(2))  # re-stream heals
+    assert follower.applied_seqno == 3
+    # a PING advertising a seqno we lack also NACKs (drop detector)
+    applier.feed((encode_ping(0, 9) + "\n").encode("ascii"))
+    assert sent[-1] == "REPL NACK expect=4"
+    leader.close()
+    follower.close()
+
+
+# ---------------------------------------------------------------------------
+# the acceptance property: kill the leader at every insert boundary
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.faults
+def test_kill_leader_at_every_insert_boundary_failover(tmp_path):
+    """For EVERY insert index and both durability boundaries (site wal:
+    record durable before apply; site apply: applied before ack), kill
+    the leader and promote the follower: the promoted tree must be
+    bit-identical to the batch oracle over exactly the delivered
+    inserts, with equal ECV(down), and every insert the client saw
+    acked must be present.  The client then retries the unacked
+    remainder against the promoted leader and must end bit-identical to
+    the uninterrupted run."""
+    base, bsd, tail, head = _make_state(tmp_path, "base")
+    base.close()
+    rng = np.random.default_rng(23)
+    ins = rng.integers(0, 140, size=(6, 2)).astype(np.uint32)
+
+    # the uninterrupted oracle
+    def oracle_parent(k):
+        at = np.concatenate([tail, ins[:k, 0]])
+        ah = np.concatenate([head, ins[:k, 1]])
+        return build_forest(at, ah, base.seq,
+                            max_vid=len(base.parts) - 1).parent
+
+    full_want = oracle_parent(len(ins))
+
+    for site in ("wal", "apply"):
+        for nth in range(len(ins)):
+            lsd = str(tmp_path / f"L-{site}-{nth}")
+            fsd = str(tmp_path / f"F-{site}-{nth}")
+            shutil.copytree(bsd, lsd)
+            shutil.copytree(bsd, fsd)
+            leader = ServeCore.open(lsd)
+            follower = ServeCore.open(fsd)
+            follower.fire_faults = False  # the plan names the LEADER
+            acks = []
+            applier = ReplApplier(follower, acks.append)
+
+            def deliver():
+                recs = leader.records_from(follower.applied_seqno)
+                for s, p in recs or []:
+                    applier.feed((encode_append(leader.epoch, s, p)
+                                  + "\n").encode("ascii"))
+
+            serve_faults.install_plan(parse_serve_fault_plan(
+                f"kill@{site}:{nth}", kill_mode="raise"))
+            acked = 0
+            killed_at = None
+            for i, row in enumerate(ins):
+                try:
+                    leader.insert(row.reshape(1, 2))
+                    deliver()  # sync replication: deliver before ack
+                    acked += 1
+                except ServeKilled:
+                    killed_at = i
+                    break
+            serve_faults.clear_plan()
+            assert killed_at == nth and acked == nth
+            leader.close()
+
+            # promotion: epoch fence sealed durably, then serve
+            follower.advance_epoch(leader.epoch + 1)
+            assert follower.epoch == 1
+            # bit-identical to the oracle over the delivered prefix,
+            # equal ECV(down), zero acked inserts lost
+            np.testing.assert_array_equal(follower.parent,
+                                          oracle_parent(nth))
+            assert follower.applied_seqno == nth >= acked
+            rsd = str(tmp_path / f"ref-{site}-{nth}")
+            shutil.copytree(bsd, rsd)  # never mutate the shared base
+            ref = ServeCore.open(rsd)
+            for row in ins[:nth]:
+                ref.insert(row.reshape(1, 2))
+            assert follower.ecv()["ecv_down"] == ref.ecv()["ecv_down"]
+            ref.close()
+            shutil.rmtree(rsd)
+            # surviving state dir must fsck clean across the boundary
+            _, failures = fsck_paths([fsd], "strict")
+            assert not failures, failures
+
+            # the client retries the unacked remainder on the new leader
+            for row in ins[nth:]:
+                follower.insert(row.reshape(1, 2))
+            np.testing.assert_array_equal(follower.parent, full_want)
+            follower.close()
+            # ... and the promoted dir still recovers bit-identically
+            revived = ServeCore.open(fsd)
+            assert revived.epoch == 1
+            np.testing.assert_array_equal(revived.parent, full_want)
+            revived.close()
+            shutil.rmtree(lsd)
+            shutil.rmtree(fsd)
+
+
+def test_fenced_ex_leader_divergent_tail_rolls_back(tmp_path):
+    """Partition story at the core level: the ex-leader applied records
+    past the promotion point that were never acked or replicated; on
+    rejoin it must adopt the new leader's snapshot, ROLLING BACK the
+    divergent tail, and end bit-identical to the new history."""
+    base, bsd, tail, head = _make_state(tmp_path, "base")
+    base.close()
+    lsd = str(tmp_path / "exlead")
+    fsd = str(tmp_path / "newlead")
+    shutil.copytree(bsd, lsd)
+    shutil.copytree(bsd, fsd)
+    ex = ServeCore.open(lsd)
+    new = ServeCore.open(fsd)
+    shared = np.array([[2, 9], [3, 7]], np.uint32)
+    for row in shared:  # replicated prefix on both
+        ex.insert(row.reshape(1, 2))
+    for s, p in ex.records_from(0):
+        new.apply_replicated(s, p)
+    ex.insert(np.array([[5, 30]], np.uint32))  # divergent, never acked
+    assert ex.applied_seqno == 3 and new.applied_seqno == 2
+
+    new.advance_epoch(1)  # promotion on the other side of the partition
+    new.insert(np.array([[8, 40]], np.uint32))  # epoch-1 record, seqno 3
+
+    # heal: ex-leader must refuse to stream (its seqno 3 > epoch_base 2
+    # on an older epoch) and instead adopt the snapshot, tail gone
+    blob, s_applied, s_epoch = new.snapshot_bytes()
+    tmp = str(tmp_path / "xfer.snap")
+    open(tmp, "wb").write(blob)
+    snap = load_serve_snapshot(tmp, integrity="trust")
+    ex.reset_from_snapshot(snap)
+    assert (ex.epoch, ex.applied_seqno) == (1, 3)
+    np.testing.assert_array_equal(ex.parent, new.parent)
+    np.testing.assert_array_equal(ex.pst, new.pst)
+    # the rolled-back dir recovers to the SAME adopted state
+    ex.close()
+    revived = ServeCore.open(lsd)
+    assert (revived.epoch, revived.applied_seqno) == (1, 3)
+    np.testing.assert_array_equal(revived.parent, new.parent)
+    revived.close()
+    _, failures = fsck_paths([lsd], "strict")
+    assert not failures, failures
+    # rolling BACKWARD is refused: the new leader must never adopt the
+    # fenced snapshot of an older term
+    with pytest.raises(IntegrityError):
+        old_blob = open(tmp, "rb").read()
+        del old_blob
+        stale = load_serve_snapshot(tmp, integrity="trust")
+        stale.epoch = 0
+        new.reset_from_snapshot(stale)
+    new.close()
+
+
+def test_fsck_refuses_cross_epoch_overlap(tmp_path):
+    """The promotion boundary is auditable: a clean promoted dir passes
+    fsck; an epoch-0 log forged to reach past the epoch-1 boundary is
+    refused as cross-epoch seqno overlap."""
+    core, sd, _, _ = _make_state(tmp_path, "node")
+    for i in range(4):
+        core.insert(np.array([[i, i + 2]], np.uint32))
+    core.advance_epoch(1)
+    core.insert(np.array([[1, 9]], np.uint32))
+    core.close()
+    results, failures = fsck_paths([sd], "strict")
+    assert not failures, failures
+    wals = sorted(d for _, _, d in results)
+    assert any("epoch=0" in d for _, _, d in results)
+    assert any("epoch=1" in d for _, _, d in results)
+    del wals
+    # forge: extend the ARCHIVED epoch-0 log past the epoch-1 boundary
+    from sheep_tpu.serve.wal import WalAppender, archived_wal_paths
+    with WalAppender(archived_wal_paths(sd)[0]) as w:
+        w.append(encode_inserts(np.array([[7, 8]], np.uint32)))
+    _, failures = fsck_paths([sd], "strict")
+    assert failures and "cross-epoch" in failures[0][2]
+
+
+# ---------------------------------------------------------------------------
+# the live cluster over sockets
+# ---------------------------------------------------------------------------
+
+
+def _abrupt_kill(daemon):
+    """In-process stand-in for kill -9: no goodbye to anyone — sockets
+    die, threads die, nothing flushes or demotes gracefully."""
+    daemon._stop.set()
+    daemon._wake()
+    if daemon.watcher is not None:
+        daemon.watcher.stop()
+    daemon.hub.stop()
+    try:
+        daemon._listener.close()
+    except OSError:
+        pass
+    for conn in list(daemon._conns.values()):
+        try:
+            conn.sock.close()
+        except OSError:
+            pass
+    if daemon._hb is not None:
+        daemon._hb.stop()
+    try:
+        os.unlink(os.path.join(daemon.core.state_dir, "serve.addr"))
+    except OSError:
+        pass
+
+
+def _spawn_cluster(tmp_path, n_followers=1, hb_s=0.05, failover_s=0.6,
+                   **cluster_kw):
+    """One leader + N wire-bootstrapped followers, fully attached."""
+    lcore, lsd, tail, head = _make_state(tmp_path, "lead")
+    all_dirs = [lsd] + [str(tmp_path / f"f{i}") for i in range(n_followers)]
+    lead = ServeDaemon(
+        lcore, ServeConfig(),
+        cluster=ClusterConfig(node_id="L", role="leader",
+                              peers=[d for d in all_dirs if d != lsd],
+                              hb_s=hb_s, failover_s=failover_s,
+                              poll_timeout_s=1.0, **cluster_kw)).start()
+    lh, lp = lead.address
+    followers = []
+    for i in range(n_followers):
+        fsd = all_dirs[1 + i]
+        bootstrap_state_dir(fsd, lh, lp)
+        fcore = ServeCore.open(fsd)
+        fol = ServeDaemon(
+            fcore, ServeConfig(),
+            cluster=ClusterConfig(node_id=f"F{i}", role="follower",
+                                  peers=[d for d in all_dirs if d != fsd],
+                                  hb_s=hb_s, failover_s=failover_s,
+                                  poll_timeout_s=1.0,
+                                  **cluster_kw)).start()
+        followers.append(fol)
+    _wait_until(lambda: lead.hub.follower_count() == n_followers,
+                what="followers attached")
+    return lead, followers, (tail, head)
+
+
+def test_cluster_replicates_redirects_and_fails_over(tmp_path):
+    """The cluster acceptance, end to end on real sockets: synchronous
+    replication (OK means the follower has it), follower reads with
+    parity + typed write redirect, role/epoch/lag in STATS, abrupt
+    leader death -> epoch-fenced promotion with zero acked inserts
+    lost, and the fenced ex-leader rejoining as a follower (write
+    availability restored through the new quorum)."""
+    lead, (fol,), (tail, head) = _spawn_cluster(tmp_path)
+    lh, lp = lead.address
+    fh, fp = fol.address
+    acked = []
+    with ServeClient(lh, lp) as c:
+        rng = np.random.default_rng(3)
+        for _ in range(12):
+            u, v = (int(x) for x in rng.integers(0, 140, size=2))
+            c.insert([(u, v)])
+            acked.append((u, v))
+        st = c.kv("STATS")
+        assert st["role"] == "leader" and st["followers"] == 1
+        assert st["applied_seqno"] == len(acked)
+    # sync acks: the follower already has every acked insert
+    assert fol.core.applied_seqno == len(acked)
+    np.testing.assert_array_equal(fol.core.parent, lead.core.parent)
+
+    with ServeClient(fh, fp) as c:
+        st = c.kv("STATS")
+        assert st["role"] == "follower" and st["repl_lag"] == 0
+        assert st["leader"] == f"{lh}:{lp}"
+        assert c.part([0, 1, 2]) == [lead.core.part(v) for v in (0, 1, 2)]
+        with pytest.raises(ServeError) as ei:
+            c.insert([(1, 2)])
+        assert ei.value.code == "notleader"
+        assert f"{lh}:{lp}" in ei.value.detail
+
+    _abrupt_kill(lead)
+    _wait_until(lambda: fol.role == "leader", what="promotion")
+    assert fol.core.epoch == 1
+    # zero acknowledged inserts lost, bit-identical serving state
+    assert fol.core.applied_seqno == len(acked)
+    at = np.concatenate([tail, np.array([u for u, _ in acked], np.uint32)])
+    ah = np.concatenate([head, np.array([v for _, v in acked], np.uint32)])
+    want = build_forest(at, ah, fol.core.seq,
+                        max_vid=len(fol.core.parts) - 1)
+    np.testing.assert_array_equal(fol.core.parent, want.parent)
+
+    # the fenced ex-leader returns — and demotes instead of splitting
+    excore = ServeCore.open(lead.core.state_dir)
+    assert excore.epoch == 0
+    ex = ServeDaemon(
+        excore, ServeConfig(),
+        cluster=ClusterConfig(node_id="L", role="leader",
+                              peers=[fol.core.state_dir], hb_s=0.05,
+                              failover_s=0.6, poll_timeout_s=1.0)).start()
+    assert ex.role == "follower"
+    assert ("fenced_at_start", 1) in ex.config.events
+    _wait_until(lambda: fol.hub.follower_count() == 1,
+                what="ex-leader attached as follower")
+    # write availability is back: the new quorum acks through the
+    # rejoined follower, which also adopts the new epoch
+    with ServeClient(fh, fp) as c:
+        c.insert([(4, 9)])
+        st = c.kv("STATS")
+        assert st["role"] == "leader" and st["epoch"] == 1
+    _wait_until(lambda: excore.applied_seqno == len(acked) + 1,
+                what="ex-leader caught up")
+    assert excore.epoch == 1
+    np.testing.assert_array_equal(excore.parent, fol.core.parent)
+    ex.shutdown()
+    fol.shutdown()
+
+
+def test_quorum_insert_refused_without_followers(tmp_path):
+    """A clustered leader whose followers are all gone refuses writes
+    typed (the CP choice: an OK no replica holds could be lost to
+    failover) and keeps serving reads."""
+    core, sd, _, _ = _make_state(tmp_path, "lonely")
+    d = ServeDaemon(core, ServeConfig(),
+                    cluster=ClusterConfig(
+                        node_id="L", role="leader",
+                        peers=[str(tmp_path / "ghost")], hb_s=0.05,
+                        failover_s=30.0, poll_timeout_s=0.2)).start()
+    try:
+        h, p = d.address
+        with ServeClient(h, p) as c:
+            with pytest.raises(ServeError) as ei:
+                c._ok("DEADLINE=0.3 INSERT 1 2")
+            assert ei.value.code == "unavailable"
+            assert "quorum" in ei.value.detail
+            assert c.part([0])  # reads unaffected
+            assert d.counters["repl_quorum_fails"] == 1
+    finally:
+        d.shutdown()
+
+
+def test_netfaults_drop_dup_partition_on_live_stream(tmp_path):
+    """Deterministic wire chaos: a dropped frame heals by NACK
+    re-stream, a duplicated frame applies once, a partitioned stream
+    reconnects — every case converging bit-identical, nothing acked
+    lost."""
+    lead, (fol,), _ = _spawn_cluster(tmp_path, hb_s=0.05,
+                                     failover_s=30.0)
+    lh, lp = lead.address
+    netfaults.install_plan(parse_netfault_plan(
+        "drop@repl:1,dup@repl:3,partition@repl:5"))
+    with ServeClient(lh, lp) as c:
+        for i in range(8):
+            # generous deadline: the dropped frame waits out one hb PING
+            # before the NACK re-stream completes the quorum
+            c._ok(f"DEADLINE=20 INSERT {i} {i + 9}")
+    _wait_until(lambda: fol.core.applied_seqno == 8,
+                what="follower converged")
+    np.testing.assert_array_equal(fol.core.parent, lead.core.parent)
+    assert fol.core.applied_seqno == lead.core.applied_seqno == 8
+    rep = fol.replicator
+    assert rep is not None and rep.applier is not None
+    lead.shutdown()
+    fol.shutdown()
+
+
+def test_snapshot_resync_when_stream_window_passed(tmp_path,
+                                                   monkeypatch):
+    """A follower that falls behind the leader's retention window must
+    bootstrap from a snapshot instead of streaming — and end
+    bit-identical anyway."""
+    from sheep_tpu.serve import state as state_mod
+    monkeypatch.setattr(state_mod, "REPL_TAIL_KEEP", 2)
+    lcore, lsd, tail, head = _make_state(tmp_path, "lead")
+    # follower dir exists from the same artifacts but never streamed
+    fsd = str(tmp_path / "fol")
+    shutil.copytree(lsd, fsd)
+    for i in range(10):  # retention window now only holds the last 2
+        lcore.insert(np.array([[i, i + 3]], np.uint32))
+    assert lcore.records_from(0) is None
+    lead = ServeDaemon(lcore, ServeConfig(),
+                       cluster=ClusterConfig(node_id="L", role="leader",
+                                             peers=[fsd], hb_s=0.05,
+                                             failover_s=30.0)).start()
+    fcore = ServeCore.open(fsd)
+    fol = ServeDaemon(fcore, ServeConfig(),
+                      cluster=ClusterConfig(node_id="F", role="follower",
+                                            peers=[lsd], hb_s=0.05,
+                                            failover_s=30.0)).start()
+    _wait_until(lambda: fcore.applied_seqno == 10, what="resync")
+    assert fol.replicator.resyncs == 1
+    np.testing.assert_array_equal(fcore.parent, lcore.parent)
+    _, failures = fsck_paths([fsd], "strict")
+    assert not failures, failures
+    lead.shutdown()
+    fol.shutdown()
+
+
+def test_follower_bounded_staleness_refusal(tmp_path):
+    """A follower that cannot reach any leader refuses reads typed
+    once its lag bound is configured — bounded staleness, not silent
+    time travel."""
+    core, sd, _, _ = _make_state(tmp_path, "stale")
+    d = ServeDaemon(core, ServeConfig(),
+                    cluster=ClusterConfig(
+                        node_id="F", role="follower",
+                        peers=[str(tmp_path / "ghost")], max_lag=0,
+                        hb_s=0.05, failover_s=30.0,
+                        poll_timeout_s=0.2)).start()
+    try:
+        h, p = d.address
+        with ServeClient(h, p) as c:
+            with pytest.raises(ServeError) as ei:
+                c.part([0])
+            assert ei.value.code == "stale"
+            assert c.kv("STATS")["role"] == "follower"  # STATS always on
+    finally:
+        d.shutdown()
+
+
+def test_supervise_status_on_serve_dir(tmp_path):
+    """`sheep supervise --status` renders a serve state dir: live role/
+    epoch/lag over the wire, dead-daemon fallback from the status file
+    and snapshots."""
+    from sheep_tpu.supervisor.status import serve_status_json
+    lead, (fol,), _ = _spawn_cluster(tmp_path, hb_s=0.05,
+                                     failover_s=30.0)
+    with ServeClient(*lead.address) as c:
+        c.insert([(3, 8)])
+    live = serve_status_json(lead.core.state_dir)
+    assert live["alive"] and live["role"] == "leader"
+    assert live["applied_seqno"] == 1 and live["followers"] == 1
+    fstat = serve_status_json(fol.core.state_dir)
+    assert fstat["alive"] and fstat["role"] == "follower"
+    lead._write_status(force=True)
+    _abrupt_kill(lead)
+    dead = serve_status_json(lead.core.state_dir)
+    assert not dead["alive"]
+    assert dead["role"] == "leader" and dead["applied_seqno"] == 1
+    assert dead["heartbeat_age_s"] is not None
+    fol.shutdown()
+
+
+def test_pipelined_connection_keeps_order(tmp_path):
+    """The selectors loop serializes one connection's requests while
+    other connections proceed: a pipelined burst answers in order."""
+    import socket as socket_mod
+    core, sd, _, _ = _make_state(tmp_path, "pipe")
+    d = ServeDaemon(core, ServeConfig()).start()
+    try:
+        h, p = d.address
+        s = socket_mod.create_connection((h, p), timeout=10)
+        burst = b"".join(f"PART {i}\n".encode() for i in range(50))
+        s.sendall(burst + b"PING\n")
+        rf = s.makefile("rb")
+        lines = [rf.readline().decode().strip() for _ in range(51)]
+        assert lines[-1] == "OK pong"
+        for i, line in enumerate(lines[:50]):
+            assert line == f"OK {core.part(i)}", (i, line)
+        s.close()
+    finally:
+        d.shutdown()

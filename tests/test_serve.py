@@ -66,7 +66,7 @@ def test_wal_roundtrip(tmp_path):
     p = str(tmp_path / "serve.wal")
     payloads = [b"alpha", b"", b"x" * 1000]
     _wal_with_records(p, payloads)
-    sig, records, end, torn = read_wal(p, "strict")
+    sig, epoch, records, end, torn = read_wal(p, "strict")
     assert sig == SIG and not torn
     assert [r[1] for r in records] == payloads
     assert [r[0] for r in records] == [1, 2, 3]
@@ -106,20 +106,20 @@ def test_wal_torn_at_every_byte_boundary(tmp_path):
             f.write(blob[:cut])
         n_complete = sum(1 for b in bounds if b <= cut) - 1
         if cut in bounds:
-            sig, records, end, torn = read_wal(torn_path, "strict")
+            sig, epoch, records, end, torn = read_wal(torn_path, "strict")
             assert not torn and len(records) == n_complete
         else:
             with pytest.raises(MalformedArtifact):
                 read_wal(torn_path, "strict")
             with pytest.warns(UserWarning):
-                _, records, end, torn = read_wal(torn_path, "repair")
+                _, _, records, end, torn = read_wal(torn_path, "repair")
             assert torn and len(records) == n_complete
             assert end == bounds[n_complete]
             with pytest.warns(UserWarning):
                 dropped = repair_wal(torn_path)
             assert dropped == cut - bounds[n_complete]
             # after repair the log is strict-clean with the same prefix
-            _, records2, _, torn2 = read_wal(torn_path, "strict")
+            _, _, records2, _, torn2 = read_wal(torn_path, "strict")
             assert not torn2
             assert [r[1] for r in records2] == payloads[:n_complete]
 
@@ -167,12 +167,12 @@ def test_wal_append_fault_injection(tmp_path):
             with pytest.raises(exc_type):
                 w.append(b"doomed")
             assert os.path.getsize(p) == size0  # truncated back
-            _, records, _, torn = read_wal(p, "strict")
+            _, _, records, _, torn = read_wal(p, "strict")
             assert not torn and len(records) == 1
             # the armed entry fired; the retry lands clean
             assert w.append(b"retry") == 2
         faultfs.clear_plan()
-        _, records, _, _ = read_wal(p, "strict")
+        _, _, records, _, _ = read_wal(p, "strict")
         assert [r[1] for r in records] == [b"base", b"retry"]
 
 
@@ -330,7 +330,7 @@ def test_open_strict_refuses_torn_wal_repair_truncates(tmp_path):
         revived = ServeCore.open(sd, integrity="repair")
     # the torn (never-acknowledged) insert is gone; state = snapshot
     assert revived.applied_seqno == 0
-    _, records, _, torn = read_wal(w, "strict")
+    _, _, records, _, torn = read_wal(w, "strict")
     assert not torn and not records  # physically truncated
     revived.close()
 

@@ -301,9 +301,11 @@ def _run_one(log_n: int) -> dict:
         # after any real load phase the edges are resident in host RAM as
         # well as HBM; on accelerators the host copy lets the hybrid
         # recompute seq/pst host-side (bit-identical) instead of fetching
-        # 2n*4B through the ~10MB/s tunnel (on cpu the fetch is free)
-        he = (tail, head) if platform != "cpu" else None
-        return build_graph_hybrid(t, h, n, host_edges=he, perf=perf)
+        # 2n*4B through the ~10MB/s tunnel, and on cpu it enables the
+        # streaming handoff's host-seq prep (native counting-sort
+        # sequence + device link mapping — ops/build.host_seq_mode)
+        return build_graph_hybrid(t, h, n, host_edges=(tail, head),
+                                  perf=perf)
 
     from sheep_tpu.utils.envinfo import env_capture
     rec = {"log_n": log_n, "edges": e, "platform": platform,
@@ -332,13 +334,21 @@ def _run_one(log_n: int) -> dict:
                      "times": [round(x, 4) for x in times],
                      "edges_per_sec": round(e / best, 1)}
         # overlap/pipeline observability for on-chip interpretation: the
-        # best rep's reduce+fetch breakdown and speculation counters
-        # (hybrid only; keys are set by reduce_and_fetch_links)
+        # best rep's reduce+tail breakdown — the streaming windowed
+        # handoff's per-window fetch/fold timers and overlap fraction
+        # (reduce_and_finish_native), plus the legacy speculation
+        # counters when the serial path ran
         best_perf = perfs[times.index(best)]
         if best_perf:
             rec[name]["perf"] = {k: v for k, v in best_perf.items()
                                  if k in ("loop_s", "fetch_tail_s",
-                                          "overlap")
+                                          "overlap", "stream_mode",
+                                          "fetch_windows", "fold_s",
+                                          "window_fetch_s",
+                                          "window_fold_s", "overlap_s",
+                                          "overlap_frac",
+                                          "handoff_links",
+                                          "packed_handoff")
                                  or k.startswith("spec_")}
         if name == "device":
             rec[name]["rounds"] = int(out[1])

@@ -55,55 +55,59 @@ def main() -> None:
         seq, _, m, lo, hi, pst = prepare_links(t, h, n)
         int(jnp.max(lo[:1]) + jnp.max(hi[:1]))  # scalar fetch: sync
         t0 = mark("prep", t0)
-        # THE production reduce+fetch (ops.build.reduce_and_fetch_links —
-        # shared with build_graph_hybrid so this profile and the watcher
-        # A/Bs measure exactly what the hybrid ships, including the
-        # overlapped speculative handoff on accelerators).  loop_s /
-        # fetch_tail_s are the serialized equivalents of the old
-        # reduce / d2h phases: with overlap on, d2h shows only the
-        # NON-hidden tail of the link fetch.  NOTE: production also
-        # overlaps the seq/pst fetch via a prefetch thread — this
-        # breakdown serializes that part, so d2h stays an upper bound.
+        # THE production reduce+tail (ops.build.reduce_and_finish_native
+        # — shared with build_graph_hybrid so this profile and the
+        # watcher A/Bs measure exactly what the hybrid ships: the
+        # streaming windowed handoff by default, the serial fetch + the
+        # speculative snapshot when SHEEP_STREAM_HANDOFF=0).  With the
+        # stream, the old d2h/native phases merge into one overlapped
+        # tail: d2h reports fetch_tail_s minus the fold, native reports
+        # the fold, and the per-window breakdown rides along verbatim.
         from sheep_tpu.ops.build import (handoff_input_ok,
-                                         reduce_and_fetch_links,
+                                         reduce_and_finish_native,
                                          fetch_links_host)
         perf: dict = {}
-        kind, a, b, live, rounds = reduce_and_fetch_links(
+        res = reduce_and_finish_native(
             lo, hi, n, stop_live=factor * n,
-            handoff_input=handoff_input_ok(), perf=perf)
+            handoff_input=handoff_input_ok(),
+            pst_h=lambda: np.asarray(pst).astype(np.uint32),
+            accumulate_pst_ok=True, perf=perf)
+        rounds, live = res[4], int(res[3])
         if record is not None:
             record["rounds"] = rounds
-            record["live"] = int(live)
-            record["converged"] = kind == "device"
+            record["live"] = live
+            record["converged"] = res[0] == "device"
             # rounds == 0: the immediate-handoff skip fired and `live`
             # is the sentinel-inclusive input length, NOT a post-round
             # live count — don't compare it against older records
-            record["immediate_handoff"] = rounds == 0 and kind == "host"
+            record["immediate_handoff"] = rounds == 0 and res[0] != "device"
             record["reduce"] = perf.get("loop_s")
-            # packing mode + overlap + actual handed-off link count
-            # ride along so A/B arms are auditable from the artifact
-            # alone (ADVICE r05: the ab_pack_off arm could not prove
-            # the knob toggled)
+            # packing mode + stream/overlap counters + actual handed-off
+            # link count ride along so A/B arms are auditable from the
+            # artifact alone
             record.update({k: v for k, v in perf.items()
                            if k in ("overlap", "packed_handoff",
-                                    "handoff_links")
+                                    "handoff_links", "stream_mode",
+                                    "fetch_windows", "window_fetch_s",
+                                    "window_fold_s", "overlap_s",
+                                    "overlap_frac", "fold_s")
                            or k.startswith("spec_")})
-        t0 = time.perf_counter()
-        if kind == "device":  # converged: links already form the forest
-            lo_h, hi_h, _ = fetch_links_host(a, b, live, n)
-        else:
-            lo_h, hi_h = a, b
-        pst_h = np.asarray(pst).astype(np.uint32)
-        seq_h = np.asarray(seq)
-        t1 = time.perf_counter()
+        if res[0] == "device":  # converged: links already form the forest
+            t0 = time.perf_counter()
+            lo_h, hi_h, _ = fetch_links_host(res[1], res[2], live, n)
+            pst_h = np.asarray(pst).astype(np.uint32)
+            t0 = mark("d2h", t0)
+            native = native_or_none("auto")
+            parent_h, _ = native.build_forest_links(
+                lo_h.astype(np.uint32), hi_h.astype(np.uint32), n, pst_h)
+            t0 = mark("native", t0)
+            return parent_h
+        _, parent_h, pst_out, _, _ = res
         if record is not None:
+            fold = perf.get("fold_s", 0.0) or 0.0
             record["d2h"] = round(
-                perf.get("fetch_tail_s", 0.0) + (t1 - t0), 4)
-        t0 = t1
-        native = native_or_none("auto")
-        parent_h, pst_out = native.build_forest_links(
-            lo_h.astype(np.uint32), hi_h.astype(np.uint32), n, pst_h)
-        t0 = mark("native", t0)
+                max(0.0, perf.get("fetch_tail_s", 0.0) - fold), 4)
+            record["native"] = round(fold, 4)
         return parent_h
 
     one(None)  # compile

@@ -192,6 +192,48 @@ then
 fi
 # -------------------------------------------------------------------------
 
+# --- streaming-handoff smoke (hybrid tail, ISSUE 8) ----------------------
+# Forced-on windowed handoff at a small n — the host-side window split at
+# W=4, the accelerator window queue (device hi-sort + slice stream)
+# forced on cpu, and the resumable fold — each bit-identical to the
+# oracle.  Seconds of work; a regression in the round-7 streaming tail
+# fails the gate before pytest even runs.
+if ! env JAX_PLATFORMS=cpu SHEEP_STREAM_HANDOFF=1 SHEEP_HANDOFF_WINDOWS=4 \
+     python - <<'EOF'
+import os
+import numpy as np
+from sheep_tpu.core import build_forest, degree_sequence
+from sheep_tpu.ops import build_graph_hybrid
+from sheep_tpu.utils.synth import rmat_edges
+
+n = 1 << 12
+tail, head = rmat_edges(12, 4 * n, seed=31)
+want_seq = degree_sequence(tail, head)
+want = build_forest(tail, head, want_seq)
+
+perf = {}
+seq, forest = build_graph_hybrid(tail, head, n, perf=perf)
+assert perf.get("stream_mode") == "windowed", perf
+np.testing.assert_array_equal(seq, want_seq)
+np.testing.assert_array_equal(forest.parent, want.parent)
+np.testing.assert_array_equal(forest.pst_weight, want.pst_weight)
+
+# accelerator transfer machinery (window queue) forced on cpu
+os.environ["SHEEP_STREAM_DEVICE_WINDOWS"] = "1"
+os.environ["SHEEP_OVERLAP_SLICE"] = "16384"
+perf2 = {}
+seq2, forest2 = build_graph_hybrid(tail, head, n, perf=perf2)
+assert perf2.get("stream_mode") == "windowed", perf2
+np.testing.assert_array_equal(forest2.parent, want.parent)
+np.testing.assert_array_equal(forest2.pst_weight, want.pst_weight)
+EOF
+then
+  echo "STREAM-HANDOFF SMOKE FAILED: windowed handoff diverged from the" \
+       "oracle" >&2
+  exit 1
+fi
+# -------------------------------------------------------------------------
+
 # --- serve smoke (crash-safe partition service, ISSUE 6) -----------------
 # Start a real bin/serve subprocess on a tiny graph, query + insert over
 # the wire, kill -9, restart from the same state dir, and assert the

@@ -170,6 +170,92 @@ def _build_forest_links_pre(lo, hi, n, pst, compute_pre: bool, impl: str):
     return Forest(parent, pst.astype(np.uint32)), pre
 
 
+class PyLinksFold:
+    """Python-oracle twin of the native resumable fold
+    (:class:`sheep_tpu.native.LinksFold`): the exact link build consumed
+    one ascending-hi window at a time against shared union-find state.
+
+    This is the parity oracle for the streaming windowed handoff and the
+    fallback when the native runtime is unavailable.  Same contract:
+    windows ascend by hi (an equal-hi group may split across adjacent
+    windows — exact, because within one hi-group distinct component roots
+    each adopt exactly once and repeats are no-ops regardless of order);
+    an out-of-order window raises ValueError.  ``pst`` None accumulates
+    pst from the streamed records (original-multiset callers only).
+    """
+
+    def __init__(self, n: int, pst: np.ndarray | None = None):
+        self.n = n
+        self.accumulate_pst = pst is None
+        self.parent = np.full(n, INVALID_JNID, dtype=np.uint32)
+        self._pst = np.zeros(n, dtype=np.int64) if pst is None \
+            else np.asarray(pst, dtype=np.int64).copy()
+        self._uf = np.arange(n, dtype=np.int64)
+        self._bound = 0
+
+    def block(self, lo: np.ndarray, hi: np.ndarray) -> None:
+        n = self.n
+        lo = np.asarray(lo, dtype=np.int64)
+        hi = np.asarray(hi, dtype=np.int64)
+        if len(lo) and int(lo.max()) >= n:
+            raise ValueError(f"malformed link: lo >= n ({n})")
+        if self.accumulate_pst and len(lo):
+            self._pst += np.bincount(lo, minlength=n)[:n]
+        linked = hi < n
+        lo, hi = lo[linked], hi[linked]
+        if len(hi) and int(hi.min()) < self._bound:
+            raise ValueError(
+                "out-of-order fold window: a linked hi precedes the "
+                "previous window's range — windows must ascend by hi")
+        order = np.argsort(hi, kind="stable")
+        lo_s, hi_s = lo[order], hi[order]
+        uf, parent = self._uf, self.parent
+        m = len(lo_s)
+        i = 0
+        while i < m:
+            h = int(hi_s[i])
+            adopted = []
+            while i < m and int(hi_s[i]) == h:
+                r = _find(uf, int(lo_s[i]))
+                if r != h and parent[r] == INVALID_JNID:
+                    parent[r] = h
+                    adopted.append(r)
+                i += 1
+            for r in adopted:  # deferred re-root (adoptKids)
+                uf[r] = h
+        if m:
+            self._bound = max(self._bound, int(hi_s[-1]))
+
+    def finish(self) -> tuple[np.ndarray, np.ndarray]:
+        return self.parent, self._pst.astype(np.uint32)
+
+
+def host_hi_window_bounds(hi: np.ndarray, w: int, n: int) -> list[int]:
+    """Equal-count hi-quantile window boundaries over an UNSORTED host hi
+    array — the numpy twin of parallel.chunked.hi_window_bounds
+    (np.partition at the quantile ranks, no full sort, no device
+    dispatch).  Window k keeps hi in [bounds[k], bounds[k+1]); used by
+    the cpu-side split of the streaming windowed handoff (ops.build) and
+    the driver's stream rung, so every windowing site shares one rule."""
+    cnt = len(hi)
+    ks = sorted({(k * cnt) // w for k in range(1, w)})
+    if not ks or cnt == 0:
+        return [0, n]
+    mid = np.partition(np.asarray(hi), ks)[ks]
+    return [0, *(int(x) for x in mid), n]
+
+
+def links_fold(n: int, pst: np.ndarray | None = None, impl: str = "auto"):
+    """Resolve a resumable link fold: the native
+    :class:`~sheep_tpu.native.LinksFold` when built, else the
+    :class:`PyLinksFold` oracle.  Both expose ``block(lo, hi)`` +
+    ``finish() -> (parent, pst)`` with identical semantics."""
+    native = native_or_none(impl)
+    if native is not None:
+        return native.LinksFold(n, pst)
+    return PyLinksFold(n, pst)
+
+
 def pre_weights(tail: np.ndarray, head: np.ndarray, seq: np.ndarray,
                 max_vid: int | None = None, impl: str = "auto") -> np.ndarray:
     """The reference's pre_weight array for a graph + sequence.

@@ -77,6 +77,16 @@ def _bind(lib: ctypes.CDLL) -> None:
     lib.sheep_build_forest_edges.argtypes = [
         _u32p, _u32p, ctypes.c_int64, _u32p, ctypes.c_int64,
         ctypes.c_int64, _u32p, _u32p, ctypes.c_void_p]
+    lib.sheep_build_forest_links_begin.restype = ctypes.c_int
+    lib.sheep_build_forest_links_begin.argtypes = [
+        ctypes.c_int64, ctypes.c_void_p, _u32p, _u32p, _u32p]
+    lib.sheep_build_forest_links_block.restype = ctypes.c_int64
+    lib.sheep_build_forest_links_block.argtypes = [
+        _u32p, _u32p, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+        ctypes.c_int32, _u32p, _u32p, _u32p]
+    lib.sheep_build_forest_links_finish.restype = ctypes.c_int
+    lib.sheep_build_forest_links_finish.argtypes = [
+        ctypes.c_int64, _u32p, _u32p]
     lib.sheep_forward_partition.restype = ctypes.c_int64
     lib.sheep_forward_partition.argtypes = [
         _u32p, _i64p, ctypes.c_int64, ctypes.c_int64, _i32p]
@@ -138,6 +148,72 @@ def build_forest_links(lo: np.ndarray, hi: np.ndarray, n: int,
     if compute_pre:
         return parent, pst_out, pre_out
     return parent, pst_out
+
+
+class LinksFold:
+    """Resumable native link fold (sheep_build_forest_links_begin/_block/
+    _finish): the exact forest build consumed one ascending-hi window at a
+    time, so the streaming handoff can fold window k while window k+1 is
+    still in flight and the full link table never materializes host-side.
+
+    Blocks must arrive in ascending-hi order (an equal-hi group may split
+    across adjacent blocks — exact, see the kernel comment); ``block``
+    raises ValueError on an out-of-order window so a mis-sliced stream
+    fails loudly instead of building a different forest.  ``pst`` None
+    means the fold accumulates pst from the streamed records themselves —
+    exact only when the windows together carry the ORIGINAL link multiset
+    (the immediate-handoff stream); reduced/rewritten links need the
+    prep-time pst passed here.
+    """
+
+    def __init__(self, n: int, pst: np.ndarray | None = None):
+        lib = _load()
+        assert lib is not None
+        self._lib = lib
+        self.n = n
+        self.accumulate_pst = pst is None
+        self.parent = np.empty(n, dtype=np.uint32)
+        self.pst = np.empty(n, dtype=np.uint32)
+        self._uf = np.empty(n, dtype=np.uint32)
+        self._bound = 0
+        self._done = False
+        pst_ptr = None
+        if pst is not None:
+            pst = np.ascontiguousarray(pst, dtype=np.uint32)
+            pst_ptr = pst.ctypes.data_as(ctypes.c_void_p)
+        rc = lib.sheep_build_forest_links_begin(n, pst_ptr, self.parent,
+                                                self.pst, self._uf)
+        if rc != 0:
+            raise RuntimeError(f"sheep_build_forest_links_begin rc={rc}")
+
+    def block(self, lo: np.ndarray, hi: np.ndarray) -> None:
+        """Fold one window of links (uint32-safe arrays, every lo < n,
+        linked hi >= every previous window's linked hi)."""
+        assert not self._done, "fold already finished"
+        lo = np.ascontiguousarray(lo, dtype=np.uint32)
+        hi = np.ascontiguousarray(hi, dtype=np.uint32)
+        r = self._lib.sheep_build_forest_links_block(
+            lo, hi, len(lo), self.n, self._bound,
+            1 if self.accumulate_pst else 0, self.parent, self.pst,
+            self._uf)
+        if r == -7:
+            raise ValueError(
+                "out-of-order fold window: a linked hi precedes the "
+                "previous window's range — windows must ascend by hi")
+        if r == -3:
+            raise ValueError(f"malformed link: lo >= n ({self.n})")
+        if r < 0:
+            raise RuntimeError(f"sheep_build_forest_links_block rc={r}")
+        self._bound = int(r)
+
+    def finish(self) -> tuple[np.ndarray, np.ndarray]:
+        """Seal the fold; returns (parent, pst) uint32 [n]."""
+        rc = self._lib.sheep_build_forest_links_finish(self.n, self.parent,
+                                                       self._uf)
+        if rc != 0:
+            raise RuntimeError(f"sheep_build_forest_links_finish rc={rc}")
+        self._done = True
+        return self.parent, self.pst
 
 
 def blocked_enabled() -> bool:

@@ -178,6 +178,80 @@ static inline void adopt_group(const uint32_t* grp, int64_t len, uint32_t hh,
 static inline uint32_t rec_lo(uint64_t r) { return (uint32_t)r; }
 static inline int64_t rec_h(uint64_t r) { return (int64_t)(r >> 32); }
 
+static void blocked_group_adopt(const uint32_t* lo, const uint32_t* hi,
+                                int64_t m, int64_t n, uint32_t* pst_out,
+                                uint32_t* uf, uint32_t* parent_out,
+                                uint32_t* pre_out, PhaseTimer& pt);
+
+// Unblocked grouping + adoption (counting sort by hi, then the shared
+// adopt_group): the small-input path of sheep_build_forest, factored so
+// the resumable block fold below reuses it verbatim.
+static void plain_group_adopt(const uint32_t* lo, const uint32_t* hi,
+                              int64_t m, int64_t n, uint32_t* pst_out,
+                              uint32_t* uf, uint32_t* parent_out,
+                              uint32_t* pre_out, PhaseTimer& pt) {
+  if (pst_out)
+    for (int64_t i = 0; i < m; ++i) ++pst_out[lo[i]];
+  pt.mark("pst");
+  std::vector<int64_t> offs((size_t)n + 1, 0);
+  for (int64_t i = 0; i < m; ++i)
+    if (hi[i] < (uint64_t)n) ++offs[hi[i] + 1];
+  pt.mark("count");
+  for (int64_t h = 0; h < n; ++h) offs[h + 1] += offs[h];
+  int64_t linked = offs[n];
+  std::vector<uint32_t> lo_by_hi((size_t)linked);
+  {
+    std::vector<int64_t> cur(offs.begin(), offs.end() - 1);
+    for (int64_t i = 0; i < m; ++i)
+      if (hi[i] < (uint64_t)n) lo_by_hi[(size_t)cur[hi[i]]++] = lo[i];
+  }
+  pt.mark("scatter");
+  std::vector<uint32_t> adopted;
+  for (int64_t h = 0; h < n; ++h)
+    adopt_group(lo_by_hi.data() + offs[h], offs[h + 1] - offs[h],
+                (uint32_t)h, uf, parent_out, pre_out, adopted);
+  pt.mark("adopt");
+}
+
+// One block of the resumable link fold — sheep_build_forest's loop split
+// at the block boundary (the streaming windowed handoff, round-7).
+// Blocks must arrive in ascending-hi order: every linked record (hi < n)
+// must satisfy hi >= lo_bound, where lo_bound is the previous block's
+// return value (0 for the first).  An equal-hi group MAY split across
+// adjacent blocks: within one hi-group the adoption order cannot change
+// parent (distinct component roots each adopt exactly once, repeats are
+// no-ops, and a root adopted by the first half is found AS h by the
+// second half's uf chase — the same no-op), so a boundary landing inside
+// a group is exact.  ``accumulate_pst`` adds 1 to pst_out[lo] per record
+// (pst-only links hi >= n included) — exact only when the blocks
+// together carry the ORIGINAL link multiset; chunk-rewritten callers
+// pass their prep-time pst at begin instead.  Returns the new bound
+// (max linked hi seen), -3 on a malformed lo, -7 on an out-of-order
+// block (which would silently build a different forest).
+static int64_t fold_links_block(const uint32_t* lo, const uint32_t* hi,
+                                int64_t m, int64_t n, int64_t lo_bound,
+                                bool accumulate_pst, uint32_t* uf,
+                                uint32_t* parent_out, uint32_t* pst_out,
+                                uint32_t* pre_out, PhaseTimer& pt) {
+  int64_t mx = lo_bound;
+  for (int64_t i = 0; i < m; ++i) {
+    if (lo[i] >= (uint64_t)n) return -3;  // malformed link
+    if (hi[i] < (uint64_t)n) {
+      if ((int64_t)hi[i] < lo_bound) return -7;  // out-of-order block
+      if ((int64_t)hi[i] > mx) mx = (int64_t)hi[i];
+    }
+  }
+  pt.mark("validate");
+  if (use_blocked(m, n)) {
+    blocked_group_adopt(lo, hi, m, n, accumulate_pst ? pst_out : nullptr,
+                        uf, parent_out, pre_out, pt);
+  } else {
+    plain_group_adopt(lo, hi, m, n, accumulate_pst ? pst_out : nullptr,
+                      uf, parent_out, pre_out, pt);
+  }
+  return mx;
+}
+
 // Grouping + adoption of (lo, hi<n) links, shared by sheep_build_forest
 // and the fused sheep_build_forest_edges.  One global per-h count
 // builds the prefix table; EQUAL-COUNT bucket boundaries come from its
@@ -315,45 +389,68 @@ int sheep_build_forest(const uint32_t* lo, const uint32_t* hi, int64_t m,
   if (pre_out) std::memset(pre_out, 0, sizeof(uint32_t) * (size_t)n);
   std::vector<uint32_t> uf((size_t)n);
   for (int64_t v = 0; v < n; ++v) uf[(size_t)v] = (uint32_t)v;
+  (void)blocked;  // dispatch lives in fold_links_block (use_blocked)
 
-  if (!blocked) {
-    for (int64_t i = 0; i < m; ++i)
-      if (lo[i] >= (uint64_t)n) return -3;  // malformed link
-    if (!pst_in)
-      for (int64_t i = 0; i < m; ++i) ++pst_out[lo[i]];
-    pt.mark("validate+pst");
-    // Counting sort of lo values grouped by hi; pst-only links excluded.
-    std::vector<int64_t> offs((size_t)n + 1, 0);
-    for (int64_t i = 0; i < m; ++i)
-      if (hi[i] < (uint64_t)n) ++offs[hi[i] + 1];
-    pt.mark("count");
-    for (int64_t h = 0; h < n; ++h) offs[h + 1] += offs[h];
-    int64_t linked = offs[n];
-    std::vector<uint32_t> lo_by_hi((size_t)linked);
-    {
-      std::vector<int64_t> cur(offs.begin(), offs.end() - 1);
-      for (int64_t i = 0; i < m; ++i)
-        if (hi[i] < (uint64_t)n) lo_by_hi[(size_t)cur[hi[i]]++] = lo[i];
-    }
-    pt.mark("scatter");
-    std::vector<uint32_t> adopted;
-    for (int64_t h = 0; h < n; ++h)
-      adopt_group(lo_by_hi.data() + offs[h], offs[h + 1] - offs[h],
-                  (uint32_t)h, uf.data(), parent_out, pre_out, adopted);
-    pt.mark("adopt");
-    return 0;
+  // The whole input as ONE block of the resumable fold — the monolithic
+  // build and the streaming windowed handoff share every semantic by
+  // construction.  Outputs are undefined on error, so a partially
+  // filled pst at the -3 return is fine.
+  int64_t rc = fold_links_block(lo, hi, m, n, 0, !pst_in, uf.data(),
+                                parent_out, pst_out, pre_out, pt);
+  return rc < 0 ? (int)rc : 0;
+}
+
+// ---------------------------------------------------------------------------
+// Resumable link fold (streaming windowed handoff, round-7): the exact
+// sheep_build_forest split at block boundaries so a host fold can consume
+// device link windows AS THEY ARRIVE (fetch of window k+1 overlapping the
+// fold of window k) without ever materializing the full link table.  All
+// state is caller-owned ([n] buffers: parent/pst plus the union-find
+// array), so the Python side can keep it across an arbitrary number of
+// blocks and recover it after a failed stream.
+// ---------------------------------------------------------------------------
+
+// Initialize the fold state.  pst_in NULL => blocks accumulate pst from
+// their own records (see fold_links_block's exactness note); non-NULL =>
+// the precomputed prep-time pst is copied and blocks leave it alone.
+int sheep_build_forest_links_begin(int64_t n, const uint32_t* pst_in,
+                                   uint32_t* parent_out, uint32_t* pst_out,
+                                   uint32_t* uf) {
+  if (n < 0) return -1;
+  if (pst_in) {
+    std::memcpy(pst_out, pst_in, sizeof(uint32_t) * (size_t)n);
+  } else {
+    std::memset(pst_out, 0, sizeof(uint32_t) * (size_t)n);
   }
-
-  // Blocked path: validate in one tight pass, then the shared
-  // quantile-bucketed grouping+adoption (which also accumulates pst
-  // unless precomputed).  Outputs are undefined on error, so a
-  // partially-filled pst at the -3 return is fine.
-  for (int64_t i = 0; i < m; ++i)
-    if (lo[i] >= (uint64_t)n) return -3;
-  pt.mark("validate");
-  blocked_group_adopt(lo, hi, m, n, pst_in ? nullptr : pst_out, uf.data(),
-                      parent_out, pre_out, pt);
+  for (int64_t v = 0; v < n; ++v) {
+    parent_out[v] = kInvalid;
+    uf[(size_t)v] = (uint32_t)v;
+  }
   return 0;
+}
+
+// Fold one ascending-hi window; see fold_links_block for the ordering
+// contract and return values (new bound >= 0, or -3/-7).
+int64_t sheep_build_forest_links_block(const uint32_t* lo, const uint32_t* hi,
+                                       int64_t m, int64_t n, int64_t lo_bound,
+                                       int32_t accumulate_pst,
+                                       uint32_t* parent_out,
+                                       uint32_t* pst_out, uint32_t* uf) {
+  if (n < 0 || m < 0 || lo_bound < 0) return -1;
+  PhaseTimer pt("links_block");
+  return fold_links_block(lo, hi, m, n, lo_bound, accumulate_pst != 0, uf,
+                          parent_out, pst_out, nullptr, pt);
+}
+
+// Seal the fold.  The ascending-hi discipline leaves no deferred work —
+// parent/pst are already final after the last block; this exists so the
+// ABI brackets the stream (begin/block/finish) and a future deferred
+// pass has a home.  Returns 0.
+int sheep_build_forest_links_finish(int64_t n, uint32_t* parent_out,
+                                    uint32_t* uf) {
+  (void)parent_out;
+  (void)uf;
+  return n < 0 ? -1 : 0;
 }
 
 // Map raw edge records to links through a vid->position table.  A vid

@@ -63,7 +63,7 @@ def prepare_links(tail: jnp.ndarray, head: jnp.ndarray, n: int,
 
 def _finish(seq, m, parent, pst):
     m = int(m)
-    seq = np.asarray(seq)[:m].astype(np.uint32)
+    seq = _as_u32(np.ascontiguousarray(np.asarray(seq)[:m]))
     # Trimmed to the m active slots; parents of active nodes are active
     # positions (< m), so the converter's n=m sentinel check is exact.
     from .forest import _to_forest
@@ -139,6 +139,19 @@ def build_graph_hybrid(tail: np.ndarray, head: np.ndarray,
     connectivity, and the elimination forest is a function of threshold
     connectivity only (module docstring of ops.forest).
 
+    The handoff itself is the STREAMING WINDOWED tail by default (round
+    7, :func:`stream_handoff_enabled` / SHEEP_STREAM_HANDOFF): the
+    reduced live set fetches as W ascending hi-quantile windows
+    (SHEEP_HANDOFF_WINDOWS; shared quantile rule with the mesh tail
+    shard), each folded through the RESUMABLE native union-find
+    (native.LinksFold) the moment it lands — fold k overlaps fetch k+1
+    and the full link table never materializes host-side.  On the cpu
+    backend the fetch is a zero-copy view, so the stream instead drops
+    the pre-fold device sort and (host_seq_mode) moves the degree
+    sequence to the native counting sort, shrinking the device program
+    to the link mapping.  Any stream failure falls back to the serial
+    fetch mid-build.
+
     Returns (seq uint32 [m], Forest over m), bit-identical to the oracle.
 
     ``handoff_factor`` tunes how reduced the link set must be before the
@@ -179,14 +192,35 @@ def build_graph_hybrid(tail: np.ndarray, head: np.ndarray,
     if n == 0:
         return np.empty(0, np.uint32), Forest(
             np.empty(0, np.uint32), np.empty(0, np.uint32))
-    if host_edges is None and jax.devices()[0].platform != "cpu" \
-            and isinstance(tail, np.ndarray) and isinstance(head, np.ndarray):
-        # auto-detect only where the d2h saving is real: on the cpu
-        # backend the device "fetch" is a near-free copy and the host
-        # recompute would compete with the reduce loop for the same cores
+    if host_edges is None \
+            and isinstance(tail, np.ndarray) and isinstance(head, np.ndarray) \
+            and (jax.devices()[0].platform != "cpu"
+                 or (stream_handoff_enabled() and handoff_input_ok())):
+        # auto-detect where the host copy buys something real: on
+        # accelerators it saves the 2n*4B seq/pst d2h; on the cpu
+        # backend it used to be off (the host recompute competed with
+        # the reduce loop for the same cores), but under the streaming
+        # immediate handoff there IS no reduce loop — the copy instead
+        # enables the host-seq prep below
         host_edges = (tail, head)
     given_seq = None
     _lazy_pst = None
+    acc_ok = False  # may the tail fold count pst from its own stream?
+    if seq is None and host_edges is not None and host_seq_mode() \
+            and stream_handoff_enabled() and handoff_input_ok():
+        # streaming cpu prep (round 7): the native counting-sort degree
+        # sequence (~6x the XLA histogram+sort on the same silicon)
+        # computed host-side UP FRONT, so the device program shrinks to
+        # the link mapping alone.  Bit-identical: the host sequence
+        # equals the device's (degree asc, vid asc — tested across all
+        # four build implementations) and given_seq_links encodes the
+        # same absent-vid contract the device mapping uses.  Every
+        # active vid is in this sequence, so no pst-only link is ever
+        # masked out — the streamed multiset stays intact and the fold
+        # may count pst itself (acc_ok).
+        from ..core.sequence import degree_sequence
+        seq = degree_sequence(host_edges[0], host_edges[1], n)
+        acc_ok = True
     if seq is not None:
         # `-s` fast path: no histogram, no device sort — links map through
         # the given position table (absent-vid contract lives in
@@ -205,12 +239,18 @@ def build_graph_hybrid(tail: np.ndarray, head: np.ndarray,
                 return given_seq_links(tail, head, given_seq, n)[2]
     else:
         # with a host edge copy the prefetch thread recomputes pst
-        # host-side — skip the device's full-E pst scatter; keep the
-        # original lo handle so the rare prefetch-failure fallback can
-        # still materialize pst on device afterwards
+        # host-side — skip the device's full-E pst scatter; same when
+        # the streaming fold will count pst in its own read pass (the
+        # immediate-handoff platforms).  Keep the original lo handle so
+        # the rare fallback can still materialize pst on device.
         dev_seq, _, m, lo, hi, pst = prepare_links(
             jnp.asarray(tail), jnp.asarray(head), n,
-            with_pst=host_edges is None)
+            with_pst=host_edges is None
+            and not (stream_handoff_enabled() and handoff_input_ok()))
+        # full-graph prep: every vid holds a position, so the link
+        # multiset carries no maskable pst-only records — the streaming
+        # fold may accumulate pst when the loop skips straight to handoff
+        acc_ok = True
         if pst is None:
             orig_lo = lo
 
@@ -229,54 +269,68 @@ def build_graph_hybrid(tail: np.ndarray, head: np.ndarray,
     # chunk loop
     import threading
     fetched: dict = {}
+    pre = None
+    if acc_ok and given_seq is not None:
+        # host-seq streaming prep: seq/m are host-known already and pst
+        # comes from the tail fold's own read pass — nothing to prefetch
+        # (the fallback paths resolve pst through _lazy_pst)
+        fetched = {"seq": given_seq, "m": len(given_seq)}
+    else:
+        def _prefetch():
+            try:
+                if host_edges is not None:
+                    t_np, h_np = host_edges
+                    fetched["seq"], fetched["pst"] = _host_seq_pst(
+                        t_np, h_np, n, seq=given_seq)
+                    # host seq is already trimmed to the m active slots,
+                    # so its length replaces the device scalar fetch
+                    # (~70ms tunneled)
+                    fetched["m"] = len(fetched["seq"])
+                else:
+                    fetched["seq"] = np.asarray(seq)
+                    if pst is not None:
+                        fetched["pst"] = np.asarray(pst)
+            except Exception:  # fall back to the synchronous fetch below
+                fetched.clear()
 
-    def _prefetch():
-        try:
-            if host_edges is not None:
-                t_np, h_np = host_edges
-                fetched["seq"], fetched["pst"] = _host_seq_pst(
-                    t_np, h_np, n, seq=given_seq)
-                # host seq is already trimmed to the m active slots, so its
-                # length replaces the device scalar fetch (~70ms tunneled)
-                fetched["m"] = len(fetched["seq"])
-            else:
-                fetched["seq"] = np.asarray(seq)
-                fetched["pst"] = np.asarray(pst)
-        except Exception:  # fall back to the synchronous fetch below
-            fetched.clear()
+        pre = threading.Thread(target=_prefetch, daemon=True)
+        pre.start()
 
-    pre = threading.Thread(target=_prefetch, daemon=True)
-    pre.start()
-    # immediate-handoff only where its trade was measured to win — the
-    # shared handoff_input_ok gate (same for the stream's final fold and
-    # the profiler, so the sites can't drift).  On accelerators the
-    # reduce and the handoff fetch run OVERLAPPED (reduce_and_fetch_links
-    # streams an early snapshot while later chunks still run).
-    kind, a, b, live, rounds = reduce_and_fetch_links(
-        lo, hi, n, stop_live=handoff_factor * n,
-        handoff_input=handoff_input_ok(), perf=perf)
     def _pst_resolved():
         # host-prefetched pst when the thread landed it; else the device
         # pst — materialized lazily when prepare_links skipped the scatter
-        # (prefetch failure is the only path that reaches the lazy case)
         if "pst" in fetched:
             return fetched["pst"]
         return pst if pst is not None else _lazy_pst()
 
-    if kind == "device":  # converged before the handoff threshold
-        pre.join()
+    def _pst_after_fetch():
+        # resolved only after the link fetch/stream has begun, so the
+        # seq/pst prefetch keeps overlapping it
+        if pre is not None:
+            pre.join()
+        return _as_u32(np.asarray(_pst_resolved()))
+
+    # immediate-handoff only where its trade was measured to win — the
+    # shared handoff_input_ok gate (same for the stream's final fold and
+    # the profiler, so the sites can't drift).  The tail is the shared
+    # production reduce+finish: the streaming windowed handoff (fold of
+    # window k overlapping fetch of window k+1) when enabled, the serial
+    # fetch + monolithic fold otherwise — bit-identical either way.
+    res = reduce_and_finish_native(
+        lo, hi, n, stop_live=handoff_factor * n,
+        handoff_input=handoff_input_ok(), pst_h=_pst_after_fetch,
+        accumulate_pst_ok=acc_ok, perf=perf)
+    if res[0] == "device":  # converged before the handoff threshold
+        _, a, b, live, rounds = res
+        if pre is not None:
+            pre.join()
         parent = parent_from_links(a, b, n)
         return _finish(fetched.get("seq", seq), fetched.get("m", m), parent,
                        _pst_resolved())
-    def _pst_after_fetch():
-        # resolved only after the link fetch has completed, so the
-        # seq/pst prefetch keeps overlapping it
-        pre.join()
-        return np.asarray(_pst_resolved()).astype(np.uint32)
-
-    parent_h, pst_out = finish_native_host(a, b, n, _pst_after_fetch)
+    _, parent_h, pst_out, live, rounds = res
     m = int(fetched.get("m", m))
-    seq_np = np.asarray(fetched.get("seq", seq))[:m].astype(np.uint32)
+    seq_np = _as_u32(np.ascontiguousarray(
+        np.asarray(fetched.get("seq", seq))[:m]))
     return seq_np, Forest(parent_h[:m].copy(), pst_out[:m].copy())
 
 
@@ -379,7 +433,8 @@ class _StreamFetcher:
     slices and an abort loses at most one slice of link time.
     """
 
-    def __init__(self, lo, hi, n: int, live: int, slice_links: int):
+    def __init__(self, lo, hi, n: int, live: int, slice_links: int,
+                 autostart: bool = True):
         self.n = n
         self.live = live
         self.packed = pack_handoff(n)  # ONE policy with fetch_links_host
@@ -394,6 +449,7 @@ class _StreamFetcher:
                                 width // self.slice_len)
         self.done_slices = 0
         self.failed = False
+        self.busy_s = 0.0  # thread time actually spent fetching slices
         self._abort = False
         self._slices: list = []
         # one elementwise pack over the padded width: pow2 shapes only,
@@ -404,13 +460,24 @@ class _StreamFetcher:
         else:
             self._dev = (lo.astype(jnp.int32), hi.astype(jnp.int32))
         self._thread = threading.Thread(target=self._run, daemon=True)
-        self._thread.start()
+        if autostart:
+            self._thread.start()
+
+    # subclass seams (the window-queue stream, _WindowStream): gate a
+    # slice before its fetch, observe one landing.  Base: free-running.
+    def _wait_turn(self, i: int) -> None:
+        pass
+
+    def _on_slice(self) -> None:
+        pass
 
     def _run(self) -> None:
         try:
             for i in range(self.total_slices):
+                self._wait_turn(i)
                 if self._abort:
                     return
+                t0 = time.perf_counter()
                 start = i * self.slice_len
                 if self.packed:
                     self._slices.append(
@@ -422,11 +489,14 @@ class _StreamFetcher:
                         (np.asarray(_slice_rows(lo_d, start, self.slice_len)),
                          np.asarray(_slice_rows(hi_d, start,
                                                 self.slice_len))))
+                self.busy_s += time.perf_counter() - t0
                 self.done_slices = i + 1
+                self._on_slice()
         except Exception:
             self.failed = True
         finally:
             self._dev = None  # release the device buffer promptly
+            self._on_slice()
 
     def finished(self) -> bool:
         return not self.failed and self.done_slices >= self.total_slices
@@ -473,6 +543,275 @@ class _StreamFetcher:
             return unpack_links_6b(np.concatenate(self._slices))
         los, his = zip(*self._slices)
         return np.concatenate(los), np.concatenate(his)
+
+
+class _WindowStream(_StreamFetcher):
+    """Window-queue generalization of the snapshot stream (the streaming
+    windowed handoff's transfer side): a hi-SORTED device link table
+    streams as fixed-length slices grouped into W equal-count windows —
+    contiguous count-slices of the sorted table ARE the hi-quantile
+    windows (parallel.chunked.hi_window_bounds rule) — and the fetch
+    thread runs at most :data:`PREFETCH` windows ahead of the fold
+    consumer.  Resident host memory is therefore O(live/W * PREFETCH),
+    never the full table; :meth:`window` hands window k to the fold and
+    frees its slices while k+1 keeps streaming underneath.
+    """
+
+    #: windows allowed in flight beyond the one being folded (double
+    #: buffering: fold k while k+1 lands and k+2 streams)
+    PREFETCH = 2
+
+    def __init__(self, lo, hi, n: int, live: int, slice_links: int,
+                 windows: int):
+        super().__init__(lo, hi, n, live, slice_links, autostart=False)
+        self._cv = threading.Condition()
+        self._consumed = -1  # highest window already handed to the fold
+        w = max(1, min(windows, self.total_slices))
+        self.windows = w
+        self._cuts = [(k * self.total_slices) // w for k in range(w + 1)]
+        self._thread.start()
+
+    def _window_of(self, i: int) -> int:
+        import bisect
+        return bisect.bisect_right(self._cuts, i) - 1
+
+    def _wait_turn(self, i: int) -> None:
+        with self._cv:
+            while (not self._abort
+                   and self._window_of(i)
+                   > self._consumed + 1 + self.PREFETCH):
+                self._cv.wait(0.5)
+
+    def _on_slice(self) -> None:
+        with self._cv:
+            self._cv.notify_all()
+
+    def window(self, k: int, timeout_s: float | None = None):
+        """Block until window k has fully landed, then return its host
+        (lo, hi) int arrays (unfiltered — callers mask lo < n) and free
+        the backing slices.  Raises RuntimeError on a failed or wedged
+        stream (the caller falls back to the serial fetch)."""
+        lo_w, hi_w = self.collect_range(self._cuts[k], self._cuts[k + 1],
+                                        timeout_s)
+        with self._cv:
+            self._consumed = max(self._consumed, k)
+            self._cv.notify_all()
+        return lo_w, hi_w
+
+    def collect_range(self, s0: int, s1: int,
+                      timeout_s: float | None = None):
+        if timeout_s is None:
+            # generous watchdog, same spirit as _SpecHandoff.complete: a
+            # wedged transfer must never hold the build forever
+            timeout_s = ((s1 - s0) * self.slice_len * self.bytes_per_link
+                         / 5e5 + 120.0)
+        deadline = time.monotonic() + timeout_s
+        with self._cv:
+            while self.done_slices < s1 and not self.failed:
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    self.failed = True
+                    break
+                self._cv.wait(min(left, 0.5))
+        if self.failed:
+            raise RuntimeError("window stream failed or timed out")
+        part = self._slices[s0:s1]
+        for i in range(s0, s1):  # bound resident memory to the window
+            self._slices[i] = None
+        if not part:
+            return np.empty(0, np.int32), np.empty(0, np.int32)
+        if self.packed:
+            from .forest import unpack_links_6b
+            return unpack_links_6b(np.concatenate(part))
+        los, his = zip(*part)
+        return np.concatenate(los), np.concatenate(his)
+
+    def abort(self, timeout: float = 5.0) -> None:
+        self._abort = True
+        with self._cv:
+            self._cv.notify_all()
+        self.join(timeout, mark_failed=False)
+
+
+def stream_handoff_enabled() -> bool:
+    """THE streaming-windowed-handoff gate (SHEEP_STREAM_HANDOFF
+    overrides; default on): the hybrid's tail consumes the reduced live
+    set as ascending hi-quantile windows, each folded through the
+    resumable native union-find (native.LinksFold — python twin without
+    the runtime) the moment it lands, so the fold of window k overlaps
+    the fetch of window k+1 and the full tail link table never
+    materializes host-side.  Any stream failure falls back to the serial
+    fetch mid-build, exactly like _SpecHandoff's failure path."""
+    v = os.environ.get("SHEEP_STREAM_HANDOFF", "")
+    if v != "":
+        return v == "1"
+    # an EXPLICIT legacy-overlap arm (SHEEP_OVERLAP_HANDOFF=1) keeps the
+    # speculative-snapshot path unless the stream is explicitly chosen
+    # too, so the round-4/5 A/B arms keep measuring what they name
+    if os.environ.get("SHEEP_OVERLAP_HANDOFF", "") == "1":
+        return False
+    return True
+
+
+def handoff_windows(live: int) -> int:
+    """Window-count policy (SHEEP_HANDOFF_WINDOWS overrides).  On the
+    cpu backend the device->host fetch is a zero-copy view — there is
+    nothing to overlap, and the blocked kernel's internal quantile
+    bucketing already IS the windowing — so ONE window is optimal.  On a
+    real accelerator the fetch is a genuine transfer: 4 windows keep the
+    fold busy behind the stream while each window stays large enough to
+    amortize its slice dispatches; tiny handoffs stay monolithic."""
+    v = os.environ.get("SHEEP_HANDOFF_WINDOWS", "")
+    if v != "":
+        return max(1, int(v))
+    if jax.devices()[0].platform == "cpu":
+        return 1
+    return 4 if live >= (1 << 20) else 1
+
+
+def host_seq_mode() -> bool:
+    """Host-computed degree sequence for the streaming hybrid
+    (SHEEP_STREAM_HOST_SEQ overrides).  Where device and host share the
+    silicon (cpu backend), the native counting-sort sequence is ~6x the
+    XLA histogram+sort and the device program shrinks to the link
+    mapping alone — measured the difference between a ~7.6s and a ~3.5s
+    hybrid at 2^22 on the 1-core bench host.  On a real accelerator the
+    device sort is cheap and a host sequence would serialize in front of
+    the mapping, so default off there."""
+    v = os.environ.get("SHEEP_STREAM_HOST_SEQ", "")
+    if v != "":
+        return v == "1"
+    return jax.devices()[0].platform == "cpu"
+
+
+def _as_u32(a: np.ndarray) -> np.ndarray:
+    """uint32 without a copy where possible: contiguous int32 (the fetch
+    dtype) reinterprets for free — exact under the package-wide
+    nonnegative-int32 value contract — instead of the unconditional
+    .astype() that used to copy multi-hundred-MB link arrays through the
+    handoff path."""
+    a = np.asarray(a)
+    if a.dtype == np.uint32:
+        return a
+    if a.dtype == np.int32 and a.flags["C_CONTIGUOUS"]:
+        return a.view(np.uint32)
+    return a.astype(np.uint32, copy=False)
+
+
+def _stream_tail(lo, hi, live: int, n: int, pst_h, accumulate: bool,
+                 perf: dict | None):
+    """The streaming windowed handoff tail: fetch the reduced live set
+    as W ascending hi-quantile windows and fold each straight into the
+    resumable union-find.  Returns (parent, pst) uint32 [n], or None on
+    ANY failure — the caller falls back to the serial fetch (the device
+    arrays are still alive), exactly like _SpecHandoff degrades.
+
+    ``accumulate`` True means the windows together carry the ORIGINAL
+    link multiset (immediate handoff, zero reduce rounds) and pst is
+    counted inside the fold's own read pass — the device/host pst
+    resolver ``pst_h`` is then never touched.  False: ``pst_h`` (array
+    or zero-arg callable) resolves AFTER the stream has started, so a
+    caller's pst prefetch keeps overlapping the first window's fetch.
+    """
+    from ..core.forest import host_hi_window_bounds, links_fold
+
+    t_start = time.perf_counter()
+    w = handoff_windows(int(live))
+    platform = jax.devices()[0].platform
+    # SHEEP_STREAM_DEVICE_WINDOWS=1 forces the accelerator transfer path
+    # (device hi-sort + _WindowStream slices) on the cpu backend — the
+    # same trick the overlap tests use, so the window-queue machinery is
+    # exercised without hardware
+    device_windows = platform != "cpu" \
+        or os.environ.get("SHEEP_STREAM_DEVICE_WINDOWS", "") == "1"
+    stream = None
+    fetch_s: list[float] = []
+    fold_s: list[float] = []
+    links_folded = 0
+    try:
+        if device_windows:
+            # device-side windowing: ONE hi-sort program, then windows
+            # are contiguous equal-count slices streamed double-buffered
+            slo, shi = _sort_by_hi_prog(lo, hi)
+            slice_links = int(os.environ.get("SHEEP_OVERLAP_SLICE",
+                                             str(1 << 18)))
+            stream = _WindowStream(slo, shi, n, int(live), slice_links, w)
+            w = stream.windows
+
+            def windows_iter():
+                for k in range(w):
+                    yield stream.window(k)
+        else:
+            # cpu backend: the "fetch" is a zero-copy view (it blocks on
+            # the async device program — that wait IS the old fetch_tail
+            # wall); windows split host-side by the shared quantile rule
+            def windows_iter():
+                lo_h = np.asarray(lo)[:int(live)]
+                hi_h = np.asarray(hi)[:int(live)]
+                keep = lo_h < n
+                if w == 1:
+                    yield lo_h[keep], hi_h[keep]
+                    return
+                lo_k = lo_h[keep]
+                hi_k = hi_h[keep]
+                bounds = host_hi_window_bounds(hi_k[hi_k < n], w, n)
+                for k in range(w):
+                    sel = hi_k >= bounds[k]
+                    if k + 1 < w:  # last window keeps any pst-only tail
+                        sel &= hi_k < bounds[k + 1]
+                    yield lo_k[sel], hi_k[sel]
+
+        it = windows_iter()
+        pst_arr = None
+        if not accumulate:
+            pst_arr = _as_u32(pst_h() if callable(pst_h) else pst_h)
+        fold = links_fold(n, pst_arr)
+        for _ in range(w):
+            t0 = time.perf_counter()
+            wlo, whi = next(it)
+            keep = wlo < n
+            if not keep.all():
+                wlo, whi = wlo[keep], whi[keep]
+            t1 = time.perf_counter()
+            fold.block(_as_u32(wlo), _as_u32(whi))
+            t2 = time.perf_counter()
+            fetch_s.append(round(t1 - t0, 4))
+            fold_s.append(round(t2 - t1, 4))
+            links_folded += len(wlo)
+        parent, pst_out = fold.finish()
+    except Exception as exc:
+        if stream is not None:
+            stream.abort()
+        if perf is not None:
+            perf["stream_mode"] = f"fallback:{type(exc).__name__}"
+        return None
+    if perf is not None:
+        wall = time.perf_counter() - t_start
+        fetch_busy = stream.busy_s if stream is not None else sum(fetch_s)
+        serialized = fetch_busy + sum(fold_s)
+        overlap_s = max(0.0, serialized - wall)
+        perf.update({
+            "stream_mode": "windowed",
+            "fetch_windows": w,
+            "window_fetch_s": fetch_s,
+            "window_fold_s": fold_s,
+            "fold_s": round(sum(fold_s), 4),
+            "overlap_s": round(overlap_s, 4),
+            "overlap_frac": round(overlap_s / serialized, 4)
+            if serialized > 0 else 0.0,
+            "handoff_links": links_folded,
+            "packed_handoff": stream.packed if stream is not None
+            else False,
+        })
+    return parent, pst_out
+
+
+@functools.partial(jax.jit)
+def _sort_by_hi_prog(lo, hi):
+    """Cached program wrapper of ops.forest.sort_links_by_hi (one compile
+    per table shape — tunneled compiles are slow)."""
+    from .forest import sort_links_by_hi
+    return sort_links_by_hi(lo, hi)
 
 
 class _SpecHandoff:
@@ -684,21 +1023,112 @@ def reduce_and_fetch_links(lo, hi, n: int, stop_live: int,
     return "host", lo_h, hi_h, int(live), rounds
 
 
+def reduce_and_finish_native(lo, hi, n: int, stop_live: int,
+                             handoff_input: bool = False, pst_h=None,
+                             accumulate_pst_ok: bool = False, perf=None):
+    """THE production reduce + handoff + native-tail of the hybrid,
+    shared with ops.stream's final fold and scripts/hybrid_profile so
+    none of them can drift from what the hybrid ships.
+
+    With the streaming windowed handoff enabled (the default —
+    :func:`stream_handoff_enabled`) the tail is :func:`_stream_tail`:
+    W ascending hi-quantile windows, each folded through the resumable
+    native union-find the moment it lands, fold k overlapping fetch k+1,
+    the full link table never host-resident; any stream failure falls
+    back to the serial fetch of the still-alive device arrays.  Disabled,
+    the tail is the legacy serial path (reduce_and_fetch_links +
+    finish_native_host) including the speculative overlapped snapshot on
+    accelerators.
+
+    Returns ("device", lo, hi, live, rounds) when the reduce loop
+    converged before the handoff threshold (the links already form the
+    forest — no native tail ran), else ("forest", parent, pst, live,
+    rounds) with parent/pst uint32 [n].
+
+    ``pst_h`` — array or zero-arg callable resolving the prep-time pst;
+    consulted only when the fold cannot count pst itself.
+    ``accumulate_pst_ok`` — the caller vouches the INPUT links are the
+    original multiset with no pst-only record masked out (full-graph
+    prep, or an internally derived full-coverage sequence); the fold
+    then accumulates pst in its own read pass whenever the loop took the
+    immediate-handoff exit (zero rounds — any chunk round rewrites the
+    multiset, after which only the prep-time pst is right).
+
+    ``perf`` gains loop_s and fetch_tail_s — fetch_tail_s is now the
+    whole tail wall (fetch + fold minus their overlap) — plus the
+    per-window breakdown (fetch_windows, window_fetch_s / window_fold_s,
+    overlap_s / overlap_frac, stream_mode) and handoff_links.
+    """
+    from .forest import reduce_links_hosted
+
+    if not stream_handoff_enabled():
+        kind, a, b, live, rounds = reduce_and_fetch_links(
+            lo, hi, n, stop_live=stop_live, handoff_input=handoff_input,
+            perf=perf)
+        if kind == "device":
+            return "device", a, b, live, rounds
+        t0 = time.perf_counter()
+        parent, pst = finish_native_host(a, b, n, pst_h)
+        if perf is not None:
+            # serial tail accounting mirrors the streamed one: the fold
+            # is part of the handoff bill either way
+            perf["fold_s"] = round(time.perf_counter() - t0, 4)
+            perf["fetch_tail_s"] = round(
+                perf.get("fetch_tail_s", 0.0) + perf["fold_s"], 4)
+            perf["fetch_windows"] = 0
+        return "forest", parent, pst, live, rounds
+    t0 = time.perf_counter()
+    # handoff_sort=False: the streaming tail feeds the cache-blocked
+    # kernel (raw order reads faster than the sort costs) or sorts by hi
+    # itself for the window slices — either way _sorted_once is waste
+    lo, hi, live, rounds, converged = reduce_links_hosted(
+        lo, hi, n, stop_live=stop_live, handoff_input=handoff_input,
+        handoff_sort=False)
+    t1 = time.perf_counter()
+    if perf is not None:
+        perf["loop_s"] = round(t1 - t0, 4)
+        perf["overlap"] = False  # the spec-snapshot stream is superseded
+    if converged:
+        if perf is not None:
+            perf["fetch_tail_s"] = 0.0
+        return "device", lo, hi, int(live), rounds
+    accumulate = accumulate_pst_ok and rounds == 0
+    out = _stream_tail(lo, hi, int(live), n, pst_h, accumulate, perf)
+    if out is None:
+        # stream failed: serial fetch of the SAME device arrays (still
+        # alive) + monolithic fold — bit-identical, just unoverlapped.
+        # ``accumulate`` holds for the serial fold too (same multiset),
+        # so pst_in=None lets the kernel count pst exactly as planned.
+        lo_h, hi_h, packed = fetch_links_host(lo, hi, int(live), n)
+        if perf is not None:
+            perf["handoff_links"] = int(len(lo_h))
+            perf["packed_handoff"] = packed
+        out = finish_native_host(lo_h, hi_h, n,
+                                 None if accumulate else pst_h)
+    parent, pst = out
+    if perf is not None:
+        perf["fetch_tail_s"] = round(time.perf_counter() - t1, 4)
+    return "forest", parent, pst, int(live), rounds
+
+
 def finish_native_host(lo_h: np.ndarray, hi_h: np.ndarray, n: int, pst_h):
     """Exact union-find tail on HOST link arrays: returns (parent, pst)
     uint32 [n].  pst_h may be a zero-arg callable resolved here — after
-    the link fetch — so a caller's prefetch thread keeps overlapping it."""
+    the link fetch — so a caller's prefetch thread keeps overlapping it.
+    Dtype conversion goes through the no-copy reinterpret (_as_u32): the
+    old unconditional .astype(np.uint32) duplicated multi-hundred-MB
+    arrays that were already uint32-exact int32."""
     if callable(pst_h):
         pst_h = pst_h()
     from ..core.forest import native_or_none
     native = native_or_none("auto")
     if native is not None:
         return native.build_forest_links(
-            lo_h.astype(np.uint32), hi_h.astype(np.uint32), n, pst_h)
+            _as_u32(lo_h), _as_u32(hi_h), n, pst_h)
     from ..core.forest import build_forest_links
-    forest = build_forest_links(lo_h.astype(np.int64),
-                                hi_h.astype(np.int64), n, pst=pst_h,
-                                impl="python")
+    forest = build_forest_links(np.asarray(lo_h, dtype=np.int64),
+                                np.asarray(hi_h, dtype=np.int64), n,
+                                pst=pst_h, impl="python")
     return forest.parent, forest.pst_weight
 
 

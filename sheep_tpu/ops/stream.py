@@ -170,23 +170,27 @@ def build_graph_streaming_hosted(blocks, n: int, pos: np.ndarray,
     # handoff threshold and let the native union-find chase the residue —
     # the device-convergence tail was measured at hundreds of rounds on
     # the last few thousand links (SCALE_r03: 781 total rounds).
-    from .build import (default_handoff_factor, finish_native_host,
-                        handoff_input_ok, reduce_and_fetch_links)
-    # same production reduce+fetch as the hybrid, including the
-    # overlapped speculative handoff stream on accelerators
-    kind, a, b, live, rounds = reduce_and_fetch_links(
-        carry_lo, carry_hi, n, stop_live=default_handoff_factor() * n,
-        handoff_input=handoff_input_ok())
-    total_rounds += rounds
+    from .build import (default_handoff_factor, handoff_input_ok,
+                        reduce_and_finish_native)
+    # same production reduce+tail as the hybrid — the streaming windowed
+    # handoff when enabled, the serial fetch (with the speculative
+    # snapshot stream on accelerators) otherwise.  pst here is the
+    # accumulated per-block count, NOT recoverable from the carry links
+    # (they were rewritten by the mid-stream folds), so the fold always
+    # receives it precomputed.
     pst_np = np.asarray(pst).astype(np.uint32)
-    if kind == "device":  # converged before the handoff threshold
-        parent = parent_from_links(a, b, n)
+    res = reduce_and_finish_native(
+        carry_lo, carry_hi, n, stop_live=default_handoff_factor() * n,
+        handoff_input=handoff_input_ok(), pst_h=pst_np)
+    total_rounds += res[4]
+    if res[0] == "device":  # converged before the handoff threshold
+        parent = parent_from_links(res[1], res[2], n)
         parent_np = np.asarray(parent).astype(np.int64)
         out = np.full(n, INVALID_JNID, dtype=np.uint32)
         live_mask = parent_np < n
         out[live_mask] = parent_np[live_mask].astype(np.uint32)
         return Forest(out, pst_np), total_rounds
-    parent_h, pst_out = finish_native_host(a, b, n, pst_np)
+    _, parent_h, pst_out, _, _ = res
     return Forest(parent_h.copy(), pst_out.copy()), total_rounds
 
 

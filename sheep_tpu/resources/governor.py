@@ -163,6 +163,13 @@ def rung_peak_nbytes(rung: str, n: int, links: int,
       host         the numpy floor casts links to int64 (16 bytes/link
                    for lo+hi), plus the int64 union-find array and the
                    uint32 parent/pst.
+      stream       the resumable windowed fold (round 7): uf/parent/pst
+                   uint32 [n] (12n), ONE uint32 window pair at a time
+                   (8 * min(links, SPILL_BLOCK)), plus the quantile
+                   partition's transient hi copy + per-window boolean
+                   mask (~5 bytes/link) — the int32 input table itself
+                   is the caller's.  Sits between host (16 bytes/link
+                   cast) and spill (which pays a scratch file).
       spill        links live in a memory-mapped scratch file; resident
                    state is the union-find fold's O(n) arrays plus one
                    block of links (SPILL_BLOCK) and the carry (<= n
@@ -175,6 +182,8 @@ def rung_peak_nbytes(rung: str, n: int, links: int,
                 + 12 * (n + 1))
     if rung == "host":
         return 16 * links + 8 * n + 8 * n
+    if rung == "stream":
+        return 12 * n + 8 * min(links, SPILL_BLOCK) + 5 * links
     if rung == "spill":
         return 8 * SPILL_BLOCK + 16 * n + 8 * n
     raise ValueError(f"unknown rung {rung!r}")

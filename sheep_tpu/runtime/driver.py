@@ -81,10 +81,13 @@ class RuntimeConfig:
     integrity: str | None = None
     #: degradation ladder, tried in order.  "mesh" is skipped when fewer
     #: than two devices are visible; "host" is the exact numpy/native
-    #: union-find; "spill" (ISSUE 5) is the memory FLOOR below it — the
-    #: links table lives in a memory-mapped scratch file and folds through
-    #: the union-find in bounded blocks, O(n + block) resident.
-    ladder: tuple[str, ...] = ("mesh", "single", "host", "spill")
+    #: union-find; "stream" (ISSUE 8) folds the SAME in-RAM link table
+    #: through the resumable native union-find one hi-quantile window at
+    #: a time — O(n + window) beyond the input, no int64 cast, no
+    #: scratch file — so tight budgets pick it before "spill" (ISSUE 5),
+    #: the memory FLOOR, where the links table lives in a memory-mapped
+    #: scratch file and folds in bounded blocks.
+    ladder: tuple[str, ...] = ("mesh", "single", "host", "stream", "spill")
     #: resource budgets (SHEEP_MEM_BUDGET / SHEEP_DISK_BUDGET); None =
     #: build one from the environment.  The governor routes the ladder
     #: around rungs whose estimated peak cannot fit, shrinks chunk work
@@ -298,6 +301,42 @@ def _rung_host(lo, hi, n, rt, num_workers):
     return forest.parent
 
 
+def _rung_stream(lo, hi, n, rt, num_workers):
+    """Streaming windowed fold between host and spill (ISSUE 8): the
+    int32 link table stays in RAM, but instead of the host rung's
+    16-bytes-per-link int64 cast it folds through the RESUMABLE native
+    union-find one ascending hi-quantile window at a time — the exact
+    fold the hybrid's streaming handoff feeds (core.forest.links_fold /
+    native sheep_build_forest_links_begin/_block/_finish) — so the peak
+    beyond the input is O(n + window), with no scratch file to pay for.
+
+    Soundness: windows partition the multiset by CONTIGUOUS hi range
+    (the shared equal-count quantile rule, host_hi_window_bounds), so
+    feeding them in ascending order replays the exact grouped insert the
+    monolithic build runs.  pst comes from the driver (these links may
+    be chunk-rewritten), so the fold runs with a zero pst like the host
+    rung.
+    """
+    from ..core.forest import host_hi_window_bounds, links_fold
+    from ..resources.governor import SPILL_BLOCK
+
+    zero = np.zeros(n, dtype=np.uint32)
+    fold = links_fold(n, pst=zero)
+    k = len(lo)
+    if k:
+        w = max(1, -(-k // SPILL_BLOCK))
+        bounds = host_hi_window_bounds(hi, w, n) if w > 1 else [0, n]
+        w = len(bounds) - 1
+        for i in range(w):
+            sel = hi >= bounds[i]
+            if i + 1 < w:  # the last window keeps the whole tail
+                sel &= hi < bounds[i + 1]
+            fold.block(lo[sel], hi[sel])
+            rt.events.append(("stream-window", i, int(sel.sum())))
+    parent, _ = fold.finish()
+    return parent
+
+
 def _rung_spill(lo, hi, n, rt, num_workers):
     """The memory FLOOR of the ladder (ISSUE 5): the links table spills
     to a memory-mapped int32 scratch file and the exact union-find folds
@@ -360,7 +399,7 @@ def _rung_spill(lo, hi, n, rt, num_workers):
 
 
 _RUNGS = {"mesh": _rung_mesh, "single": _rung_single, "host": _rung_host,
-          "spill": _rung_spill}
+          "stream": _rung_stream, "spill": _rung_spill}
 
 
 def _ladder_rungs(config: RuntimeConfig, num_workers) -> list[str]:

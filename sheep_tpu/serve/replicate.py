@@ -198,36 +198,73 @@ class ReplApplier:
         self.dups = 0
         self.gaps = 0
         self.frame_errors = 0
+        self.bursts = 0  # sealed APPEND bursts (one fsync + one ACK each)
+        self._unsynced = False  # applied-but-unsynced records in the WAL
+        self._ack_due = False   # an APPEND landed since the last ACK
 
     @property
     def lag(self) -> int:
         return max(0, self.leader_seqno - self.core.applied_seqno)
 
     def feed(self, data: bytes) -> None:
-        """Buffer ``data`` and handle every COMPLETE line in it."""
+        """Buffer ``data`` and handle every COMPLETE line in it.
+
+        APPEND frames that arrive together are applied as ONE durability
+        burst (batched follower acks): each record appends to the local
+        WAL with its fsync deferred, the burst seals with a SINGLE fsync,
+        and one cumulative ACK answers the lot — the per-record fsync was
+        the throughput cap on replicated inserts.  The ack invariant is
+        unchanged: nothing is ACKed before it is durable in the local WAL
+        (the seal's fsync strictly precedes the ACK), so a crash
+        mid-burst loses only never-acknowledged records and recovery
+        lands on a valid earlier record boundary.
+        """
         self._buf.extend(data)
+        lines = []
         while True:
             nl = self._buf.find(b"\n")
             if nl < 0:
-                return
-            raw = bytes(self._buf[:nl])
+                break
+            lines.append(bytes(self._buf[:nl]))
             del self._buf[: nl + 1]
+        for i, raw in enumerate(lines):
             try:
                 text = raw.decode("ascii").strip()
             except UnicodeDecodeError:
+                self._seal_burst()
                 self.frame_errors += 1
                 self._send(encode_nack(self.core.applied_seqno + 1))
                 continue
             if text:
-                self.handle_line(text)
+                self.handle_line(text, defer_ack=i + 1 < len(lines))
+        self._seal_burst()
 
-    def handle_line(self, text: str) -> None:
+    def _seal_burst(self) -> None:
+        """fsync the burst's deferred WAL tail, then send ONE cumulative
+        ACK.  No-op when nothing is pending.  A failed fsync propagates
+        with nothing acked — the stream dies and the reconnect re-syncs
+        from the durable position."""
+        if self._unsynced:
+            self.core.wal_sync()  # may raise: nothing gets acked
+            self._unsynced = False
+            self.bursts += 1
+        if self._ack_due:
+            self._ack_due = False
+            self._send(encode_ack(self.core.applied_seqno))
+
+    def handle_line(self, text: str, defer_ack: bool = False) -> None:
+        """Handle one frame line.  ``defer_ack`` marks a mid-burst APPEND
+        (more complete lines are already buffered): its fsync+ACK are
+        deferred to the burst seal.  Every other frame kind seals any
+        open burst first, so an ACK for a PING can never cover an
+        unsynced record."""
         self.last_frame_t = time.monotonic()
         try:
             frame = parse_frame(text)
         except ReplProtocolError:
             # a frame that parses wrong is indistinguishable from lost
             # bytes: ask for a re-stream from our applied position
+            self._seal_burst()
             self.frame_errors += 1
             self._send(encode_nack(self.core.applied_seqno + 1))
             return
@@ -237,16 +274,19 @@ class ReplApplier:
         if epoch < self.core.epoch:
             # a fenced ex-leader is still streaming at us: tell it its
             # term is over instead of applying history that lost
+            self._seal_burst()
             self._send(encode_fenced(self.core.epoch))
             return
         if epoch > self.core.epoch:
+            self._seal_burst()  # the old epoch's tail seals under it
             self._on_epoch(epoch)
         self.leader_seqno = max(self.leader_seqno, frame.seqno())
         if frame.kind == "APPEND":
             try:
                 out = self.core.apply_replicated(frame.seqno(),
-                                                 frame.payload)
+                                                 frame.payload, sync=False)
             except ReplicationGap as gap:
+                self._seal_burst()
                 self.gaps += 1
                 self._send(encode_nack(gap.expected))
                 return
@@ -254,8 +294,12 @@ class ReplApplier:
                 self.dups += 1
             else:
                 self.applied += 1
-            self._send(encode_ack(self.core.applied_seqno))
+                self._unsynced = True
+            self._ack_due = True
+            if not defer_ack:
+                self._seal_burst()
         else:  # PING carries the leader's latest seqno: gap detector
+            self._seal_burst()
             if self.leader_seqno > self.core.applied_seqno:
                 self.gaps += 1
                 self._send(encode_nack(self.core.applied_seqno + 1))

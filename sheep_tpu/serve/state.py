@@ -716,12 +716,20 @@ class ServeCore:
             del self._wal_tail[:drop]
             self.repl_floor = self._wal_tail[0][0] - 1
 
-    def apply_replicated(self, seqno: int, payload: bytes) -> str:
+    def apply_replicated(self, seqno: int, payload: bytes,
+                         sync: bool = True) -> str:
         """Fold one record shipped by the leader into a FOLLOWER's state
         (serve/replicate.py).  The record lands in the local WAL under
         the leader's seqno (same durability order as :meth:`insert`:
         append + fsync -> apply), so a follower crash recovers through
         the exact snapshot+replay path a leader does.
+
+        ``sync=False`` defers the WAL fsync (batched follower acks): the
+        applier folds a whole APPEND burst, then pays ONE
+        :meth:`wal_sync` before its single cumulative ACK — nothing is
+        ever acknowledged ahead of its fsync, and a crash mid-burst
+        loses only unacknowledged records (recovery replays the durable
+        prefix, a valid earlier boundary).
 
         Returns ``"applied"`` or ``"dup"`` (seqno already applied — a
         re-sent frame, dropped idempotently).  A seqno that would leave
@@ -735,7 +743,7 @@ class ServeCore:
             if seqno != self.applied_seqno + 1:
                 raise ReplicationGap(self.applied_seqno + 1, seqno)
             pairs = decode_inserts(payload)  # refuse garbage pre-append
-            self._wal.append_at(seqno, payload)
+            self._wal.append_at(seqno, payload, sync=sync)
             self._fire("wal")
             self._apply_pairs(pairs)
             self.applied_seqno = seqno
@@ -747,6 +755,13 @@ class ServeCore:
             if self._inserts_since_snap >= self.snap_every:
                 self.maybe_seal()
             return "applied"
+
+    def wal_sync(self) -> None:
+        """Seal a deferred-fsync burst (see :meth:`apply_replicated`
+        ``sync=False``): one fsync covering every unsynced append.  The
+        caller acknowledges only after this returns."""
+        with self._lock:
+            self._wal.sync()
 
     def records_from(self, seqno: int):
         """Replication backlog: every retained record with a seqno

@@ -261,22 +261,31 @@ class WalAppender:
             else ResourceGovernor.from_env()
         self._f = open(path, "r+b")
         self._f.seek(clean_end)
+        self._unsynced = False
 
-    def append(self, payload: bytes) -> int:
+    def append(self, payload: bytes, sync: bool = True) -> int:
         """Durably append one record; returns its seqno.  The record is
         on disk (fsync'd) when this returns — the caller may acknowledge.
         On ANY write failure the log is truncated back to the record
         boundary and the error re-raises typed (DiskExhausted/WriteFault
         for ENOSPC/EIO, real or injected): a failed append leaves no
         trace, so it can be retried or refused without a repair pass."""
-        return self.append_at(self.next_seqno, payload)
+        return self.append_at(self.next_seqno, payload, sync=sync)
 
-    def append_at(self, seqno: int, payload: bytes) -> int:
+    def append_at(self, seqno: int, payload: bytes,
+                  sync: bool = True) -> int:
         """Append one record under a CALLER-chosen seqno (the follower
         apply path, serve/replicate.py: a replica logs records under the
         leader's numbering so the two logs stay comparable).  ``seqno``
         must keep the chain strictly monotone; same durability contract
-        as :meth:`append`."""
+        as :meth:`append`.
+
+        ``sync=False`` defers the fsync (write+flush only): the batched
+        follower apply appends a whole APPEND burst and pays ONE
+        :meth:`sync` for the lot — the caller MUST NOT acknowledge any
+        deferred record before that sync returns.  A crash in the window
+        loses only unacknowledged records, and the torn-tail repair
+        truncates any partially-flushed one."""
         if len(payload) > MAX_PAYLOAD:
             raise ValueError(f"WAL payload of {len(payload)} bytes exceeds "
                              f"the {MAX_PAYLOAD} cap")
@@ -294,7 +303,8 @@ class WalAppender:
         try:
             w.write(rec)
             self._f.flush()
-            os.fsync(self._f.fileno())
+            if sync:
+                os.fsync(self._f.fileno())
         except OSError as exc:
             try:
                 self._f.truncate(start)
@@ -307,8 +317,30 @@ class WalAppender:
             if typed is not exc:
                 raise typed from exc
             raise
+        if not sync:
+            self._unsynced = True
         self.next_seqno = seqno + 1
         return seqno
+
+    def sync(self) -> None:
+        """fsync any deferred appends (the burst seal).  No-op when
+        nothing is pending.  On failure the error re-raises typed and the
+        log is NOT truncated: deferred records are already applied by the
+        caller and a truncation here could leave a seqno gap on disk —
+        the bytes stay buffered for a later retry, and a crash before one
+        lands is covered by the torn-tail repair (none of the deferred
+        records were acknowledged)."""
+        if not self._unsynced:
+            return
+        try:
+            self._f.flush()
+            os.fsync(self._f.fileno())
+        except OSError as exc:
+            typed = _typed(exc, self.path)
+            if typed is not exc:
+                raise typed from exc
+            raise
+        self._unsynced = False
 
     def close(self) -> None:
         try:

@@ -144,6 +144,85 @@ def test_degree_histogram_out_of_range_vid_raises():
         native.degree_histogram(tail, head, 50)
 
 
+# ---------------------------------------------------------------------------
+# resumable link fold (streaming windowed handoff, round 7):
+# sheep_build_forest_links_begin/_block/_finish and its python twin
+# ---------------------------------------------------------------------------
+
+
+def _rand_links(rng, n, m, pst_only_frac=0.05):
+    a = rng.integers(0, n, m)
+    b = rng.integers(0, n, m)
+    keep = a != b
+    lo = np.minimum(a, b)[keep].astype(np.int64)
+    hi = np.maximum(a, b)[keep].astype(np.int64)
+    po = rng.random(len(lo)) < pst_only_frac
+    hi[po] = INVALID_JNID  # pst-only links (absent endpoint)
+    return lo, hi
+
+
+@pytest.mark.parametrize("seed", range(6))
+@pytest.mark.parametrize("blocks", [1, 2, 4, 8])
+def test_links_fold_block_parity(seed, blocks):
+    """The resumable fold over ANY ascending-hi block split — including
+    cuts landing inside an equal-hi group — is bit-identical to the
+    monolithic build: native and python twins, pst accumulated in-fold
+    and precomputed."""
+    from sheep_tpu.core.forest import PyLinksFold
+    rng = np.random.default_rng(800 + seed)
+    n = int(rng.integers(50, 400))
+    lo, hi = _rand_links(rng, n, int(rng.integers(10, 6 * n)))
+    want = build_forest_links(lo, hi, n, impl="python")
+    order = np.argsort(hi, kind="stable")
+    lo_s, hi_s = lo[order], hi[order]
+    cuts = [(len(lo_s) * k) // blocks for k in range(blocks + 1)]
+    for make in (lambda pst: native.LinksFold(n, pst),
+                 lambda pst: PyLinksFold(n, pst)):
+        for pst in (None, want.pst_weight):
+            fold = make(pst)
+            for a, b in zip(cuts[:-1], cuts[1:]):
+                fold.block(lo_s[a:b], hi_s[a:b])
+            parent, pst_out = fold.finish()
+            np.testing.assert_array_equal(parent, want.parent)
+            np.testing.assert_array_equal(pst_out, want.pst_weight)
+
+
+def test_links_fold_out_of_order_window_raises():
+    """An out-of-order window would silently build a different forest —
+    both twins must refuse it loudly."""
+    from sheep_tpu.core.forest import PyLinksFold
+    n = 10
+    for fold in (native.LinksFold(n), PyLinksFold(n)):
+        fold.block(np.array([3], np.int64), np.array([7], np.int64))
+        with pytest.raises(ValueError, match="ascend"):
+            fold.block(np.array([1], np.int64), np.array([2], np.int64))
+
+
+def test_links_fold_malformed_lo_raises():
+    from sheep_tpu.core.forest import PyLinksFold
+    n = 10
+    for fold in (native.LinksFold(n), PyLinksFold(n)):
+        with pytest.raises(ValueError):
+            fold.block(np.array([12], np.int64), np.array([13], np.int64))
+
+
+def test_links_fold_equal_hi_group_split_exact():
+    """A window boundary INSIDE one hi-group is exact by construction
+    (distinct roots adopt once, repeats no-op) — pin it explicitly."""
+    from sheep_tpu.core.forest import PyLinksFold
+    lo = np.array([0, 1, 2, 3], np.int64)
+    hi = np.array([5, 5, 5, 5], np.int64)
+    n = 6
+    want = build_forest_links(lo, hi, n, impl="python")
+    for make in (lambda: native.LinksFold(n), lambda: PyLinksFold(n)):
+        fold = make()
+        fold.block(lo[:2], hi[:2])
+        fold.block(lo[2:], hi[2:])  # same hi=5 group continues
+        parent, pst_out = fold.finish()
+        np.testing.assert_array_equal(parent, want.parent)
+        np.testing.assert_array_equal(pst_out, want.pst_weight)
+
+
 def _pre_oracle(tail, head, seq):
     # Brute force meetKid semantics (lib/jnode.h:174-176): replay the
     # reference's sequential insert with unions deferred per vertex.

@@ -176,15 +176,68 @@ def test_torn_stream_at_every_byte_boundary(tmp_path):
         n_complete = sum(1 for b in bounds if b <= cut)
         assert fol.applied_seqno == n_complete, f"cut at byte {cut}"
         np.testing.assert_array_equal(fol.parent, want[n_complete])
-        # every applied record was ACKed, cumulative
+        # every applied record is covered by a cumulative ACK; frames
+        # delivered together apply as ONE burst (batched follower acks:
+        # one fsync, one ACK), so the LAST ack covers the whole prefix
         acks = [s for s in sent if s.startswith("REPL ACK")]
-        assert len(acks) == n_complete
+        if n_complete:
+            assert acks and acks[-1] == f"REPL ACK seqno={n_complete}"
+        else:
+            assert not acks
         # the remainder of the stream completes the replica exactly
         applier.feed(blob[cut:])
         assert fol.applied_seqno == len(ins)
         np.testing.assert_array_equal(fol.parent, want[-1])
         fol.close()
     leader.close()
+
+
+def test_batched_follower_acks_one_fsync_per_burst(tmp_path, monkeypatch):
+    """APPEND frames delivered together apply as ONE durability burst:
+    a single WAL fsync seals the lot and a single cumulative ACK answers
+    it (the per-record fsync was the replicated-insert throughput cap) —
+    while the ack invariant holds: the fsync strictly precedes the ACK,
+    and a record-by-record delivery still acks record by record."""
+    leader, _, _, _ = _make_state(tmp_path, "lead")
+    frames = []
+    for i in range(8):
+        seqno = leader.insert(np.array([[i, i + 5]], np.uint32))
+        payload = leader.records_from(seqno - 1)[0][1]
+        frames.append(encode_append(leader.epoch, seqno, payload))
+    follower, _, _, _ = _make_state(tmp_path, "fol")
+    sent = []
+    applier = ReplApplier(follower, sent.append)
+    import sheep_tpu.serve.wal as wal_mod
+    real_fsync = os.fsync
+    calls = {"n": 0}
+
+    def counting(fd):
+        calls["n"] += 1
+        return real_fsync(fd)
+
+    monkeypatch.setattr(wal_mod.os, "fsync", counting)
+    order = []
+    real_send = sent.append
+
+    def sending(line):
+        order.append(("ack", calls["n"]))
+        return real_send(line)
+
+    applier._send = sending
+    applier.feed(("\n".join(frames[:6]) + "\n").encode("ascii"))
+    assert follower.applied_seqno == 6
+    assert calls["n"] == 1, f"burst of 6 must fsync once, saw {calls}"
+    assert applier.bursts == 1
+    assert sent == ["REPL ACK seqno=6"]
+    assert order == [("ack", 1)]  # the fsync preceded the one ACK
+    # record-by-record delivery still acks per record (no batching to do)
+    for fr in frames[6:]:
+        applier.feed((fr + "\n").encode("ascii"))
+    assert follower.applied_seqno == 8
+    assert sent[-2:] == ["REPL ACK seqno=7", "REPL ACK seqno=8"]
+    assert calls["n"] == 3
+    leader.close()
+    follower.close()
 
 
 def test_corrupt_frame_nacks_without_apply(tmp_path):

@@ -1,0 +1,255 @@
+#!/usr/bin/env python3
+"""EXTBENCH: the out-of-core acceptance run (ISSUE 9 / ROADMAP).
+
+Builds a graph whose ``.dat`` edge list is >= ``--factor`` x
+``SHEEP_MEM_BUDGET`` through the external-memory rung and records, per
+the bench-honesty rules (env_capture embedded, serialized 1-core runs,
+every arm in its OWN subprocess so VmHWM is that arm's true lifetime
+peak):
+
+  ext     the out-of-core build (ops/extmem, jax never imported):
+          edges/s over both streamed passes, measured peak RSS (VmHWM)
+          vs the budget, parent+pst CRCs.
+  spill   the same input through the in-RAM spill rung (PR 5's memory
+          floor — loads the records, spills the links to scratch): the
+          throughput bar the ext rung must clear.
+  oracle  the in-RAM native fused build: ground-truth CRCs + the
+          native-kernel-speed reference.
+
+Acceptance asserted into the record: file >= factor x budget; ext VmHWM
+inside the budget; ext CRCs == oracle CRCs (oracle-exact); ext edges/s
+>= spill edges/s.
+
+Usage:
+  python scripts/extbench.py --budget 192M --factor 4 --out EXTBENCH_r01.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+import zlib
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def vmhwm_bytes() -> int:
+    with open("/proc/self/status", "rb") as f:
+        for line in f:
+            if line.startswith(b"VmHWM:"):
+                return int(line.split()[1]) * 1024
+    return 0
+
+
+def _crcs(forest):
+    return {
+        "parent_crc32": zlib.crc32(forest.parent.tobytes()) & 0xFFFFFFFF,
+        "pst_crc32": zlib.crc32(forest.pst_weight.tobytes()) & 0xFFFFFFFF,
+    }
+
+
+def generate(path: str, records: int, log_n: int, chunk: int = 1 << 22,
+             seed: int = 17) -> None:
+    """Write an R-MAT ``.dat`` in bounded chunks (the generator must not
+    need the whole edge list in RAM either).  No sidecar: the streamed
+    read accepts sidecar-less files, and sealing one would mean one more
+    full pass over a multi-GB artifact."""
+    import numpy as np
+    from sheep_tpu.utils.synth import rmat_edges
+    dtype = np.dtype([("tail", "<u4"), ("head", "<u4"), ("weight", "<f4")])
+    t0 = time.perf_counter()
+    with open(path, "wb") as f:
+        done = 0
+        i = 0
+        while done < records:
+            m = min(chunk, records - done)
+            tail, head = rmat_edges(log_n, m, seed=seed + i)
+            rec = np.empty(m, dtype=dtype)
+            rec["tail"] = tail
+            rec["head"] = head
+            rec["weight"] = 1.0
+            f.write(rec.tobytes())
+            done += m
+            i += 1
+    print(f"generated {records} records ({os.path.getsize(path) >> 20}MB) "
+          f"in {time.perf_counter() - t0:.1f}s", file=sys.stderr)
+
+
+def child_ext(path: str) -> dict:
+    # jax-free by construction: ops/__init__ resolves lazily and extmem
+    # never touches the device stack — assert it stayed that way, because
+    # a backend import would silently eat most of a small budget
+    from sheep_tpu.ops.extmem import build_forest_extmem, dat_num_records
+    records = dat_num_records(path)
+    perf: dict = {}
+    t0 = time.perf_counter()
+    seq, forest = build_forest_extmem(path, perf=perf)
+    wall = time.perf_counter() - t0
+    assert "jax" not in sys.modules, "ext arm imported jax"
+    out = {"arm": "ext", "records": records, "wall_s": round(wall, 3),
+           "edges_per_s": round(records / wall, 1),
+           "vmhwm_bytes": vmhwm_bytes(), "n": int(len(seq)), "perf": perf}
+    out.update(_crcs(forest))
+    return out
+
+
+def child_spill(path: str) -> dict:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from sheep_tpu.io.edges import load_edges
+    from sheep_tpu.runtime import RuntimeConfig, build_graph_resilient
+    t0 = time.perf_counter()
+    edges = load_edges(path)
+    cfg = RuntimeConfig(ladder=("spill",))
+    seq, forest = build_graph_resilient(edges.tail, edges.head, config=cfg)
+    wall = time.perf_counter() - t0
+    out = {"arm": "spill", "records": edges.num_edges,
+           "wall_s": round(wall, 3),
+           "edges_per_s": round(edges.num_edges / wall, 1),
+           "vmhwm_bytes": vmhwm_bytes(), "n": int(len(seq))}
+    out.update(_crcs(forest))
+    return out
+
+
+def child_oracle(path: str) -> dict:
+    from sheep_tpu.core import build_forest, degree_sequence
+    from sheep_tpu.io.edges import load_edges
+    t0 = time.perf_counter()
+    edges = load_edges(path)
+    seq = degree_sequence(edges.tail, edges.head)
+    forest = build_forest(edges.tail, edges.head, seq)
+    wall = time.perf_counter() - t0
+    out = {"arm": "oracle", "records": edges.num_edges,
+           "wall_s": round(wall, 3),
+           "edges_per_s": round(edges.num_edges / wall, 1),
+           "vmhwm_bytes": vmhwm_bytes(), "n": int(len(seq))}
+    out.update(_crcs(forest))
+    return out
+
+
+def run_child(arm: str, path: str, budget: str | None,
+              extra_env: dict | None = None) -> dict:
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    if arm == "ext" and budget:
+        env["SHEEP_MEM_BUDGET"] = budget
+    else:
+        env.pop("SHEEP_MEM_BUDGET", None)
+    env.update(extra_env or {})
+    t0 = time.perf_counter()
+    proc = subprocess.run(
+        [sys.executable, os.path.abspath(__file__), "--child", arm,
+         "--data", path],
+        env=env, cwd=REPO, capture_output=True, text=True)
+    if proc.returncode != 0:
+        return {"arm": arm, "error": proc.stderr[-2000:],
+                "wall_s": round(time.perf_counter() - t0, 3)}
+    return json.loads(proc.stdout.strip().splitlines()[-1])
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--budget", default="192M",
+                    help="SHEEP_MEM_BUDGET for the ext arm")
+    ap.add_argument("--factor", type=float, default=4.0,
+                    help="edge-list bytes as a multiple of the budget")
+    ap.add_argument("--log-n", type=int, default=20)
+    ap.add_argument("--data", default=None,
+                    help="reuse an existing .dat instead of generating")
+    ap.add_argument("--extra-block", default=None,
+                    help="also run an UNBUDGETED ext arm at this "
+                         "SHEEP_EXT_BLOCK (the block/throughput trade, "
+                         "informational — not part of the acceptance)")
+    ap.add_argument("--keep-file", action="store_true")
+    ap.add_argument("--out", default="EXTBENCH_r01.json")
+    ap.add_argument("--child", choices=("ext", "spill", "oracle"),
+                    help=argparse.SUPPRESS)
+    args = ap.parse_args()
+
+    if args.child:
+        out = {"ext": child_ext, "spill": child_spill,
+               "oracle": child_oracle}[args.child](args.data)
+        print(json.dumps(out))
+        return 0
+
+    from sheep_tpu.resources.governor import parse_size
+    from sheep_tpu.utils.envinfo import env_capture
+    budget_bytes = parse_size(args.budget)
+    path = args.data
+    generated = False
+    if path is None:
+        records = -(-int(args.factor * budget_bytes) // 12)
+        path = os.path.join(os.environ.get("TMPDIR", "/tmp"),
+                            f"extbench-{records}.dat")
+        if not (os.path.exists(path)
+                and os.path.getsize(path) == 12 * records):
+            generate(path, records, args.log_n)
+        generated = True
+    file_bytes = os.path.getsize(path)
+
+    record: dict = {
+        "bench": "EXTBENCH",
+        "round": "r01",
+        "budget": args.budget,
+        "budget_bytes": budget_bytes,
+        "factor": args.factor,
+        "file_bytes": file_bytes,
+        "file_over_budget": round(file_bytes / budget_bytes, 2),
+        "log_n": args.log_n,
+        "env_capture": env_capture(),
+        "arms": {},
+        "_note": ("serialized 1-core runs, one subprocess per arm so "
+                  "VmHWM is that arm's true lifetime peak; the ext arm "
+                  "runs under SHEEP_MEM_BUDGET and never imports jax"),
+    }
+    try:
+        for arm in ("ext", "spill", "oracle"):
+            print(f"running {arm} arm...", file=sys.stderr)
+            record["arms"][arm] = run_child(arm, path, args.budget)
+            print(json.dumps(record["arms"][arm]), file=sys.stderr)
+        if args.extra_block:
+            # the block/throughput trade: no budget, bigger blocks, the
+            # fused-edges strategy — shows what an operator buys by
+            # raising SHEEP_EXT_BLOCK when headroom allows
+            name = f"ext_block_{args.extra_block}"
+            print(f"running {name} arm (unbudgeted)...", file=sys.stderr)
+            record["arms"][name] = run_child(
+                "ext", path, None,
+                extra_env={"SHEEP_EXT_BLOCK": args.extra_block})
+            record["arms"][name]["_note"] = \
+                "informational: unbudgeted, operator-pinned block"
+            print(json.dumps(record["arms"][name]), file=sys.stderr)
+        ext = record["arms"]["ext"]
+        spill = record["arms"]["spill"]
+        oracle = record["arms"]["oracle"]
+        record["acceptance"] = {
+            "file_ge_factor_x_budget":
+                file_bytes >= args.factor * budget_bytes,
+            "ext_rss_inside_budget":
+                ext.get("vmhwm_bytes", 1 << 62) <= budget_bytes,
+            "ext_oracle_exact":
+                ext.get("parent_crc32") == oracle.get("parent_crc32")
+                and ext.get("pst_crc32") == oracle.get("pst_crc32"),
+            "ext_ge_spill_throughput":
+                ext.get("edges_per_s", 0) >= spill.get("edges_per_s", 0),
+        }
+        record["passed"] = all(record["acceptance"].values())
+    finally:
+        if generated and not args.keep_file:
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+    with open(os.path.join(REPO, args.out), "w") as f:
+        json.dump(record, f, indent=2)
+        f.write("\n")
+    print(json.dumps(record["acceptance"], indent=2))
+    return 0 if record.get("passed") else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -234,6 +234,66 @@ then
 fi
 # -------------------------------------------------------------------------
 
+# --- out-of-core smoke (external-memory build, ISSUE 9) ------------------
+# A tiny SHEEP_MEM_BUDGET under which the governor-planned ladder skips
+# host AND stream but keeps the ext rung (rss reading zeroed so the plan
+# is deterministic), oracle-checked bit-identical; plus a forced
+# EIO-at-block arm that must retry mid-stream to the same tree.  Seconds
+# of work; a regression in the round-8 out-of-core path fails the gate
+# before pytest even runs.
+if ! env JAX_PLATFORMS=cpu python - <<'EOF'
+import numpy as np, tempfile
+import sheep_tpu.resources.governor as G
+from sheep_tpu.core import build_forest, degree_sequence
+from sheep_tpu.io import faultfs
+from sheep_tpu.io.edges import write_dat
+from sheep_tpu.ops.extmem import build_forest_extmem
+from sheep_tpu.runtime import RuntimeConfig, build_graph_resilient
+from sheep_tpu.utils.synth import rmat_edges
+
+d = tempfile.mkdtemp()
+tail, head = rmat_edges(14, 1 << 18, seed=41)
+p = d + "/g.dat"
+write_dat(p, tail, head)
+want_seq = degree_sequence(tail, head)
+want = build_forest(tail, head, want_seq)
+n, links = len(want_seq), len(tail)
+
+G.rss_bytes = lambda: 0  # deterministic headroom for the plan
+gov = G.ResourceGovernor(mem_budget=1)
+ext_est = G.rung_peak_nbytes("ext", n, links,
+                             ext_block=gov.ext_fitted_block(n))
+stream_est = G.rung_peak_nbytes("stream", n, links)
+assert ext_est < stream_est, (ext_est, stream_est)
+budget = (ext_est + stream_est) // 2
+cfg = RuntimeConfig(governor=G.ResourceGovernor(mem_budget=budget),
+                    edges_path=p)
+seq, f = build_graph_resilient(tail, head, config=cfg)
+skipped = {e[1] for e in cfg.events if e[0] == "mem-skip-rung"}
+assert "stream" in skipped and "host" in skipped, cfg.events
+assert any(e[0] == "ext-block" for e in cfg.events), "ext rung never ran"
+np.testing.assert_array_equal(seq, want_seq)
+np.testing.assert_array_equal(f.parent, want.parent)
+np.testing.assert_array_equal(f.pst_weight, want.pst_weight)
+
+# forced EIO at the 2nd block read: in-process retry, bit-identical
+faultfs.install_plan(faultfs.parse_io_fault_plan("eio@dat:1"))
+perf = {}
+seq2, f2 = build_forest_extmem(p, block_edges=1 << 15,
+                               backoff_base_s=0.0, perf=perf)
+faultfs.clear_plan()
+assert perf["retries"] + perf.get("seq_retries", 0) == 1, perf
+np.testing.assert_array_equal(seq2, want_seq)
+np.testing.assert_array_equal(f2.parent, want.parent)
+np.testing.assert_array_equal(f2.pst_weight, want.pst_weight)
+EOF
+then
+  echo "OUT-OF-CORE SMOKE FAILED: the ext rung diverged from the oracle" \
+       "or did not survive its reader fault" >&2
+  exit 1
+fi
+# -------------------------------------------------------------------------
+
 # --- serve smoke (crash-safe partition service, ISSUE 6) -----------------
 # Start a real bin/serve subprocess on a tiny graph, query + insert over
 # the wire, kill -9, restart from the same state dir, and assert the

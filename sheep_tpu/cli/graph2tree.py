@@ -82,10 +82,10 @@ def main(argv: list[str] | None = None) -> int:
         # instead of burning more single-letter flags.
         opts, args = getopt.gnu_getopt(
             argv, "irl:p:s:o:vkejm:w:xfdtc",
-            ["checkpoint-dir=", "resume", "max-retries="])
+            ["checkpoint-dir=", "resume", "max-retries=", "ext"])
     except getopt.GetoptError as exc:
         if (exc.opt or "").startswith(("checkpoint-dir", "max-retries",
-                                       "resume")):
+                                       "resume", "ext")):
             print(f"Option --{exc.opt}: {exc.msg}.")
             return 1
         o = (exc.opt or "?")[:1]
@@ -108,6 +108,7 @@ def main(argv: list[str] | None = None) -> int:
     width_limit = 0
     find_max_width = False
     do_faqs = do_print = do_validate = False
+    use_ext = False
 
     for o, a in opts:
         if o == "-i":
@@ -143,6 +144,8 @@ def main(argv: list[str] | None = None) -> int:
             do_print = not do_print
         elif o == "-c":
             do_validate = not do_validate
+        elif o == "--ext":
+            use_ext = True
 
     if not args:
         print(USAGE)
@@ -169,6 +172,29 @@ def main(argv: list[str] | None = None) -> int:
             is_leader = False
             proc0 = False
 
+    jxn_mode = make_kids or make_pst or make_jxn or width_limit or \
+        find_max_width
+
+    # External-memory routing (ISSUE 9): decided BEFORE the load — the
+    # whole point is that the edge list never enters RAM.  --ext forces;
+    # SHEEP_EXT_BLOCK is the env twin; a configured SHEEP_MEM_BUDGET the
+    # in-RAM load cannot fit routes automatically.  Only the serial
+    # whole-file .dat path streams (mesh/jxn/partial loads keep their
+    # in-RAM semantics), and a partitioned-graph copy (-p with -o) still
+    # needs the records — say so and fall back instead of surprising.
+    if not use_mesh and not jxn_mode and not num_parts \
+            and graph_filename.endswith(".dat"):
+        if not use_ext:
+            from ..ops.extmem import should_use_extmem
+            use_ext = should_use_extmem(graph_filename)
+        if use_ext and partitions and output_filename:
+            print("warning: the external-memory build cannot write a "
+                  "partitioned graph copy (the edge records never load); "
+                  "falling back to the in-RAM path", file=sys.stderr)
+            use_ext = False
+    else:
+        use_ext = False
+
     if verbose:
         print(f"Loading {graph_filename}...")
     if use_mesh and num_parts:
@@ -180,16 +206,17 @@ def main(argv: list[str] | None = None) -> int:
         print(f"warning: -l {part}/{num_parts} is superseded by -i/-r "
               f"(the mesh processes all records, like the reference's "
               f"MPI rank mapping); ignoring -l", file=sys.stderr)
-    edges = load_edges(graph_filename, part, num_parts) if not use_mesh \
-        else load_edges(graph_filename)
-    if verbose:
-        nodes, nedges = graph_stats(edges)
-        print(f"Nodes:{nodes} Edges:{nedges}")
+    if use_ext:
+        edges = None  # the stream IS the load; downstream guards on None
+    else:
+        edges = load_edges(graph_filename, part, num_parts) \
+            if not use_mesh else load_edges(graph_filename)
+        if verbose:
+            nodes, nedges = graph_stats(edges)
+            print(f"Nodes:{nodes} Edges:{nedges}")
     if is_leader:
         print_phase("Loaded graph", clock.phase_seconds())
 
-    jxn_mode = make_kids or make_pst or make_jxn or width_limit or \
-        find_max_width
     widths = None
 
     map_only = False
@@ -317,6 +344,29 @@ def main(argv: list[str] | None = None) -> int:
             print_phase("Mapped", clock.phase_seconds())
             if use_mesh_reduce:
                 print_phase("Reduced", clock.phase_seconds())
+    elif use_ext:
+        # Out-of-core serial path (ISSUE 9): two streamed passes, no jax,
+        # no in-RAM edge list.  Same phase grammar as the serial path.
+        from ..ops.extmem import build_forest_extmem, \
+            streaming_degree_sequence
+        ext_kw: dict = {}
+        if rt_cfg is not None:
+            ext_kw = dict(checkpoint_dir=rt_cfg.checkpoint_dir,
+                          resume=rt_cfg.resume,
+                          max_retries=rt_cfg.max_retries,
+                          backoff_base_s=rt_cfg.backoff_base_s,
+                          checkpoint_every=rt_cfg.checkpoint_every,
+                          integrity=rt_cfg.integrity,
+                          governor=rt_cfg.governor)
+        if sequence_filename:
+            seq = read_sequence(sequence_filename)
+        else:
+            seq, _, _ = streaming_degree_sequence(graph_filename)
+        if is_leader:
+            print_phase("Sorted", clock.phase_seconds())
+        seq, forest = build_forest_extmem(graph_filename, seq=seq, **ext_kw)
+        if is_leader:
+            print_phase("Mapped", clock.phase_seconds())
     else:
         if sequence_filename:
             seq = read_sequence(sequence_filename)
@@ -350,15 +400,20 @@ def main(argv: list[str] | None = None) -> int:
         if is_leader:
             print_phase("Mapped", clock.phase_seconds())
 
+    # under --ext the records never loaded; every vid with a record has
+    # nonzero degree and is therefore in the sequence, so seq.max() IS
+    # the file's max vid
+    max_vid = edges.max_vid if edges is not None else \
+        (int(np.asarray(seq).max()) if len(seq) else 0)
     if partitions != 0:
         p = Partition.from_forest(seq, forest, partitions,
-                                  max_vid=edges.max_vid)
+                                  max_vid=max_vid)
         if output_filename:
             if proc0:
                 prefix = output_filename + \
                     ("-w0000-p" if use_mesh_reduce else "")
                 p.write_partitioned_graph(edges.tail, edges.head, seq,
-                                          prefix, max_vid=edges.max_vid)
+                                          prefix, max_vid=max_vid)
         elif is_leader:
             p.print()
     elif output_filename and not map_only and proc0:
@@ -379,8 +434,11 @@ def main(argv: list[str] | None = None) -> int:
     if do_print and proc0:
         print_tree(seq, forest.parent, forest.pst_weight)
     if do_validate and proc0:
-        if is_valid_forest(forest, edges.tail, edges.head, seq,
-                           max_vid=edges.max_vid):
+        if edges is None:
+            print("warning: -c needs the in-RAM edge list; skipped under "
+                  "the external-memory build", file=sys.stderr)
+        elif is_valid_forest(forest, edges.tail, edges.head, seq,
+                             max_vid=edges.max_vid):
             print("Tree is valid.")
         else:
             print("ERROR: Tree is not valid.")

@@ -243,10 +243,22 @@ def load_edges(path: str, part: int = 0, num_parts: int = 0,
 
 
 def iter_dat_blocks(path: str, block_edges: int, part: int = 0,
-                    num_parts: int = 0):
-    """Stream a ``.dat`` file as (tail, head) uint32 blocks via memmap —
-    the out-of-core path: nothing but the current block is materialized.
+                    num_parts: int = 0, start_edge: int = 0):
+    """Stream a ``.dat`` file as (tail, head) uint32 blocks — the
+    out-of-core path: nothing but the current block is materialized.
     Honors partial-load ranges like :func:`read_dat`.
+
+    Blocks are plain buffered reads, NOT a whole-file memmap (ISSUE 9):
+    every memmap page ever touched stays counted in RSS until unmapped,
+    so a streamed multi-GB file would "grow" the process to the file
+    size and bust any measured-peak memory budget — the exact number the
+    external-memory build is accepted on.  seek+read keeps the resident
+    set at O(block) no matter the file.
+
+    ``start_edge`` skips that many records of the (possibly partial)
+    range before the first block — the resume path of the external-memory
+    build (ops/extmem.py): a checkpoint at block boundary k restarts the
+    stream at ``k * block_edges`` instead of re-reading the prefix.
 
     Raw records only: SHEEP_DDUP_GRAPH is NOT applied here (block-local
     dedup would differ from load-level dedup); a warning is emitted so the
@@ -254,10 +266,16 @@ def iter_dat_blocks(path: str, block_edges: int, part: int = 0,
 
     Integrity: the record-size check runs up front like :func:`read_dat`;
     when a sidecar exists and the whole file is streamed (no partial
-    range), the checksum accumulates incrementally across blocks and a
-    mismatch raises AT THE END of the stream — bounded memory is kept, and
-    a corrupted file still fails the run instead of feeding garbage into
-    the fold."""
+    range, no start_edge), the checksum accumulates incrementally across
+    blocks and a mismatch raises AT THE END of the stream — bounded
+    memory is kept, and a corrupted file still fails the run instead of
+    feeding garbage into the fold.
+
+    Fault injection: each block read is a ``dat``-site fault point
+    (``SHEEP_IO_FAULT_PLAN`` ``kind@dat:nth``, io/faultfs.hurt_read), so
+    EIO/ENOSPC mid-stream is rehearsable — the ext build's retry/resume
+    path exists because this hook can prove it works."""
+    from . import faultfs
     mode = resolve_policy(None)
     if os.environ.get("SHEEP_DDUP_GRAPH", "") == "1":
         warnings.warn("SHEEP_DDUP_GRAPH is ignored by the streaming block "
@@ -275,7 +293,9 @@ def iter_dat_blocks(path: str, block_edges: int, part: int = 0,
     start, stop = partial_range(num_records, part, num_parts) if num_parts \
         else (0, num_records)
     sc = read_sidecar(path) if mode != "trust" else None
-    whole = (start, stop) == (0, num_records)
+    whole = (start, stop) == (0, num_records) and start_edge == 0
+    if start_edge:
+        start = min(stop, start + start_edge)
     if sc is not None and sc["size"] != nbytes:
         msg = (f"{path}: checksum mismatch (size {nbytes} != recorded "
                f"{sc['size']})")
@@ -286,14 +306,20 @@ def iter_dat_blocks(path: str, block_edges: int, part: int = 0,
         sc = None
     from ..integrity.sidecar import crc_update
     crc = 0
-    mm = np.memmap(path, dtype=_XS1_DTYPE, mode="r")
-    for a in range(start, stop, block_edges):
-        b = min(a + block_edges, stop)
-        rec = mm[a:b]
-        if sc is not None and whole:
-            crc = crc_update(rec.tobytes(), crc, sc["algo"])
-        yield np.ascontiguousarray(rec["tail"]), \
-            np.ascontiguousarray(rec["head"])
+    with open(path, "rb") as f:
+        for a in range(start, stop, block_edges):
+            b = min(a + block_edges, stop)
+            faultfs.hurt_read(path)
+            f.seek(a * _XS1_DTYPE.itemsize)
+            rec = np.fromfile(f, dtype=_XS1_DTYPE, count=b - a)
+            if len(rec) < b - a:
+                raise MalformedArtifact(
+                    f"{path}: short read at record {a} (file truncated "
+                    f"mid-stream?)")
+            if sc is not None and whole:
+                crc = crc_update(rec.tobytes(), crc, sc["algo"])
+            yield np.ascontiguousarray(rec["tail"]), \
+                np.ascontiguousarray(rec["head"])
     if sc is not None and whole:
         # trailing torn bytes (if any) are part of the recorded sum
         tail_bytes = nbytes - num_records * _XS1_DTYPE.itemsize

@@ -186,6 +186,33 @@ def arm(path: str) -> str | None:
     return plan.take(site, index)
 
 
+def hurt_read(path: str) -> None:
+    """Arm-and-fire for READ sites (ISSUE 9): the streaming ``.dat``
+    block reader calls this once per block, so ``kind@dat:nth`` hurts the
+    nth BLOCK READ of an out-of-core stream exactly like it hurts the nth
+    write of an offline run — one grammar, one counter space per site
+    (reads and writes at the same site share indices; a build that does
+    both is told so by its plan, not surprised).  Writers go through
+    :func:`arm`/:func:`wrap` because their fault must tear the file;
+    readers just need the typed OSError at the right moment: eio/enospc
+    raise (ENOSPC models a reader whose backing filesystem went sick
+    mid-stream — same errno the retry logic classifies), ``short`` maps
+    to EIO (a torn read IS an I/O error to the consumer), ``slow`` stalls
+    like the write kind."""
+    kind = arm(path)
+    if kind is None:
+        return
+    if kind == "slow":
+        time.sleep(_SLOW_S)
+        return
+    if kind == "enospc":
+        raise OSError(errno.ENOSPC,
+                      "injected ENOSPC (SHEEP_IO_FAULT_PLAN) reading "
+                      + path)
+    raise OSError(errno.EIO,
+                  f"injected {kind} (SHEEP_IO_FAULT_PLAN) reading {path}")
+
+
 class FaultyFile:
     """File proxy that hurts writes per the armed kind.  Only the write
     path is proxied — flush/fileno/close pass through, so io/atomic.py's

@@ -93,6 +93,9 @@ def _bind(lib: ctypes.CDLL) -> None:
     lib.sheep_degree_histogram.restype = ctypes.c_int
     lib.sheep_degree_histogram.argtypes = [
         _u32p, _u32p, ctypes.c_int64, ctypes.c_int64, _i64p]
+    lib.sheep_degree_histogram_acc.restype = ctypes.c_int
+    lib.sheep_degree_histogram_acc.argtypes = [
+        _u32p, _u32p, ctypes.c_int64, ctypes.c_int64, _i64p]
     lib.sheep_degree_sequence.restype = ctypes.c_int64
     lib.sheep_degree_sequence.argtypes = [
         _i64p, ctypes.c_int64, _u32p]
@@ -298,6 +301,26 @@ def degree_histogram(tail: np.ndarray, head: np.ndarray, n: int) -> np.ndarray:
     if rc != 0:
         raise RuntimeError(f"sheep_degree_histogram failed rc={rc}")
     return deg
+
+
+def degree_histogram_acc(tail: np.ndarray, head: np.ndarray,
+                         deg: np.ndarray) -> None:
+    """Add one edge block's degree contributions INTO ``deg`` (int64
+    [n], caller-owned, NOT zeroed here) — the streaming accumulator of
+    the out-of-core degree pass (ops/extmem.py): per-block adds fold into
+    one histogram with no per-block allocation, exactly equal to the
+    one-shot histogram over the concatenated records."""
+    lib = _load()
+    assert lib is not None
+    tail = np.ascontiguousarray(tail, dtype=np.uint32)
+    head = np.ascontiguousarray(head, dtype=np.uint32)
+    assert deg.dtype == np.int64 and deg.flags["C_CONTIGUOUS"]
+    rc = lib.sheep_degree_histogram_acc(tail, head, len(tail), len(deg), deg)
+    if rc == -3:
+        raise ValueError(
+            f"corrupt edge records: a vid is out of range for n={len(deg)}")
+    if rc != 0:
+        raise RuntimeError(f"sheep_degree_histogram_acc failed rc={rc}")
 
 
 def jxn_build(tail: np.ndarray, head: np.ndarray, seq: np.ndarray,

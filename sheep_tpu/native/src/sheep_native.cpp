@@ -581,6 +581,25 @@ int sheep_degree_histogram(const uint32_t* tail, const uint32_t* head,
   return 0;
 }
 
+// Accumulating variant for the out-of-core streaming pass (round-8): adds
+// this block's degree contributions INTO deg_io without zeroing it, so the
+// per-block histogram of an edge stream folds into one int64 accumulator
+// with no per-block allocation.  Summing blocks is exact (integer adds
+// commute), so the accumulated histogram equals sheep_degree_histogram over
+// the concatenated records — which is what keeps the streaming degree
+// sequence bit-identical to the in-RAM one.  Same -3 contract on a vid
+// >= n; a failed block leaves deg_io with a PARTIAL block added (callers
+// abort the pass — the accumulator is not salvageable mid-block).
+int sheep_degree_histogram_acc(const uint32_t* tail, const uint32_t* head,
+                               int64_t m, int64_t n, int64_t* deg_io) {
+  for (int64_t i = 0; i < m; ++i) {
+    if (tail[i] >= (uint64_t)n || head[i] >= (uint64_t)n) return -3;
+    ++deg_io[tail[i]];
+    ++deg_io[head[i]];
+  }
+  return 0;
+}
+
 // Fused degree sequence straight from edge records (round-6): histogram
 // + ascending-degree counting sort in one call, with the histogram in
 // uint32 — int64 counters measured ~27% slower per random increment on

@@ -1,46 +1,59 @@
-from .sort import (
-    degree_histogram,
-    degree_order,
-    edge_links,
-    degree_sequence_device,
-)
-from .forest import (
-    forest_fixpoint,
-    forest_fixpoint_hosted,
-    fixpoint_chunk,
-    reduce_links_hosted,
-    parent_from_links,
-    pst_weights,
-    merge_parents,
-    build_forest_device,
-    merge_forests_device,
-)
-from .build import (build_step, build_graph_device, build_graph_hybrid,
-                    prepare_links)
-from .stream import (build_graph_streaming,
-                     build_graph_streaming_hosted, stream_block_step,
-                     streaming_degree_histogram)
+"""Single-device JAX kernels + the jax-free external-memory build.
 
-__all__ = [
-    "degree_histogram",
-    "degree_order",
-    "edge_links",
-    "degree_sequence_device",
-    "forest_fixpoint",
-    "forest_fixpoint_hosted",
-    "fixpoint_chunk",
-    "reduce_links_hosted",
-    "parent_from_links",
-    "pst_weights",
-    "merge_parents",
-    "build_forest_device",
-    "merge_forests_device",
-    "build_step",
-    "build_graph_device",
-    "build_graph_hybrid",
-    "prepare_links",
-    "build_graph_streaming",
-    "build_graph_streaming_hosted",
-    "stream_block_step",
-    "streaming_degree_histogram",
-]
+Resolution is LAZY (PEP 562): importing ``sheep_tpu.ops`` — or its
+jax-free member ``ops.extmem`` (ISSUE 9) — must not initialize a jax
+backend.  The out-of-core build's whole acceptance is peak RSS inside
+``SHEEP_MEM_BUDGET``, and a backend's baseline footprint would be most
+of a small budget; everything that was eagerly re-exported here before
+still resolves by name exactly as it did (``from sheep_tpu.ops import
+build_graph_hybrid`` triggers the jax import at that moment, not at
+package import).
+"""
+
+_LAZY = {
+    # .sort
+    "degree_histogram": "sort",
+    "degree_order": "sort",
+    "edge_links": "sort",
+    "degree_sequence_device": "sort",
+    # .forest
+    "forest_fixpoint": "forest",
+    "forest_fixpoint_hosted": "forest",
+    "fixpoint_chunk": "forest",
+    "reduce_links_hosted": "forest",
+    "parent_from_links": "forest",
+    "pst_weights": "forest",
+    "merge_parents": "forest",
+    "build_forest_device": "forest",
+    "merge_forests_device": "forest",
+    # .build
+    "build_step": "build",
+    "build_graph_device": "build",
+    "build_graph_hybrid": "build",
+    "prepare_links": "build",
+    # .stream
+    "build_graph_streaming": "stream",
+    "build_graph_streaming_hosted": "stream",
+    "stream_block_step": "stream",
+    "streaming_degree_histogram": "stream",
+    # .extmem (jax-free)
+    "build_forest_extmem": "extmem",
+    "streaming_degree_sequence": "extmem",
+    "should_use_extmem": "extmem",
+}
+
+__all__ = sorted(_LAZY)
+
+
+def __getattr__(name: str):
+    mod = _LAZY.get(name)
+    if mod is None:
+        raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+    import importlib
+    value = getattr(importlib.import_module(f".{mod}", __name__), name)
+    globals()[name] = value  # cache: next access skips the indirection
+    return value
+
+
+def __dir__():
+    return sorted(set(globals()) | set(_LAZY))

@@ -1,0 +1,410 @@
+"""External-memory build: beyond-RAM graphs as a first-class rung (ISSUE 9).
+
+The paper's core property — elimination trees built on edge-disjoint
+partial graphs over ONE sequence merge associatively into the tree of the
+union (lib/jnode.cpp:174-201) — is exactly what makes a bounded-memory
+disk-streaming build possible: fold the ``.dat`` record stream block by
+block, never holding more than O(n + block) beyond the file itself.  PR
+5's spill rung proved the associative fold through a memmap as a
+degradation FALLBACK; this module is the fast path: every stage runs
+through the native kernels at full speed and no stage — not even degree
+sequencing — materializes the edge list.
+
+Pipeline (two streaming passes over the same blocks):
+
+  pass 1  degree sequence, out-of-core: per-block native histogram
+          accumulation (sheep_degree_histogram_acc — the fused
+          sheep_degree_sequence_edges kernel's uint32-histogram idea,
+          restated as an accumulator) into one int64 array, then the
+          host counting sort (core.sequence.degree_sequence_from_degrees
+          — the ``SHEEP_STREAM_HOST_SEQ`` machinery).  Bit-identical to
+          the in-RAM sequence: integer adds commute, so the accumulated
+          histogram IS the whole-file histogram.
+  pass 2  the carry fold: blocks arrive through the double-buffered
+          async :class:`~sheep_tpu.io.prefetch.BlockPrefetcher` (the
+          ``_WindowStream`` generalization — disk read of block k+1
+          overlaps the fold of block k), and each block folds into the
+          carry forest by one of two exact strategies, picked per block
+          by the governor's priced estimates
+          (resources.governor.ext_strategy_costs):
+
+            edges  fused native records->forest (sheep_build_forest_edges
+                   — the per-block links never materialize host-side),
+                   then the bounded merge: (carry ∪ block-forest links)
+                   through one resumable fold.  Wins when block >> n.
+            links  host position mapping + ONE resumable fold over
+                   (carry ∪ block links)
+                   (sheep_build_forest_links_begin/_block/_finish via
+                   core.forest.links_fold; python twin without the
+                   native runtime).  Wins for carry-dominated blocks.
+
+          Both are the associative merge, so ANY interleaving of picks
+          converges to the bit-identical forest; pst accumulates per
+          block (each record counts at its present earlier endpoint,
+          absent-vid records included — jtree.cpp:47-49).
+
+Fault story: every block read is a ``dat``-site I/O fault point
+(io/edges.iter_dat_blocks + SHEEP_IO_FAULT_PLAN), and an EIO/ENOSPC
+mid-stream retries from the last completed block — the in-memory carry
+is still exact, so the re-opened stream (``start_edge``) resumes rather
+than restarts.  Block boundaries checkpoint through the PR-1 snapshot
+machinery (rung "ext", ``rounds`` = blocks folded), so a killed process
+resumes bit-identically; the deterministic kill point is
+``fault_point("ext-boundary")`` after each boundary, mirroring the chunk
+drivers' "died between chunks".
+
+Deliberately jax-free (like serve/): the whole point is peak RSS inside
+``SHEEP_MEM_BUDGET``, and a backend's baseline footprint would be most
+of a small budget.  ops/__init__ resolves lazily so importing this
+module never drags the device stack in.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+
+import numpy as np
+
+from .. import INVALID_JNID
+from ..core.forest import (Forest, _positions_through, build_forest_links,
+                           forest_links, links_fold, native_or_none)
+from ..core.sequence import degree_sequence_from_degrees, sequence_positions
+from ..integrity.errors import IntegrityError
+from ..integrity.sidecar import resolve_policy
+from ..io.edges import iter_dat_blocks
+from ..io.prefetch import BlockPrefetcher
+from ..resources.governor import (EXT_PREFETCH, ResourceGovernor,
+                                  ext_block_edges, ext_strategy_costs)
+from ..runtime.faults import fault_point
+from ..runtime.retry import RetryPolicy
+from ..runtime.snapshot import Checkpointer, Snapshot, input_signature
+
+_REC_BYTES = 12  # XS1 record (io/edges._XS1_DTYPE)
+
+
+def dat_num_records(path: str) -> int:
+    return os.path.getsize(path) // _REC_BYTES
+
+
+def should_use_extmem(path: str, governor: ResourceGovernor | None = None
+                      ) -> bool:
+    """Should the build CLI route this graph through the external-memory
+    rung?  Yes when the operator opted in (``SHEEP_EXT_BLOCK`` — the env
+    twin of ``--ext``, reachable from scripts) or when a configured
+    memory budget cannot hold the in-RAM load + prep (priced at ~24
+    bytes per record: uint32 tail/head arrays plus the mapped int32 link
+    pair).  Only ``.dat`` files stream (text parsing is not the
+    beyond-RAM format)."""
+    if not path.endswith(".dat"):
+        return False
+    if os.environ.get("SHEEP_EXT_BLOCK", ""):
+        return True
+    gov = governor if governor is not None else ResourceGovernor.from_env()
+    head = gov.mem_headroom()
+    if head is None:
+        return False
+    try:
+        nbytes = os.path.getsize(path)
+    except OSError:
+        return False
+    return (nbytes // _REC_BYTES) * 24 > head
+
+
+def streaming_degree_sequence(path: str, block_edges: int | None = None,
+                              max_retries: int = 3,
+                              backoff_base_s: float = 0.05,
+                              perf: dict | None = None):
+    """Out-of-core degree sequence: one prefetched pass over the ``.dat``
+    blocks accumulating the undirected-doubled histogram (native
+    ``sheep_degree_histogram_acc``; numpy bincount twin), then the host
+    counting sort.  Returns ``(seq uint32, max_vid, num_records)`` —
+    bit-identical to ``degree_sequence`` over the loaded file, at O(V)
+    resident.
+
+    A typed reader fault (EIO/ENOSPC mid-stream — the ``dat`` I/O fault
+    site) retries from the last consumed block: the histogram is exact
+    up to there (a block is only consumed after its read completed), so
+    the re-opened stream resumes the accumulation rather than restarting
+    the pass."""
+    block = block_edges or ext_block_edges()
+    native = native_or_none("auto")
+    deg = np.zeros(1 << 10, dtype=np.int64)
+    records = 0
+    max_vid = 0
+    done = 0
+    t0 = time.perf_counter()
+    read_s = 0.0
+    policy = RetryPolicy(max_retries=max_retries,
+                         backoff_base_s=backoff_base_s)
+    attempt = 0
+    while True:
+        pf = BlockPrefetcher(
+            iter_dat_blocks(path, block, start_edge=done * block),
+            depth=EXT_PREFETCH)
+        try:
+            with pf:
+                for tail, head in pf:
+                    records += len(tail)
+                    mx = int(max(tail.max(initial=0),
+                                 head.max(initial=0)))
+                    max_vid = max(max_vid, mx)
+                    if mx >= len(deg):
+                        deg = np.concatenate(
+                            [deg,
+                             np.zeros(mx + 1 - len(deg), dtype=np.int64)])
+                    if native is not None:
+                        native.degree_histogram_acc(tail, head, deg)
+                    else:
+                        deg += np.bincount(tail, minlength=len(deg))
+                        deg += np.bincount(head, minlength=len(deg))
+                    done += 1
+            read_s += pf.busy_s
+            break
+        except OSError:
+            read_s += pf.busy_s
+            if attempt >= policy.max_retries:
+                raise
+            policy.sleep(policy.backoff(attempt))
+            attempt += 1
+    seq = degree_sequence_from_degrees(deg)
+    if perf is not None:
+        perf["seq_s"] = round(time.perf_counter() - t0, 4)
+        perf["seq_read_s"] = round(read_s, 4)
+        perf["seq_retries"] = attempt
+    return seq, max_vid, records
+
+
+def _pick_strategy(n: int, carry_links: int, block_records: int,
+                   native_ok: bool) -> str:
+    """Per-block strategy pick from the governor's priced estimates
+    (``SHEEP_EXT_STRATEGY`` = edges|links pins it for A/B arms).  Both
+    strategies are exact; the price is bytes touched, so a stale pick
+    costs time, never the tree."""
+    forced = os.environ.get("SHEEP_EXT_STRATEGY", "")
+    if forced in ("edges", "links"):
+        return forced if (forced == "links" or native_ok) else "links"
+    if not native_ok:
+        return "links"
+    costs = ext_strategy_costs(n, carry_links, block_records)
+    return "edges" if costs["edges"] <= costs["links"] else "links"
+
+
+class _ExtFold:
+    """The carry-fold state of pass 2: parent-so-far as its <= n
+    (kid -> parent) links, the order-free pst accumulator, and the shared
+    vid->position table.  O(n) resident; each :meth:`fold_block` adds one
+    block and leaves the carry converged."""
+
+    def __init__(self, n: int, pos: np.ndarray):
+        self.n = n
+        self.pos = pos
+        self.pst = np.zeros(n, dtype=np.int64)
+        self.carry_lo = np.empty(0, dtype=np.int64)
+        self.carry_hi = np.empty(0, dtype=np.int64)
+        self.parent = np.full(n, INVALID_JNID, dtype=np.uint32)
+        self._zero = np.zeros(n, dtype=np.uint32)
+        self.strategies: dict[str, int] = {}
+
+    def _absorb(self, forest: Forest) -> None:
+        self.parent = forest.parent
+        self.carry_lo, self.carry_hi = forest_links(forest)
+
+    def fold_block(self, tail: np.ndarray, head: np.ndarray) -> str:
+        n = self.n
+        native = native_or_none("auto")
+        strat = _pick_strategy(n, len(self.carry_lo), len(tail),
+                               native is not None)
+        self.strategies[strat] = self.strategies.get(strat, 0) + 1
+        if strat == "edges":
+            # fused records->forest: the block's links never materialize
+            # host-side; its pst_out is exactly this block's contribution
+            # (absent-vid records counted, self-loops dropped)
+            p, w = native.build_forest_edges(tail, head, self.pos, n)
+            self.pst += w
+            kids = np.nonzero(p != INVALID_JNID)[0]
+            fold_lo = np.concatenate([self.carry_lo, kids])
+            fold_hi = np.concatenate([self.carry_hi,
+                                      p[kids].astype(np.int64)])
+            self._absorb(build_forest_links(fold_lo, fold_hi, n,
+                                            pst=self._zero))
+            return strat
+        # links: host mapping (the exact oracle semantics of
+        # core.forest.build_forest_streaming) + one resumable fold over
+        # (carry ∪ block links) — a single window, because an unsorted
+        # disk stream cannot promise the cross-window ascending-hi
+        # contract; the fold machinery is still the begin/_block/_finish
+        # kernel underneath
+        self.pos, pt, ph = _positions_through(self.pos, tail, head)
+        keep = pt != ph  # drops self-loops and both-absent
+        pt, ph = pt[keep], ph[keep]
+        lo = np.minimum(pt, ph)
+        hi = np.maximum(pt, ph)
+        if len(lo):
+            self.pst += np.bincount(lo, minlength=n)[:n]
+        tree = hi < n
+        fold = links_fold(n, pst=self._zero)
+        fold.block(np.concatenate([self.carry_lo, lo[tree]]),
+                   np.concatenate([self.carry_hi, hi[tree]]))
+        parent, _ = fold.finish()
+        self._absorb(Forest(parent, self._zero))
+        return strat
+
+
+def build_forest_extmem(path: str, block_edges: int | None = None,
+                        seq: np.ndarray | None = None,
+                        checkpoint_dir: str | None = None,
+                        resume: bool = False, max_retries: int = 3,
+                        backoff_base_s: float = 0.05,
+                        checkpoint_every: int = 1,
+                        governor: ResourceGovernor | None = None,
+                        integrity: str | None = None,
+                        events: list | None = None,
+                        perf: dict | None = None):
+    """The external-memory build: ``(seq uint32 [m], Forest over m)``,
+    bit-identical to ``build_forest`` over the loaded file, with peak
+    resident memory O(n + block) beyond the interpreter — the edge list
+    itself never loads.
+
+    ``seq`` — an externally given elimination order skips pass 1 (the
+    ``-s`` case; the absent-vid pst contract holds: records naming vids
+    outside the sequence count toward pst, never the tree).
+    ``checkpoint_dir``/``resume`` — PR-1 snapshot machinery at block
+    boundaries; ``resume`` restarts the stream at the checkpointed block
+    (``iter_dat_blocks(start_edge=...)``), producing the bit-identical
+    forest.  ``max_retries`` bounds in-process re-opens of the stream
+    after a typed reader fault (EIO/ENOSPC mid-block — the
+    ``SHEEP_IO_FAULT_PLAN`` ``dat`` site injects these): each retry
+    resumes from the in-memory carry at the last completed block.
+    ``perf`` gains blocks/read_s/fold_s/overlap_s/overlap_frac (realized
+    read/fold overlap, same accounting as the windowed handoff) and the
+    per-strategy pick counts.
+    """
+    t_start = time.perf_counter()
+    events = events if events is not None else []
+    gov = governor if governor is not None else ResourceGovernor.from_env()
+    # under a budget the block auto-shrinks to the headroom (an explicit
+    # arg or SHEEP_EXT_BLOCK pins it — it is part of the resume identity)
+    block = block_edges or gov.ext_fitted_block()
+    if seq is None:
+        seq, _, _ = streaming_degree_sequence(
+            path, block, max_retries=max_retries,
+            backoff_base_s=backoff_base_s, perf=perf)
+    seq = np.asarray(seq, dtype=np.uint32)
+    n = len(seq)
+    if n == 0:
+        return seq, Forest(np.empty(0, np.uint32), np.empty(0, np.uint32))
+    # block size is part of the resume identity: boundary k means
+    # "k * block_edges records folded", which only holds at this block
+    sig = input_signature(n, seq) + f"|ext:b{block}"
+    ckpt = Checkpointer(checkpoint_dir, checkpoint_every, governor=gov) \
+        if checkpoint_dir else None
+    fold = _ExtFold(n, sequence_positions(seq))
+    done = 0
+    if ckpt is not None and resume:
+        try:
+            snap = ckpt.load(integrity=integrity)
+            if snap is not None:
+                snap.verify(sig)
+        except IntegrityError as exc:
+            if resolve_policy(integrity) != "repair":
+                raise
+            events.append(("corrupt-checkpoint", "ext", str(exc)))
+            snap = None
+            ckpt.boundary = 0
+        if snap is not None:
+            fold.pst = snap.pst.astype(np.int64)
+            fold.carry_lo = snap.lo.astype(np.int64)
+            fold.carry_hi = snap.hi.astype(np.int64)
+            # rebuild the carry's parent view (roots of the checkpointed
+            # links); the links ARE the state, the parent is derived
+            fold._absorb(build_forest_links(fold.carry_lo, fold.carry_hi,
+                                            n, pst=fold._zero))
+            done = snap.rounds
+            events.append(("ext-resume", done))
+    policy = RetryPolicy(max_retries=max_retries,
+                         backoff_base_s=backoff_base_s)
+    stats = {"read_s": 0.0, "fold_s": 0.0, "stream_s": 0.0}
+    # progress is shared mutably with the attempt: on a mid-stream fault
+    # the blocks folded BEFORE it must survive into the retry, or the
+    # re-opened stream would refold them (parent is idempotent under a
+    # replay, pst is not — it would double-count)
+    progress = {"done": done}
+    attempt = 0
+    while True:
+        try:
+            _stream_fold(path, block, seq, sig, fold, progress, ckpt,
+                         events, stats)
+            break
+        except OSError as exc:
+            # a typed environmental reader fault (EIO/ENOSPC mid-stream):
+            # the fold state at progress["done"] blocks is exact —
+            # re-open the stream there instead of dying or restarting
+            if attempt >= policy.max_retries:
+                raise
+            events.append(("ext-retry", attempt + 1, progress["done"],
+                           f"{type(exc).__name__}: {exc}"))
+            policy.sleep(policy.backoff(attempt))
+            attempt += 1
+    done = progress["done"]
+    pst32 = fold.pst.astype(np.uint32)
+    forest = Forest(fold.parent.copy(), pst32)
+    if ckpt is not None:
+        ckpt.clear()
+    if perf is not None:
+        wall = time.perf_counter() - t_start
+        serialized = stats["read_s"] + stats["fold_s"]
+        overlap = max(0.0, serialized - stats["stream_s"])
+        perf.update({
+            "ext_blocks": done,
+            "block_edges": block,
+            "read_s": round(stats["read_s"], 4),
+            "fold_s": round(stats["fold_s"], 4),
+            "overlap_s": round(overlap, 4),
+            "overlap_frac": round(overlap / serialized, 4)
+            if serialized > 0 else 0.0,
+            "wall_s": round(wall, 4),
+            "strategies": dict(fold.strategies),
+            "retries": attempt,
+        })
+    return seq, forest
+
+
+def _stream_fold(path: str, block: int, seq: np.ndarray, sig: str,
+                 fold: _ExtFold, progress: dict,
+                 ckpt: Checkpointer | None,
+                 events: list, stats: dict) -> None:
+    """One streaming attempt from block ``progress["done"]`` on, bumping
+    it per folded block (in place, so a mid-stream fault keeps the
+    completed prefix).  Reader faults (OSError) propagate to the
+    caller's retry loop with the fold state intact — the prefetcher's
+    producer thread re-raises them typed at the consumption point."""
+    t0 = time.perf_counter()
+    it = iter_dat_blocks(path, block, start_edge=progress["done"] * block)
+    with BlockPrefetcher(it, depth=EXT_PREFETCH) as pf:
+        try:
+            for tail, head in pf:
+                t1 = time.perf_counter()
+                strat = fold.fold_block(tail, head)
+                stats["fold_s"] += time.perf_counter() - t1
+                done = progress["done"] = progress["done"] + 1
+                events.append(("ext-block", done - 1,
+                               len(fold.carry_lo), strat))
+                if ckpt is not None:
+                    if ckpt.want():
+                        ckpt.save(Snapshot(
+                            n=fold.n, seq=seq,
+                            pst=fold.pst.astype(np.uint32),
+                            lo=fold.carry_lo.astype(np.int32),
+                            hi=fold.carry_hi.astype(np.int32),
+                            rounds=done, boundary=0, rung="ext",
+                            input_sig=sig))
+                        events.append(("checkpoint", "ext",
+                                       ckpt.boundary - 1))
+                    else:
+                        ckpt.skip()
+                # the deterministic kill point: "died between blocks"
+                fault_point("ext-boundary")
+        finally:
+            stats["read_s"] += pf.busy_s
+            stats["stream_s"] += time.perf_counter() - t0

@@ -42,6 +42,7 @@ from .errors import DiskExhausted, MemoryBudgetExceeded
 MEM_BUDGET_ENV = "SHEEP_MEM_BUDGET"
 DISK_BUDGET_ENV = "SHEEP_DISK_BUDGET"
 SCRATCH_DIR_ENV = "SHEEP_SCRATCH_DIR"
+EXT_BLOCK_ENV = "SHEEP_EXT_BLOCK"
 
 #: free space a preflighted write must leave behind (the filesystem needs
 #: breathing room for directory blocks, the sidecar, and the journal; a
@@ -152,7 +153,8 @@ def chunk_tables_nbytes(n: int, levels: int) -> int:
 
 
 def rung_peak_nbytes(rung: str, n: int, links: int,
-                     workers: int = 1, levels: int = 10) -> int:
+                     workers: int = 1, levels: int = 10,
+                     ext_block: int | None = None) -> int:
     """Rough peak resident bytes of one degradation-ladder rung
     (runtime/driver.py) reducing ``links`` live links over ``n``
     positions.  Terms:
@@ -170,6 +172,16 @@ def rung_peak_nbytes(rung: str, n: int, links: int,
                    mask (~5 bytes/link) — the int32 input table itself
                    is the caller's.  Sits between host (16 bytes/link
                    cast) and spill (which pays a scratch file).
+      ext          the external-memory rung (round 8): the edge list
+                   never loads — the priced peak is the O(n) fold state
+                   (uf/parent/pst, 12n) + the vid->position table (4n) +
+                   the int64 carry pair (<= 16n) + the prefetch queue's
+                   raw record blocks ((EXT_PREFETCH + 1) * 8n uint32
+                   pairs per block of ext_block_edges()) + one block's
+                   transient int64 mapping (16 bytes/edge).  NO links
+                   term at all: for beyond-RAM inputs it prices between
+                   stream (which holds the whole int32 table) and spill
+                   (which holds nothing but one fold block).
       spill        links live in a memory-mapped scratch file; resident
                    state is the union-find fold's O(n) arrays plus one
                    block of links (SPILL_BLOCK) and the carry (<= n
@@ -184,6 +196,9 @@ def rung_peak_nbytes(rung: str, n: int, links: int,
         return 16 * links + 8 * n + 8 * n
     if rung == "stream":
         return 12 * n + 8 * min(links, SPILL_BLOCK) + 5 * links
+    if rung == "ext":
+        block = ext_block if ext_block is not None else ext_block_edges()
+        return 32 * n + EXT_RECORD_BYTES * block
     if rung == "spill":
         return 8 * SPILL_BLOCK + 16 * n + 8 * n
     raise ValueError(f"unknown rung {rung!r}")
@@ -193,6 +208,63 @@ def rung_peak_nbytes(rung: str, n: int, links: int,
 #: links = 32MB resident — small against any realistic budget, large
 #: enough that the per-block union-find amortizes.
 SPILL_BLOCK = 1 << 22
+
+#: edge records per streamed block of the external-memory build (ISSUE 9;
+#: SHEEP_EXT_BLOCK overrides): 512K records = 6MB raw on disk, ~4MB as
+#: the prefetched uint32 pair — with the double-buffered prefetch queue
+#: the in-flight data stays small enough that ext prices under the
+#: stream rung for any beyond-RAM link count, large enough that the
+#: fused per-block kernel amortizes its O(n) merge passes.
+EXT_BLOCK_DEFAULT = 1 << 19
+
+#: priced in-flight bytes per record of one ext block: the raw 12-byte
+#: read buffer + the (prefetch-depth + 1) uint32 pairs + the transient
+#: int64/uint32 mapping of the block being folded, rounded UP (measured
+#: ~44-98 B/record across both passes on the bench host) — over-pricing
+#: degrades earlier, which is the safe direction (module docstring).
+EXT_RECORD_BYTES = 64
+
+#: blocks the ext prefetcher keeps in flight beyond the one being folded
+#: (io/prefetch.py double buffering: fold k while k+1 is resident and k+2
+#: streams off the disk)
+EXT_PREFETCH = 2
+
+
+def ext_block_edges() -> int:
+    """The ext rung's block size in EDGE RECORDS (``SHEEP_EXT_BLOCK``
+    overrides; accepts a bare count or a human size like ``2M`` = 2^21
+    records — the binary-suffix grammar of the budgets, applied to
+    records).  Floor 1: a zero/empty override must not turn the stream
+    into an infinite loop."""
+    spec = os.environ.get(EXT_BLOCK_ENV, "")
+    if not spec:
+        return EXT_BLOCK_DEFAULT
+    return max(1, parse_size(spec) or EXT_BLOCK_DEFAULT)
+
+
+def ext_strategy_costs(n: int, carry_links: int, block_records: int) -> dict:
+    """Priced bytes-touched estimates of the two per-block fold strategies
+    of the external-memory build (ops/extmem.py), used to pick per block:
+
+      edges  the fused native records->forest kernel builds a PER-BLOCK
+             forest (its internal uint32 map pass touches ~12 bytes per
+             record), then the carry merge replays (carry + <= n block
+             forest links) through one fold: + 8 bytes per merge link.
+      links  the block maps host-side to int64 position pairs (~24 bytes
+             per record incl. the fold's own read) and folds WITH the
+             carry in one pass: + 8 bytes per carry link, no second
+             O(n) merge.
+
+    The crossover is block ~ 2n/3: big blocks amortize the edges
+    strategy's extra O(n) merge, small blocks (the carry-dominated tail
+    of a stream, or a tiny SHEEP_EXT_BLOCK) don't.  Deliberately coarse
+    (module docstring): both strategies are exact, so a mispriced pick
+    costs time, never correctness.
+    """
+    return {
+        "edges": 12 * block_records + 8 * (carry_links + n),
+        "links": 24 * block_records + 8 * carry_links,
+    }
 
 
 @dataclass
@@ -246,18 +318,43 @@ class ResourceGovernor:
                 f"{self.mem_budget >> 20}MB memory budget remains "
                 f"(rss {rss_bytes() >> 20}MB)")
 
+    def ext_fitted_block(self, n: int = 0) -> int:
+        """The ext rung's block size under THIS budget: the default (or
+        env) block, halved until the priced peak fits the current
+        headroom (floor 16K records — below that the per-block O(n)
+        merge swamps the stream).  An EXPLICIT ``SHEEP_EXT_BLOCK`` is
+        the operator's word and is never second-guessed — it is also
+        part of the checkpoint's resume identity, so auto-fitting only
+        applies where no one pinned it."""
+        block = ext_block_edges()
+        if os.environ.get(EXT_BLOCK_ENV, ""):
+            return block
+        head = self.mem_headroom()
+        if head is None:
+            return block
+        while block > (1 << 14) \
+                and 32 * n + EXT_RECORD_BYTES * block > head:
+            block //= 2
+        return block
+
     def plan_rungs(self, rungs: list[str], n: int, links: int,
                    workers: int = 1) -> tuple[list[str], list[tuple]]:
         """Drop ladder rungs whose estimated peak cannot fit the memory
         headroom (the LAST rung always survives — something must run, and
-        the spill floor is sized to fit any budget that fits n).  Returns
+        the spill floor is sized to fit any budget that fits n).  The ext
+        rung prices at its FITTED block (ext_fitted_block): it can shrink
+        its stream to the headroom, and skipping it for a default it
+        would never use would waste the fastest beyond-RAM path.  Returns
         (kept_rungs, [(rung, estimate, "skip"|"keep"), ...])."""
         head = self.mem_headroom()
         if head is None or not rungs:
             return rungs, []
         kept, trace = [], []
         for i, rung in enumerate(rungs):
-            est = rung_peak_nbytes(rung, n, links, workers)
+            est = rung_peak_nbytes(
+                rung, n, links, workers,
+                ext_block=self.ext_fitted_block(n) if rung == "ext"
+                else None)
             if est > head and i < len(rungs) - 1:
                 trace.append((rung, est, "skip"))
             else:

@@ -84,10 +84,21 @@ class RuntimeConfig:
     #: union-find; "stream" (ISSUE 8) folds the SAME in-RAM link table
     #: through the resumable native union-find one hi-quantile window at
     #: a time — O(n + window) beyond the input, no int64 cast, no
-    #: scratch file — so tight budgets pick it before "spill" (ISSUE 5),
-    #: the memory FLOOR, where the links table lives in a memory-mapped
-    #: scratch file and folds in bounded blocks.
-    ladder: tuple[str, ...] = ("mesh", "single", "host", "stream", "spill")
+    #: scratch file; "ext" (ISSUE 9) re-streams the ORIGINAL ``.dat``
+    #: file block-wise through the external-memory build (ops/extmem) —
+    #: O(n + block) with no link table resident at all, available only
+    #: when ``edges_path`` names the file — so tight budgets pick it
+    #: before "spill" (ISSUE 5), the memory FLOOR, where the links table
+    #: lives in a memory-mapped scratch file and folds in bounded blocks.
+    ladder: tuple[str, ...] = ("mesh", "single", "host", "stream", "ext",
+                               "spill")
+    #: the ``.dat`` file whose FULL record stream is this build's edge
+    #: input (None for in-memory or partial-load builds).  This is what
+    #: arms the "ext" rung: unlike every other rung, ext ignores the
+    #: in-RAM link table and re-streams the original file, which is only
+    #: the same build when the file IS the whole input.  The CLI sets it
+    #: for whole-file ``.dat`` loads; SHEEP_EDGES_PATH for scripts.
+    edges_path: str | None = None
     #: resource budgets (SHEEP_MEM_BUDGET / SHEEP_DISK_BUDGET); None =
     #: build one from the environment.  The governor routes the ladder
     #: around rungs whose estimated peak cannot fit, shrinks chunk work
@@ -111,6 +122,7 @@ class RuntimeConfig:
             checkpoint_every=0 if every_s == "auto" else int(every_s),
             promote_after=int(env.get("SHEEP_PROMOTE_AFTER", "16")),
             integrity=env.get("SHEEP_INTEGRITY") or None,
+            edges_path=env.get("SHEEP_EDGES_PATH") or None,
         )
         if env.get("SHEEP_WATCHDOG_S"):
             kw["watchdog_s"] = float(env["SHEEP_WATCHDOG_S"])
@@ -139,12 +151,16 @@ class ChunkRuntime:
                  events: list, rung: str, n: int, seq: np.ndarray,
                  pst: np.ndarray, input_sig: str, rounds_base: int = 0,
                  promote_after: int = 0,
-                 governor: ResourceGovernor | None = None):
+                 governor: ResourceGovernor | None = None,
+                 edges_path: str | None = None):
         self.policy = policy
         self.ckpt = checkpointer
         self.events = events
         #: resource budgets: None = unbudgeted (every check is a no-op)
         self.governor = governor
+        #: the whole-input .dat file, when one exists (the ext rung's
+        #: source; RuntimeConfig.edges_path)
+        self.edges_path = edges_path
         self._last_levels_cap: int | None = None
         self.rung = rung
         self.n = n
@@ -337,11 +353,38 @@ def _rung_stream(lo, hi, n, rt, num_workers):
     return parent
 
 
+def _rung_ext(lo, hi, n, rt, num_workers):
+    """The external-memory rung (ISSUE 9): re-stream the ORIGINAL
+    ``.dat`` file block-wise through the out-of-core build (ops/extmem)
+    — the one rung that does not consume the in-RAM link table at all,
+    so its peak is O(n + block) regardless of the edge count.  Exact
+    because the file's record stream over the driver's sequence is the
+    same link multiset the other rungs reduce (the checkpoint handoff
+    just re-derives progress from the file instead of the snapshot — any
+    rung may rebuild from the original multiset, forest = f(threshold
+    connectivity) only).  pst comes from the driver's prep like every
+    rung, so the ext build's own accumulation is discarded.  Only
+    reachable when RuntimeConfig.edges_path names the whole-input file
+    (_ladder_rungs filters it otherwise)."""
+    from ..ops.extmem import build_forest_extmem
+
+    gov = rt.governor
+    _, forest = build_forest_extmem(
+        rt.edges_path, seq=rt.seq,
+        governor=gov if gov is not None else None,
+        events=rt.events)
+    return forest.parent
+
+
 def _rung_spill(lo, hi, n, rt, num_workers):
     """The memory FLOOR of the ladder (ISSUE 5): the links table spills
     to a memory-mapped int32 scratch file and the exact union-find folds
     over it in bounded blocks — O(n + SPILL_BLOCK) resident, any link
-    count.
+    count.  Blocks arrive through the shared async prefetcher
+    (io/prefetch.BlockPrefetcher, ISSUE 9) — the same "fold blocks
+    arriving from elsewhere" path the ext rung streams its file through
+    — so the scratch read of block k+1 overlaps the fold of block k
+    instead of serializing in front of it.
 
     Soundness is the associative-merge property every other layer already
     leans on (core.forest.build_forest_streaming, the reference's
@@ -359,6 +402,7 @@ def _rung_spill(lo, hi, n, rt, num_workers):
     import tempfile
 
     from ..core.forest import forest_links
+    from ..io.prefetch import BlockPrefetcher
     from ..resources.governor import SPILL_BLOCK
 
     gov = rt.governor
@@ -380,26 +424,32 @@ def _rung_spill(lo, hi, n, rt, num_workers):
         mhi[:] = hi
         mlo.flush()
         mhi.flush()
+
+        def scratch_blocks():
+            # the memmap slice materializes IN THE PREFETCH THREAD — the
+            # actual disk/page-cache read overlaps the consumer's fold
+            for a in range(0, k, SPILL_BLOCK):
+                b = min(a + SPILL_BLOCK, k)
+                yield (np.asarray(mlo[a:b], dtype=np.int64),
+                       np.asarray(mhi[a:b], dtype=np.int64))
+
         carry_lo = np.empty(0, dtype=np.int64)
         carry_hi = np.empty(0, dtype=np.int64)
         forest = None
-        for a in range(0, k, SPILL_BLOCK):
-            b = min(a + SPILL_BLOCK, k)
-            fold_lo = np.concatenate(
-                [carry_lo, np.asarray(mlo[a:b], dtype=np.int64)])
-            fold_hi = np.concatenate(
-                [carry_hi, np.asarray(mhi[a:b], dtype=np.int64)])
-            forest = build_forest_links(fold_lo, fold_hi, n, pst=zero)
-            carry_lo, carry_hi = forest_links(forest)
-            rt.events.append(("spill-block", a // SPILL_BLOCK,
-                              len(carry_lo)))
+        with BlockPrefetcher(scratch_blocks()) as pf:
+            for i, (blo, bhi) in enumerate(pf):
+                fold_lo = np.concatenate([carry_lo, blo])
+                fold_hi = np.concatenate([carry_hi, bhi])
+                forest = build_forest_links(fold_lo, fold_hi, n, pst=zero)
+                carry_lo, carry_hi = forest_links(forest)
+                rt.events.append(("spill-block", i, len(carry_lo)))
         return forest.parent
     finally:
         shutil.rmtree(scratch, ignore_errors=True)
 
 
 _RUNGS = {"mesh": _rung_mesh, "single": _rung_single, "host": _rung_host,
-          "stream": _rung_stream, "spill": _rung_spill}
+          "stream": _rung_stream, "ext": _rung_ext, "spill": _rung_spill}
 
 
 def _ladder_rungs(config: RuntimeConfig, num_workers) -> list[str]:
@@ -409,6 +459,10 @@ def _ladder_rungs(config: RuntimeConfig, num_workers) -> list[str]:
     devs = len(jax.devices())
     if devs < 2 or (num_workers is not None and num_workers < 2):
         rungs = [r for r in rungs if r != "mesh"]
+    if not (config.edges_path and config.edges_path.endswith(".dat")
+            and os.path.exists(config.edges_path)):
+        # ext re-streams the original file; without one it has no input
+        rungs = [r for r in rungs if r != "ext"]
     return rungs or ["host"]
 
 
@@ -495,7 +549,8 @@ def build_graph_resilient(tail, head, num_vertices=None, num_workers=None,
         rt = ChunkRuntime(policy, ckpt, events, rung, n, seq_h, pst, sig,
                           rounds_base=rounds,
                           promote_after=config.promote_after,
-                          governor=gov if gov.active else None)
+                          governor=gov if gov.active else None,
+                          edges_path=config.edges_path)
         if snap is None and i == 0:
             # boundary 0 = "prep complete": a kill during the first chunk
             # resumes without re-running the degree sort / link mapping

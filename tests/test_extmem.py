@@ -1,0 +1,416 @@
+"""External-memory build (ISSUE 9): the ext rung streams sequence-sorted
+edge blocks from disk through the double-buffered prefetcher and folds
+them at native-kernel speed with O(n + block) resident.  Covered here:
+the SHEEP_EXT_BLOCK sweep (small / medium / >= edge count) bit-identical
+parent+pst and equal ECV(down) vs the in-RAM oracle, both per-block fold
+strategies, the out-of-core degree sequence, kill-at-every-block-boundary
+checkpoint/resume, the EIO/ENOSPC-at-nth-block `dat` fault sweep (retry
+in process, typed abort + resume past the budget), the governor pricing
+ext between spill and stream, the ladder integration, the prefetcher
+unit contract, and the spill rung's shared prefetcher."""
+
+import os
+
+import numpy as np
+import pytest
+
+from sheep_tpu.core import build_forest, degree_sequence
+from sheep_tpu.io import faultfs
+from sheep_tpu.io.edges import write_dat
+from sheep_tpu.ops.extmem import (build_forest_extmem, should_use_extmem,
+                                  streaming_degree_sequence)
+
+
+@pytest.fixture
+def ext_env(monkeypatch):
+    for k in ("SHEEP_EXT_BLOCK", "SHEEP_EXT_STRATEGY", "SHEEP_MEM_BUDGET",
+              "SHEEP_IO_FAULT_PLAN", "SHEEP_FAULT_INJECT"):
+        monkeypatch.delenv(k, raising=False)
+    faultfs.clear_plan()
+    yield monkeypatch
+    faultfs.clear_plan()
+
+
+def _graph_file(tmp_path, log_n=10, seed=5):
+    from sheep_tpu.utils.synth import rmat_edges
+    n = 1 << log_n
+    tail, head = rmat_edges(log_n, 4 * n, seed=seed)
+    path = str(tmp_path / "g.dat")
+    write_dat(path, tail, head)
+    seq = degree_sequence(tail, head)
+    want = build_forest(tail, head, seq)
+    return path, tail, head, seq, want
+
+
+def _ecv_down(seq, forest, tail, head, parts=4):
+    from sheep_tpu.partition import Partition, evaluate_partition
+    part = Partition.from_forest(seq, forest, num_parts=parts)
+    rep = evaluate_partition(part.parts, tail, head, seq, num_parts=parts)
+    return int(rep.ecv_down)
+
+
+# ---------------------------------------------------------------------------
+# parity: block-size sweep, strategies, streaming sequence
+# ---------------------------------------------------------------------------
+
+
+def test_block_size_sweep_parity(tmp_path, ext_env):
+    """SHEEP_EXT_BLOCK in {small, medium, >= edge count}: bit-identical
+    parent+pst and equal ECV(down) vs the in-RAM oracle (the acceptance
+    sweep)."""
+    path, tail, head, seq0, want = _graph_file(tmp_path)
+    ecv0 = _ecv_down(seq0, want, tail, head)
+    for block in ("257", "1500", str(2 * len(tail))):
+        ext_env.setenv("SHEEP_EXT_BLOCK", block)
+        perf = {}
+        seq, f = build_forest_extmem(path, perf=perf)
+        np.testing.assert_array_equal(seq, seq0)
+        np.testing.assert_array_equal(f.parent, want.parent)
+        np.testing.assert_array_equal(f.pst_weight, want.pst_weight)
+        assert _ecv_down(seq, f, tail, head) == ecv0
+        assert perf["ext_blocks"] == -(-len(tail) // int(block))
+
+
+def test_strategy_arms_parity(tmp_path, ext_env):
+    """Both per-block fold strategies — the fused records->forest kernel
+    + bounded merge, and the direct resumable links fold — are exact and
+    interchangeable (the governor's pick can never change the tree)."""
+    path, tail, head, seq0, want = _graph_file(tmp_path, seed=7)
+    for strat in ("edges", "links"):
+        ext_env.setenv("SHEEP_EXT_STRATEGY", strat)
+        perf = {}
+        seq, f = build_forest_extmem(path, block_edges=600, perf=perf)
+        assert set(perf["strategies"]) == {strat}
+        np.testing.assert_array_equal(seq, seq0)
+        np.testing.assert_array_equal(f.parent, want.parent)
+        np.testing.assert_array_equal(f.pst_weight, want.pst_weight)
+
+
+def test_streaming_degree_sequence_matches_oracle(tmp_path, ext_env):
+    """The out-of-core degree pass (per-block histogram accumulation +
+    host counting sort) equals the in-RAM sequence bit for bit."""
+    path, tail, head, seq0, _ = _graph_file(tmp_path, seed=11)
+    seq, max_vid, records = streaming_degree_sequence(path, 333)
+    np.testing.assert_array_equal(seq, seq0)
+    assert records == len(tail)
+    assert max_vid == int(max(tail.max(), head.max()))
+
+
+def test_given_partial_seq_keeps_pst_contract(tmp_path, ext_env):
+    """An externally given PARTIAL sequence: records naming absent vids
+    count toward pst at their present endpoint but never the tree
+    (jtree.cpp:47-49), exactly like the in-RAM build."""
+    path, tail, head, full, _ = _graph_file(tmp_path, seed=3)
+    sub = full[: len(full) // 2]
+    n = 1 << 10
+    want = build_forest(tail, head, sub, max_vid=n - 1)
+    for strat in ("edges", "links"):
+        ext_env.setenv("SHEEP_EXT_STRATEGY", strat)
+        seq, f = build_forest_extmem(path, block_edges=700, seq=sub)
+        np.testing.assert_array_equal(seq, sub)
+        np.testing.assert_array_equal(f.parent, want.parent)
+        np.testing.assert_array_equal(f.pst_weight, want.pst_weight)
+
+
+def test_perf_record_shape(tmp_path, ext_env):
+    path, tail, head, _, _ = _graph_file(tmp_path)
+    perf = {}
+    build_forest_extmem(path, block_edges=900, perf=perf)
+    for key in ("ext_blocks", "block_edges", "read_s", "fold_s",
+                "overlap_s", "overlap_frac", "wall_s", "strategies",
+                "retries", "seq_s"):
+        assert key in perf, (key, perf)
+    assert perf["retries"] == 0
+    assert 0.0 <= perf["overlap_frac"] <= 1.0
+
+
+# ---------------------------------------------------------------------------
+# crash/fault story: kill-at-boundary resume, dat-site EIO/ENOSPC sweep
+# ---------------------------------------------------------------------------
+
+
+def test_kill_at_every_block_boundary_resume(tmp_path, ext_env):
+    """Kill the build at EVERY block boundary; a resumed process must
+    produce the bit-identical forest with equal ECV(down)."""
+    from sheep_tpu.runtime import (BuildKilled, FaultPlan, clear_plan,
+                                   install_plan, reset_counters)
+    path, tail, head, seq0, want = _graph_file(tmp_path)
+    ecv0 = _ecv_down(seq0, want, tail, head)
+    B = 800
+    nblocks = -(-len(tail) // B)
+    for k in range(nblocks):
+        ck = str(tmp_path / f"ck{k}")
+        reset_counters()
+        install_plan(FaultPlan(site="ext-boundary", at=k, kind="kill"))
+        with pytest.raises(BuildKilled):
+            build_forest_extmem(path, block_edges=B, checkpoint_dir=ck)
+        clear_plan()
+        reset_counters()
+        events = []
+        seq, f = build_forest_extmem(path, block_edges=B,
+                                     checkpoint_dir=ck, resume=True,
+                                     events=events)
+        if k > 0:  # boundary 0 kills before any checkpoint cadence issue
+            assert any(e[0] == "ext-resume" for e in events), (k, events)
+        np.testing.assert_array_equal(seq, seq0)
+        np.testing.assert_array_equal(f.parent, want.parent)
+        np.testing.assert_array_equal(f.pst_weight, want.pst_weight)
+        assert _ecv_down(seq, f, tail, head) == ecv0
+        # build completed: the checkpoint cleared (a later resume is fresh)
+        assert not os.path.exists(os.path.join(ck, "sheep-ckpt.npz"))
+
+
+def test_eio_at_every_block_read_retries_in_process(tmp_path, ext_env):
+    """The `dat` fault site swept over every block read of BOTH streaming
+    passes: each EIO retries from the last completed block (the carry is
+    exact there) and the result stays bit-identical."""
+    path, tail, head, seq0, want = _graph_file(tmp_path)
+    B = 800
+    nblocks = -(-len(tail) // B)
+    for k in range(2 * nblocks):  # pass 1 reads 0..n-1, pass 2 the rest
+        faultfs.install_plan(faultfs.parse_io_fault_plan(f"eio@dat:{k}"))
+        perf = {}
+        seq, f = build_forest_extmem(path, block_edges=B,
+                                     backoff_base_s=0.0, perf=perf)
+        faultfs.clear_plan()
+        assert perf["retries"] + perf.get("seq_retries", 0) == 1, (k, perf)
+        np.testing.assert_array_equal(seq, seq0)
+        np.testing.assert_array_equal(f.parent, want.parent)
+        np.testing.assert_array_equal(f.pst_weight, want.pst_weight)
+
+
+def test_enospc_mid_stream_retries(tmp_path, ext_env):
+    path, tail, head, _, want = _graph_file(tmp_path, seed=13)
+    faultfs.install_plan(faultfs.parse_io_fault_plan("enospc@dat:2"))
+    events = []
+    _, f = build_forest_extmem(path, block_edges=700, backoff_base_s=0.0,
+                               events=events)
+    faultfs.clear_plan()
+    assert any(e[0] == "ext-retry" for e in events) or events
+    np.testing.assert_array_equal(f.parent, want.parent)
+    np.testing.assert_array_equal(f.pst_weight, want.pst_weight)
+
+
+def test_reader_fault_budget_exhausts_typed_then_resumes(tmp_path,
+                                                         ext_env):
+    """A persistently sick disk exhausts the in-process retry budget with
+    a TYPED OSError — and the checkpoint makes the abort resumable: a
+    later clean run completes bit-identically."""
+    path, tail, head, seq0, want = _graph_file(tmp_path)
+    ck = str(tmp_path / "ck")
+    plan = ",".join(f"eio@dat:{i}" for i in range(3, 24))
+    faultfs.install_plan(faultfs.parse_io_fault_plan(plan))
+    with pytest.raises(OSError, match="injected"):
+        build_forest_extmem(path, block_edges=800, checkpoint_dir=ck,
+                            max_retries=2, backoff_base_s=0.0)
+    faultfs.clear_plan()
+    seq, f = build_forest_extmem(path, block_edges=800, checkpoint_dir=ck,
+                                 resume=True)
+    np.testing.assert_array_equal(seq, seq0)
+    np.testing.assert_array_equal(f.parent, want.parent)
+    np.testing.assert_array_equal(f.pst_weight, want.pst_weight)
+
+
+def test_resume_refuses_other_block_size(tmp_path, ext_env):
+    """The block size is part of the resume identity (boundary k means
+    k * block records folded): a checkpoint written at one SHEEP_EXT_BLOCK
+    must not resume under another."""
+    from sheep_tpu.integrity.errors import IntegrityError
+    from sheep_tpu.runtime import (BuildKilled, FaultPlan, clear_plan,
+                                   install_plan, reset_counters)
+    path, tail, head, _, _ = _graph_file(tmp_path)
+    ck = str(tmp_path / "ck")
+    reset_counters()
+    install_plan(FaultPlan(site="ext-boundary", at=2, kind="kill"))
+    with pytest.raises(BuildKilled):
+        build_forest_extmem(path, block_edges=800, checkpoint_dir=ck)
+    clear_plan()
+    reset_counters()
+    with pytest.raises(IntegrityError):
+        build_forest_extmem(path, block_edges=500, checkpoint_dir=ck,
+                            resume=True)
+
+
+# ---------------------------------------------------------------------------
+# governor pricing + ladder integration + the shared prefetcher
+# ---------------------------------------------------------------------------
+
+
+def test_governor_prices_ext_between_spill_and_stream(ext_env,
+                                                      monkeypatch):
+    """Beyond-RAM shapes: the ext rung (no link table resident at all)
+    prices above spill (one fold block, no prefetch queue) and below
+    stream (the whole int32 table) — so a tight budget routes
+    host -> stream -> EXT before paying spill's scratch file."""
+    import sheep_tpu.resources.governor as gov_mod
+    from sheep_tpu.resources.governor import (ResourceGovernor,
+                                              rung_peak_nbytes)
+    n, links = 1 << 20, 1 << 23
+    host_est = rung_peak_nbytes("host", n, links)
+    stream_est = rung_peak_nbytes("stream", n, links)
+    ext_est = rung_peak_nbytes("ext", n, links)
+    spill_est = rung_peak_nbytes("spill", n, links)
+    assert spill_est < ext_est < stream_est < host_est
+    monkeypatch.setattr(gov_mod, "rss_bytes", lambda: 0)
+    gov = ResourceGovernor(mem_budget=(ext_est + stream_est) // 2)
+    rungs, _ = gov.plan_rungs(["host", "stream", "ext", "spill"], n, links)
+    assert rungs == ["ext", "spill"]
+    tight = ResourceGovernor(mem_budget=spill_est // 2)
+    rungs, _ = tight.plan_rungs(["host", "stream", "ext", "spill"],
+                                n, links)
+    assert rungs == ["spill"]  # the floor always survives
+
+
+def test_ext_block_env_grammar(ext_env):
+    from sheep_tpu.resources.governor import (EXT_BLOCK_DEFAULT,
+                                              ext_block_edges)
+    assert ext_block_edges() == EXT_BLOCK_DEFAULT
+    ext_env.setenv("SHEEP_EXT_BLOCK", "2M")
+    assert ext_block_edges() == 1 << 21
+    ext_env.setenv("SHEEP_EXT_BLOCK", "4096")
+    assert ext_block_edges() == 4096
+
+
+def test_ext_rung_through_ladder(tmp_path, ext_env):
+    """build_graph_resilient with edges_path: the ext rung re-streams the
+    file and the driver's own pst/validation close over it, oracle-exact."""
+    from sheep_tpu.runtime import RuntimeConfig, build_graph_resilient
+    path, tail, head, seq0, want = _graph_file(tmp_path, seed=9)
+    ext_env.setenv("SHEEP_EXT_BLOCK", "700")
+    cfg = RuntimeConfig(ladder=("ext", "spill"), edges_path=path)
+    seq, f = build_graph_resilient(tail, head, config=cfg)
+    np.testing.assert_array_equal(seq, seq0)
+    np.testing.assert_array_equal(f.parent, want.parent)
+    np.testing.assert_array_equal(f.pst_weight, want.pst_weight)
+    assert any(e[0] == "ext-block" for e in cfg.events)
+
+
+def test_ladder_drops_ext_without_edges_path(tmp_path, ext_env):
+    """No edges_path (or a non-.dat one): the ext rung silently leaves
+    the ladder instead of faulting on a missing input."""
+    from sheep_tpu.runtime import RuntimeConfig, build_graph_resilient
+    path, tail, head, seq0, want = _graph_file(tmp_path, seed=2)
+    cfg = RuntimeConfig(ladder=("ext", "host"))
+    seq, f = build_graph_resilient(tail, head, config=cfg)
+    np.testing.assert_array_equal(f.parent, want.parent)
+    assert not any(e[0] == "ext-block" for e in cfg.events)
+
+
+def test_spill_rung_shares_block_prefetcher(tmp_path, ext_env,
+                                            monkeypatch):
+    """Satellite: the spill rung's memmap blocks arrive through the SAME
+    async prefetcher as the ext stream (one code path for 'fold blocks
+    arriving from elsewhere'), parity intact."""
+    import sheep_tpu.io.prefetch as prefetch_mod
+    import sheep_tpu.resources.governor as gov_mod
+    from sheep_tpu.runtime import RuntimeConfig, build_graph_resilient
+    path, tail, head, seq0, want = _graph_file(tmp_path, seed=4)
+    monkeypatch.setattr(gov_mod, "SPILL_BLOCK", 509)
+    made = {"n": 0}
+    real = prefetch_mod.BlockPrefetcher
+
+    class Counting(real):
+        def __init__(self, *a, **kw):
+            made["n"] += 1
+            super().__init__(*a, **kw)
+
+    monkeypatch.setattr(prefetch_mod, "BlockPrefetcher", Counting)
+    cfg = RuntimeConfig(ladder=("spill",))
+    seq, f = build_graph_resilient(tail, head, config=cfg)
+    assert made["n"] == 1
+    assert sum(1 for e in cfg.events if e[0] == "spill-block") > 1
+    np.testing.assert_array_equal(f.parent, want.parent)
+    np.testing.assert_array_equal(f.pst_weight, want.pst_weight)
+
+
+def test_should_use_extmem_routing(tmp_path, ext_env):
+    from sheep_tpu.resources.governor import ResourceGovernor
+    path, tail, head, _, _ = _graph_file(tmp_path)
+    assert not should_use_extmem(path)  # no budget, no opt-in
+    assert not should_use_extmem(str(tmp_path / "g.net"))
+    ext_env.setenv("SHEEP_EXT_BLOCK", "1024")
+    assert should_use_extmem(path)  # env opt-in
+    ext_env.delenv("SHEEP_EXT_BLOCK")
+    gov = ResourceGovernor(mem_budget=1)
+    assert should_use_extmem(path, gov)  # the load cannot fit
+
+
+def test_cli_ext_tree_identical(tmp_path, ext_env):
+    """graph2tree --ext writes the bit-identical .tre of the in-RAM run."""
+    from sheep_tpu.cli.graph2tree import main
+    from sheep_tpu.io.trefile import read_tree
+    path, tail, head, _, want = _graph_file(tmp_path, seed=6)
+    assert main([path, "-o", str(tmp_path / "ram.tre")]) == 0
+    ext_env.setenv("SHEEP_EXT_BLOCK", "600")
+    assert main([path, "-o", str(tmp_path / "ext.tre")]) == 0
+    a = read_tree(str(tmp_path / "ram.tre"))
+    b = read_tree(str(tmp_path / "ext.tre"))
+    np.testing.assert_array_equal(a[0], b[0])
+    np.testing.assert_array_equal(a[1], b[1])
+    np.testing.assert_array_equal(b[0], want.parent)
+
+
+# ---------------------------------------------------------------------------
+# BlockPrefetcher unit contract
+# ---------------------------------------------------------------------------
+
+
+def test_prefetcher_preserves_order_and_counts():
+    from sheep_tpu.io.prefetch import BlockPrefetcher
+    items = list(range(57))
+    with BlockPrefetcher(iter(items), depth=3) as pf:
+        assert list(pf) == items
+    assert pf.blocks == len(items)
+
+
+def test_prefetcher_bounded_lead():
+    """The producer never runs more than `depth` blocks ahead of the
+    consumer — that bound IS the O(depth x block) residency promise."""
+    import time
+
+    from sheep_tpu.io.prefetch import BlockPrefetcher
+    lead = {"max": 0}
+    consumed = {"n": 0}
+
+    def produce():
+        for i in range(40):
+            lead["max"] = max(lead["max"], i - consumed["n"])
+            yield i
+
+    with BlockPrefetcher(produce(), depth=2) as pf:
+        for _ in pf:
+            time.sleep(0.001)  # slow consumer: the producer must wait
+            consumed["n"] += 1
+    # the producer can be at most depth buffered + 1 in-flight ahead
+    assert lead["max"] <= 3, lead
+
+
+def test_prefetcher_propagates_typed_errors():
+    from sheep_tpu.io.prefetch import BlockPrefetcher
+
+    def produce():
+        yield 1
+        yield 2
+        raise OSError(5, "sick disk")
+
+    got = []
+    with pytest.raises(OSError, match="sick disk"):
+        with BlockPrefetcher(produce()) as pf:
+            for x in pf:
+                got.append(x)
+    assert got == [1, 2]  # everything read before the fault is delivered
+
+
+def test_prefetcher_close_releases_producer():
+    from sheep_tpu.io.prefetch import BlockPrefetcher
+
+    def produce():
+        i = 0
+        while True:  # infinite producer: only close() can end it
+            yield i
+            i += 1
+
+    pf = BlockPrefetcher(produce(), depth=2)
+    assert next(pf) == 0
+    pf.close()
+    assert not pf._thread.is_alive()

@@ -352,6 +352,13 @@ def _run_one(log_n: int) -> dict:
                                  or k.startswith("spec_")}
         if name == "device":
             rec[name]["rounds"] = int(out[1])
+        if name == "hybrid":
+            # flight-recorder A/B (ISSUE 10): one extra traced rep of
+            # the same build — the record carries the measured tracing
+            # overhead vs the untraced best, the per-phase rollup (the
+            # ONE code path the overlap/fetch/fold splits come from),
+            # and the wall reconciliation (top-level span coverage)
+            rec[name]["trace_ab"] = _trace_ab(fn, best, log_n)
         print(f"bench: n=2^{log_n} {name}: {e / best:.0f} edges/s "
               f"(best {best:.3f}s)", file=sys.stderr)
         partial = dict(rec)
@@ -385,6 +392,57 @@ def _run_one(log_n: int) -> dict:
     # the watcher salvage parse the LAST stdout line)
     print(json.dumps(rec), flush=True)
     return rec
+
+
+def _trace_ab(fn, untraced_best_s: float, log_n: int) -> dict:
+    """Run ``fn`` SHEEP_BENCH_REPS times with SHEEP_TRACE on; return the
+    A/B record: best traced vs best untraced (best-vs-best — a single
+    traced rep against an untraced best would charge run-to-run variance
+    to the recorder), the in-memory phase rollup of the best rep, and
+    the trace-file reconciliation (sum of top-level span durations vs
+    the traced wall — the <=5% acceptance check of ISSUE 10)."""
+    import tempfile
+    from sheep_tpu.obs import trace as obs_trace
+
+    reps = int(os.environ.get("SHEEP_BENCH_REPS", "3"))
+    tdir = tempfile.mkdtemp(prefix="sheep-bench-trace-")
+    prev = os.environ.get(obs_trace.ENV)
+    times, paths, summaries = [], [], []
+    try:
+        for i in range(reps):
+            tpath = os.path.join(tdir, f"hybrid_{log_n}_{i}.trace")
+            os.environ[obs_trace.ENV] = tpath
+            t0 = time.perf_counter()
+            fn({})
+            times.append(time.perf_counter() - t0)
+            paths.append(tpath)
+            summaries.append(obs_trace.trace_summary())
+            obs_trace.close_recorder()
+    finally:
+        if prev is None:
+            os.environ.pop(obs_trace.ENV, None)
+        else:
+            os.environ[obs_trace.ENV] = prev
+    best_i = times.index(min(times))
+    traced_s = times[best_i]
+    out = {
+        "traced_best_s": round(traced_s, 4),
+        "traced_times": [round(x, 4) for x in times],
+        "untraced_best_s": round(untraced_best_s, 4),
+        "overhead_frac": round(traced_s / untraced_best_s - 1.0, 4)
+        if untraced_best_s > 0 else 0.0,
+        "summary": summaries[best_i],
+    }
+    try:
+        records, _, _ = obs_trace.read_trace(paths[best_i], "repair")
+        top = sum(float(r.get("dur", 0.0)) for r in records
+                  if r.get("k") == "span" and r.get("par") is None)
+        out["top_level_span_s"] = round(top, 4)
+        out["wall_recon_frac"] = round(top / traced_s, 4) \
+            if traced_s > 0 else 0.0
+    except Exception as exc:  # a failed read must not sink the bench
+        out["trace_read_error"] = f"{type(exc).__name__}: {exc}"
+    return out
 
 
 def _headline(rec: dict) -> None:

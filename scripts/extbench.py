@@ -84,8 +84,16 @@ def child_ext(path: str) -> dict:
     # jax-free by construction: ops/__init__ resolves lazily and extmem
     # never touches the device stack — assert it stayed that way, because
     # a backend import would silently eat most of a small budget
+    from sheep_tpu.obs import trace as obs_trace
     from sheep_tpu.ops.extmem import build_forest_extmem, dat_num_records
     records = dat_num_records(path)
+    # flight recorder on (ISSUE 10): the record embeds the phase rollup
+    # alongside the perf dict, which itself now DERIVES its read/fold/
+    # overlap split from the same obs.trace code path
+    ours = obs_trace.ENV not in os.environ
+    tpath = os.environ.setdefault(
+        obs_trace.ENV, os.path.join(os.environ.get("TMPDIR", "/tmp"),
+                                    f"extbench-{os.getpid()}.trace"))
     perf: dict = {}
     t0 = time.perf_counter()
     seq, forest = build_forest_extmem(path, perf=perf)
@@ -93,7 +101,15 @@ def child_ext(path: str) -> dict:
     assert "jax" not in sys.modules, "ext arm imported jax"
     out = {"arm": "ext", "records": records, "wall_s": round(wall, 3),
            "edges_per_s": round(records / wall, 1),
-           "vmhwm_bytes": vmhwm_bytes(), "n": int(len(seq)), "perf": perf}
+           "vmhwm_bytes": vmhwm_bytes(), "n": int(len(seq)), "perf": perf,
+           "trace": obs_trace.trace_summary()}
+    obs_trace.close_recorder()
+    if ours:  # scratch trace: keep only an operator-requested one
+        for junk in (tpath, tpath + ".sum"):
+            try:
+                os.unlink(junk)
+            except OSError:
+                pass
     out.update(_crcs(forest))
     return out
 

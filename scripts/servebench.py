@@ -89,6 +89,22 @@ def _quantiles(samples_ms):
     return round(p50, 3), round(p99, 3)
 
 
+def _metrics_summary(client):
+    """The daemon's own histogram registry as the record's latency
+    summary (ISSUE 10): the per-verb req_*/p50_*/p99_* keys STATS
+    derives from the metrics registry, plus the raw Prometheus scrape's
+    size/series count — one code path, so the bench record and what a
+    scraper sees cannot disagree."""
+    st = client.kv("STATS")
+    summary = {k: st[k] for k in sorted(st)
+               if k.startswith(("req_", "p50_", "p99_"))}
+    body = client.metrics()
+    summary["_scrape_bytes"] = len(body)
+    summary["_scrape_series"] = sum(1 for ln in body.splitlines()
+                                    if ln and not ln.startswith("#"))
+    return summary
+
+
 def _query_burst(client, vids, n_requests, batch=16):
     """n_requests PART requests; returns per-request latencies in ms."""
     lat = []
@@ -183,6 +199,7 @@ def failover_bench(graph: str, out: str) -> int:
                                  / max(rec["leader_qps"], 1e-9), 2)
     total_acked = c.kv("STATS")["applied_seqno"]
     rec["acked_before_kill"] = total_acked
+    rec["server_metrics"] = _metrics_summary(c)
 
     # -- kill -9 the leader: time to promoted follower -------------------
     c.close()
@@ -325,6 +342,7 @@ def main() -> int:
     st = c.kv("STATS")
     rec["snap_failures"] = st["snap_failures"]  # the injected ENOSPC
     total_acked = st["applied_seqno"]
+    rec["server_metrics"] = _metrics_summary(c)
 
     # -- kill -9 -> restart -> first answer (recovery time) ---------------
     c.close()

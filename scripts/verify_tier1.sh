@@ -294,6 +294,44 @@ then
 fi
 # -------------------------------------------------------------------------
 
+# --- flight-recorder smoke (observability, ISSUE 10) ---------------------
+# One traced build (SHEEP_TRACE on): the tree must stay oracle-exact, the
+# trace file must fsck clean (sealed sidecar + parseable JSONL), and
+# `sheep trace` must render its rollup + rung explanation with exit 0.
+# Seconds of work; a regression anywhere in the obs layer fails the gate
+# before pytest even runs.
+OBS_DIR=$(mktemp -d)
+if env JAX_PLATFORMS=cpu SHEEP_TRACE="$OBS_DIR/build.trace" python - <<'EOF'
+import numpy as np
+from sheep_tpu.core import build_forest, degree_sequence
+from sheep_tpu.runtime import RuntimeConfig, build_graph_resilient
+from sheep_tpu.utils.synth import rmat_edges
+
+tail, head = rmat_edges(10, 4 << 10, seed=19)
+want = build_forest(tail, head, degree_sequence(tail, head))
+seq, forest = build_graph_resilient(
+    tail, head, config=RuntimeConfig(ladder=("single", "host")))
+np.testing.assert_array_equal(forest.parent, want.parent)
+np.testing.assert_array_equal(forest.pst_weight, want.pst_weight)
+EOF
+then
+  if ! env JAX_PLATFORMS=cpu bin/fsck -q "$OBS_DIR/build.trace" \
+      > /dev/null; then
+    echo "OBS SMOKE FAILED: traced build left a trace that fails fsck" >&2
+    rm -rf "$OBS_DIR"; exit 1
+  fi
+  if ! env JAX_PLATFORMS=cpu bin/trace "$OBS_DIR/build.trace" \
+      | grep -q "ran: rung"; then
+    echo "OBS SMOKE FAILED: sheep trace did not explain the ladder rung" >&2
+    rm -rf "$OBS_DIR"; exit 1
+  fi
+  rm -rf "$OBS_DIR"
+else
+  echo "OBS SMOKE FAILED: the traced build diverged from the oracle" >&2
+  rm -rf "$OBS_DIR"; exit 1
+fi
+# -------------------------------------------------------------------------
+
 # --- serve smoke (crash-safe partition service, ISSUE 6) -----------------
 # Start a real bin/serve subprocess on a tiny graph, query + insert over
 # the wire, kill -9, restart from the same state dir, and assert the
@@ -347,6 +385,15 @@ c = connect_retry(*addr(), timeout_s=60)
 st = c.kv("STATS")
 assert st["applied_seqno"] == 5, ("acked insert lost across kill -9", st)
 assert c.part(list(range(100))) == post_parts, "recovered parts diverged"
+# METRICS scrape (ISSUE 10): Prometheus grammar over the wire, per-verb
+# counters live, and STATS quantiles derived from the same registry
+body = c.metrics()
+assert "# TYPE sheep_serve_requests_total counter" in body, body[:400]
+assert 'sheep_serve_requests_total{verb="PART"}' in body, body[:400]
+assert "# TYPE sheep_serve_request_seconds histogram" in body
+assert "sheep_serve_applied_seqno 5" in body, body[:400]
+st = c.kv("STATS")
+assert st["req_part"] >= 1 and float(st["p99_part_ms"]) > 0, st
 c.request("QUIT")
 c.close()
 proc.send_signal(signal.SIGTERM)

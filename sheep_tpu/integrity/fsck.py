@@ -20,9 +20,10 @@ from .errors import IntegrityError, MalformedArtifact
 from .sidecar import read_sidecar, resolve_policy, verify_file
 
 #: suffixes fsck knows how to verify (``.npz`` = runtime snapshots,
-#: ``.wal``/``.snap`` = the serve daemon's log + serving snapshots)
+#: ``.wal``/``.snap`` = the serve daemon's log + serving snapshots,
+#: ``.trace`` = flight-recorder span logs, ISSUE 10)
 ARTIFACT_SUFFIXES = (".tre", ".seq", ".dat", ".net", ".npz",
-                     ".wal", ".snap")
+                     ".wal", ".snap", ".trace")
 
 
 def _fsck_tre(path: str, mode: str) -> str:
@@ -168,6 +169,28 @@ def _fsck_snap(path: str, mode: str) -> str:
             f"inserted={len(snap.ins_tail)} parts={snap.num_parts}")
 
 
+def _fsck_trace(path: str, mode: str) -> str:
+    """Verify a flight-recorder trace (obs/trace.py): every line parses
+    as a JSON trace record; a torn trailing line — the kill -9 shape —
+    is refused strict / reported truncatable in repair (same contract as
+    the WAL); an unparseable line with intact records after it is
+    mid-file rot, refused in every mode."""
+    from ..obs.trace import read_trace
+
+    import warnings
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")  # the tear shows in the detail
+        records, _, torn = read_trace(path, mode)
+    spans = sum(1 for r in records if r.get("k") == "span")
+    events = sum(1 for r in records if r.get("k") == "ev")
+    segments = sum(1 for r in records if r.get("k") == "meta")
+    detail = (f"records={len(records)} spans={spans} events={events} "
+              f"segments={segments}")
+    if torn:
+        detail += " torn_tail=truncatable"
+    return detail
+
+
 _CHECKERS = {
     ".tre": _fsck_tre,
     ".seq": _fsck_seq,
@@ -176,6 +199,7 @@ _CHECKERS = {
     ".npz": _fsck_npz,
     ".wal": _fsck_wal,
     ".snap": _fsck_snap,
+    ".trace": _fsck_trace,
 }
 
 

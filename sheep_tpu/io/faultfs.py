@@ -183,7 +183,11 @@ def arm(path: str) -> str | None:
     plan = _active_plan()
     if plan is None:
         return None
-    return plan.take(site, index)
+    kind = plan.take(site, index)
+    if kind is not None:
+        from ..obs import trace as _obs
+        _obs.event("io.fault", site=site, index=index, kind=kind)
+    return kind
 
 
 def hurt_read(path: str) -> None:

@@ -14,17 +14,20 @@ from the fault plan must reach the retry/degrade logic typed, not wrapped
 into anonymity), and abandoning the iterator releases the thread at the
 next block boundary.
 
-The producer's time inside ``next()`` accumulates in ``busy_s`` so
-callers can report the realized read/fold overlap the same way the
-windowed handoff reports ``overlap_frac`` (PERF_NOTES r07: measured, not
-assumed — on a 1-core host the overlap capacity is ~zero and the records
-must say so honestly).
+The producer's time inside ``next()`` accumulates through the flight
+recorder's shared timing helper (obs.trace.timed — one span per block
+when ``SHEEP_TRACE`` is on, the same measured series either way), and
+``busy_s`` is the derived view callers feed to the ONE overlap
+accounting (obs.trace.overlap_stats) the windowed handoff and the ext
+build share (PERF_NOTES r07: measured, not assumed — on a 1-core host
+the overlap capacity is ~zero and the records must say so honestly).
 """
 
 from __future__ import annotations
 
 import threading
-import time
+
+from ..obs import trace as obs
 
 #: blocks the producer may run ahead of the consumer (double buffering:
 #: fold block k while k+1 is resident and k+2 is being read)
@@ -34,16 +37,19 @@ DEFAULT_DEPTH = 2
 class BlockPrefetcher:
     """Iterate ``source`` on a background thread, at most ``depth`` blocks
     ahead of the consumer.  Use as an iterator (``for block in pf:``) or a
-    context manager (guarantees the thread is released on early exit)."""
+    context manager (guarantees the thread is released on early exit).
+    ``trace_name`` names the per-block read span in the flight recorder."""
 
     _END = object()
 
-    def __init__(self, source, depth: int = DEFAULT_DEPTH):
+    def __init__(self, source, depth: int = DEFAULT_DEPTH,
+                 trace_name: str = "prefetch.read"):
         if depth < 1:
             raise ValueError(f"prefetch depth {depth} must be >= 1")
         self.depth = depth
-        self.busy_s = 0.0  # producer time actually spent reading blocks
-        self.blocks = 0    # blocks produced so far
+        self.trace_name = trace_name
+        self._read_s: list = []  # per-block producer seconds (obs.timed)
+        self.blocks = 0          # blocks produced so far
         self._src = iter(source)
         self._buf: list = []
         self._exc: BaseException | None = None
@@ -53,6 +59,12 @@ class BlockPrefetcher:
         self._thread = threading.Thread(target=self._run, daemon=True)
         self._thread.start()
 
+    @property
+    def busy_s(self) -> float:
+        """Producer time actually spent reading blocks (the overlap
+        accounting's serialized read term)."""
+        return sum(self._read_s)
+
     def _run(self) -> None:
         try:
             while True:
@@ -61,12 +73,12 @@ class BlockPrefetcher:
                         self._cv.wait(0.5)
                     if self._abort:
                         return
-                t0 = time.perf_counter()
                 try:
-                    item = next(self._src)
+                    with obs.timed(self.trace_name, out=self._read_s,
+                                   block=self.blocks):
+                        item = next(self._src)
                 except StopIteration:
                     return
-                self.busy_s += time.perf_counter() - t0
                 with self._cv:
                     self._buf.append(item)
                     self.blocks += 1

@@ -15,6 +15,8 @@ import threading
 
 import numpy as np
 
+from ..obs import trace as _obs
+
 _DIR = os.path.dirname(os.path.abspath(__file__))
 _SO = os.path.join(_DIR, "libsheep_native.so")
 _lock = threading.Lock()
@@ -144,8 +146,9 @@ def build_forest_links(lo: np.ndarray, hi: np.ndarray, n: int,
         pst_ptr = pst.ctypes.data_as(ctypes.c_void_p)
     pre_out = np.empty(n, dtype=np.uint32) if compute_pre else None
     pre_ptr = pre_out.ctypes.data_as(ctypes.c_void_p) if compute_pre else None
-    rc = lib.sheep_build_forest(lo, hi, len(lo), n, pst_ptr, parent, pst_out,
-                                pre_ptr)
+    with _obs.span("native.build_forest", links=len(lo), n=n):
+        rc = lib.sheep_build_forest(lo, hi, len(lo), n, pst_ptr, parent,
+                                    pst_out, pre_ptr)
     if rc != 0:
         raise RuntimeError(f"sheep_build_forest failed rc={rc}")
     if compute_pre:
@@ -195,10 +198,11 @@ class LinksFold:
         assert not self._done, "fold already finished"
         lo = np.ascontiguousarray(lo, dtype=np.uint32)
         hi = np.ascontiguousarray(hi, dtype=np.uint32)
-        r = self._lib.sheep_build_forest_links_block(
-            lo, hi, len(lo), self.n, self._bound,
-            1 if self.accumulate_pst else 0, self.parent, self.pst,
-            self._uf)
+        with _obs.span("native.links_fold.block", links=len(lo)):
+            r = self._lib.sheep_build_forest_links_block(
+                lo, hi, len(lo), self.n, self._bound,
+                1 if self.accumulate_pst else 0, self.parent, self.pst,
+                self._uf)
         if r == -7:
             raise ValueError(
                 "out-of-order fold window: a linked hi precedes the "
@@ -211,8 +215,9 @@ class LinksFold:
 
     def finish(self) -> tuple[np.ndarray, np.ndarray]:
         """Seal the fold; returns (parent, pst) uint32 [n]."""
-        rc = self._lib.sheep_build_forest_links_finish(self.n, self.parent,
-                                                       self._uf)
+        with _obs.span("native.links_fold.finish", n=self.n):
+            rc = self._lib.sheep_build_forest_links_finish(
+                self.n, self.parent, self._uf)
         if rc != 0:
             raise RuntimeError(f"sheep_build_forest_links_finish rc={rc}")
         self._done = True
@@ -242,8 +247,10 @@ def build_forest_edges(tail: np.ndarray, head: np.ndarray, pos: np.ndarray,
     pst_out = np.empty(n, dtype=np.uint32)
     pre_out = np.empty(n, dtype=np.uint32) if compute_pre else None
     pre_ptr = pre_out.ctypes.data_as(ctypes.c_void_p) if compute_pre else None
-    rc = lib.sheep_build_forest_edges(tail, head, len(tail), pos, len(pos),
-                                      n, parent, pst_out, pre_ptr)
+    with _obs.span("native.build_forest_edges", records=len(tail), n=n):
+        rc = lib.sheep_build_forest_edges(tail, head, len(tail), pos,
+                                          len(pos), n, parent, pst_out,
+                                          pre_ptr)
     if rc != 0:
         raise RuntimeError(f"sheep_build_forest_edges failed rc={rc}")
     if compute_pre:
@@ -273,8 +280,9 @@ def forward_partition(parent: np.ndarray, weights: np.ndarray,
     parent = np.ascontiguousarray(parent, dtype=np.uint32)
     weights = np.ascontiguousarray(weights, dtype=np.int64)
     parts = np.empty(len(parent), dtype=np.int32)
-    rc = lib.sheep_forward_partition(parent, weights, len(parent),
-                                     max_component, parts)
+    with _obs.span("native.forward_partition", n=len(parent)):
+        rc = lib.sheep_forward_partition(parent, weights, len(parent),
+                                         max_component, parts)
     if rc == -2:
         raise ValueError(
             f"max_component {max_component} smaller than the heaviest node; "
@@ -315,7 +323,9 @@ def degree_histogram_acc(tail: np.ndarray, head: np.ndarray,
     tail = np.ascontiguousarray(tail, dtype=np.uint32)
     head = np.ascontiguousarray(head, dtype=np.uint32)
     assert deg.dtype == np.int64 and deg.flags["C_CONTIGUOUS"]
-    rc = lib.sheep_degree_histogram_acc(tail, head, len(tail), len(deg), deg)
+    with _obs.span("native.degree_histogram_acc", records=len(tail)):
+        rc = lib.sheep_degree_histogram_acc(tail, head, len(tail),
+                                            len(deg), deg)
     if rc == -3:
         raise ValueError(
             f"corrupt edge records: a vid is out of range for n={len(deg)}")
@@ -399,7 +409,8 @@ def degree_sequence_from_edges(tail: np.ndarray, head: np.ndarray,
     tail = np.ascontiguousarray(tail, dtype=np.uint32)
     head = np.ascontiguousarray(head, dtype=np.uint32)
     seq = np.empty(n, dtype=np.uint32)
-    k = lib.sheep_degree_sequence_edges(tail, head, len(tail), n, seq)
+    with _obs.span("native.degree_sequence_edges", records=len(tail)):
+        k = lib.sheep_degree_sequence_edges(tail, head, len(tail), n, seq)
     if k == -3:
         raise ValueError(
             f"corrupt edge records: a vid is out of range for n={n}")
@@ -421,7 +432,8 @@ def degree_sequence_from_degrees(deg: np.ndarray) -> np.ndarray | None:
     lib = _load()
     assert lib is not None
     seq = np.empty(len(deg), dtype=np.uint32)
-    k = lib.sheep_degree_sequence(deg, len(deg), seq)
+    with _obs.span("native.degree_sequence", n=len(deg)):
+        k = lib.sheep_degree_sequence(deg, len(deg), seq)
     return seq[:k].copy()
 
 

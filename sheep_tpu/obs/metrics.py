@@ -1,0 +1,232 @@
+"""Counters, gauges, and fixed-bucket histograms — no dependencies.
+
+The serve daemon's quantitative face (ISSUE 10): every request verb gets
+a counter and a latency histogram, replication lag and applied seqno are
+gauges, and the whole registry renders as Prometheus text exposition
+format over the ``METRICS`` verb (serve/daemon.py) so any standard
+scraper — or ``nc`` — can read it.  ``STATS`` derives its per-verb
+counts and p50/p99 from the same registry, so the wire summary and the
+scrape can never disagree.
+
+Deliberately tiny: fixed bucket boundaries (quantiles are bucket
+upper-bound estimates, which is what Prometheus itself gives you),
+label support limited to one flat label set per child, a single lock
+per registry.  Each daemon owns its own :class:`Registry` so in-process
+test clusters do not share counters.
+"""
+
+from __future__ import annotations
+
+import threading
+
+#: request-latency bucket upper bounds in seconds (powers-of-~2.5 from
+#: 100us to 10s; +Inf is implicit)
+DEFAULT_BUCKETS = (0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01,
+                   0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0)
+
+
+def _label_str(labels: dict) -> str:
+    if not labels:
+        return ""
+    inner = ",".join(f'{k}="{v}"' for k, v in sorted(labels.items()))
+    return "{" + inner + "}"
+
+
+class Counter:
+    """Monotone counter; ``labels(**kv)`` returns the child for one
+    label set (created on first use)."""
+
+    kind = "counter"
+
+    def __init__(self, name: str, help: str = "", _lock=None):
+        self.name = name
+        self.help = help
+        self._lock = _lock or threading.Lock()
+        self.value = 0.0
+        self._children: dict[tuple, Counter] = {}
+
+    def labels(self, **kv) -> "Counter":
+        key = tuple(sorted(kv.items()))
+        with self._lock:
+            child = self._children.get(key)
+            if child is None:
+                child = Counter(self.name, self.help, _lock=self._lock)
+                self._children[key] = child
+        return child
+
+    def inc(self, n: float = 1.0) -> None:
+        with self._lock:
+            self.value += n
+
+    def _render(self, out: list) -> None:
+        out.append(f"# HELP {self.name} {self.help}")
+        out.append(f"# TYPE {self.name} {self.kind}")
+        if self._children:
+            for key, child in sorted(self._children.items()):
+                out.append(f"{self.name}{_label_str(dict(key))} "
+                           f"{_num(child.value)}")
+        else:
+            out.append(f"{self.name} {_num(self.value)}")
+
+    def snapshot(self) -> dict:
+        """{label-tuple-or-(): value} for STATS derivation."""
+        with self._lock:
+            if self._children:
+                return {k: c.value for k, c in self._children.items()}
+            return {(): self.value}
+
+    def children(self) -> dict:
+        """{label-tuple: child} — how STATS walks the per-verb series."""
+        with self._lock:
+            return dict(self._children)
+
+
+class Gauge(Counter):
+    kind = "gauge"
+
+    def set(self, v: float) -> None:
+        with self._lock:
+            self.value = float(v)
+
+    def labels(self, **kv) -> "Gauge":
+        key = tuple(sorted(kv.items()))
+        with self._lock:
+            child = self._children.get(key)
+            if child is None:
+                child = Gauge(self.name, self.help, _lock=self._lock)
+                self._children[key] = child
+        return child
+
+
+class Histogram:
+    """Fixed-bucket latency histogram.  ``observe(seconds)``;
+    ``quantile(q)`` returns the upper bound of the bucket holding the
+    q-th observation (the standard bucket-estimate; exact enough for
+    p50/p99 alerting, cheap enough for the request path)."""
+
+    kind = "histogram"
+
+    def __init__(self, name: str, help: str = "",
+                 buckets: tuple = DEFAULT_BUCKETS, _lock=None):
+        self.name = name
+        self.help = help
+        self.buckets = tuple(buckets)
+        self._lock = _lock or threading.Lock()
+        self.counts = [0] * (len(self.buckets) + 1)  # +1 = +Inf
+        self.sum = 0.0
+        self.count = 0
+        self._children: dict[tuple, Histogram] = {}
+
+    def labels(self, **kv) -> "Histogram":
+        key = tuple(sorted(kv.items()))
+        with self._lock:
+            child = self._children.get(key)
+            if child is None:
+                child = Histogram(self.name, self.help, self.buckets,
+                                  _lock=self._lock)
+                self._children[key] = child
+        return child
+
+    def children(self) -> dict:
+        """{label-tuple: child} — how STATS walks the per-verb series."""
+        with self._lock:
+            return dict(self._children)
+
+    def observe(self, v: float) -> None:
+        i = 0
+        for i, ub in enumerate(self.buckets):
+            if v <= ub:
+                break
+        else:
+            i = len(self.buckets)
+        with self._lock:
+            self.counts[i] += 1
+            self.sum += v
+            self.count += 1
+
+    def quantile(self, q: float) -> float:
+        """Upper-bound estimate of the q-quantile in seconds (0.0 when
+        empty; the last finite bucket bound when q lands in +Inf)."""
+        with self._lock:
+            total = self.count
+            if total == 0:
+                return 0.0
+            want = max(1, int(q * total + 0.999999))
+            cum = 0
+            for i, c in enumerate(self.counts):
+                cum += c
+                if cum >= want:
+                    return self.buckets[i] if i < len(self.buckets) \
+                        else self.buckets[-1]
+        return self.buckets[-1]
+
+    def _render_one(self, out: list, labels: dict) -> None:
+        cum = 0
+        for ub, c in zip(self.buckets, self.counts):
+            cum += c
+            lb = dict(labels)
+            lb["le"] = _num(ub)
+            out.append(f"{self.name}_bucket{_label_str(lb)} {cum}")
+        lb = dict(labels)
+        lb["le"] = "+Inf"
+        out.append(f"{self.name}_bucket{_label_str(lb)} {self.count}")
+        out.append(f"{self.name}_sum{_label_str(labels)} "
+                   f"{_num(round(self.sum, 9))}")
+        out.append(f"{self.name}_count{_label_str(labels)} {self.count}")
+
+    def _render(self, out: list) -> None:
+        out.append(f"# HELP {self.name} {self.help}")
+        out.append(f"# TYPE {self.name} {self.kind}")
+        if self._children:
+            for key, child in sorted(self._children.items()):
+                child._render_one(out, dict(key))
+        else:
+            self._render_one(out, {})
+
+
+def _num(v) -> str:
+    f = float(v)
+    if f == int(f) and abs(f) < 1e15:
+        return str(int(f))
+    return repr(f)
+
+
+class Registry:
+    """Named metrics, one namespace; ``render()`` is the scrape body."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._metrics: dict[str, object] = {}
+
+    def _get(self, name: str, factory):
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = self._metrics[name] = factory()
+            return m
+
+    def counter(self, name: str, help: str = "") -> Counter:
+        m = self._get(name, lambda: Counter(name, help))
+        assert isinstance(m, Counter) and m.kind == "counter", name
+        return m
+
+    def gauge(self, name: str, help: str = "") -> Gauge:
+        m = self._get(name, lambda: Gauge(name, help))
+        assert isinstance(m, Gauge), name
+        return m
+
+    def histogram(self, name: str, help: str = "",
+                  buckets: tuple = DEFAULT_BUCKETS) -> Histogram:
+        m = self._get(name, lambda: Histogram(name, help, buckets))
+        assert isinstance(m, Histogram), name
+        return m
+
+    def render(self) -> str:
+        """Prometheus text exposition format; always newline-terminated
+        (the METRICS verb's ``bytes=`` count includes it)."""
+        out: list[str] = []
+        with self._lock:
+            metrics = sorted(self._metrics.items())
+        for _, m in metrics:
+            m._render(out)  # type: ignore[attr-defined]
+        return "\n".join(out) + "\n"

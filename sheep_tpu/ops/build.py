@@ -19,6 +19,7 @@ import jax.numpy as jnp
 
 from .. import INVALID_JNID
 from ..core.forest import Forest
+from ..obs import trace as obs
 from .forest import forest_fixpoint, pst_weights
 from .sort import degree_histogram, degree_order, edge_links
 
@@ -219,7 +220,8 @@ def build_graph_hybrid(tail: np.ndarray, head: np.ndarray,
         # masked out — the streamed multiset stays intact and the fold
         # may count pst itself (acc_ok).
         from ..core.sequence import degree_sequence
-        seq = degree_sequence(host_edges[0], host_edges[1], n)
+        with obs.span("prep.seq", n=n):
+            seq = degree_sequence(host_edges[0], host_edges[1], n)
         acc_ok = True
     if seq is not None:
         # `-s` fast path: no histogram, no device sort — links map through
@@ -227,8 +229,9 @@ def build_graph_hybrid(tail: np.ndarray, head: np.ndarray,
         # ops.sort.given_seq_links, shared with the mesh builders)
         from .sort import given_seq_links
         given_seq = np.asarray(seq, dtype=np.uint32)
-        lo, hi, pst = given_seq_links(tail, head, given_seq, n,
-                                      with_pst=host_edges is None)
+        with obs.span("prep.map", n=n):
+            lo, hi, pst = given_seq_links(tail, head, given_seq, n,
+                                          with_pst=host_edges is None)
         m = len(given_seq)
         dev_seq = None
         if pst is None:
@@ -243,10 +246,11 @@ def build_graph_hybrid(tail: np.ndarray, head: np.ndarray,
         # the streaming fold will count pst in its own read pass (the
         # immediate-handoff platforms).  Keep the original lo handle so
         # the rare fallback can still materialize pst on device.
-        dev_seq, _, m, lo, hi, pst = prepare_links(
-            jnp.asarray(tail), jnp.asarray(head), n,
-            with_pst=host_edges is None
-            and not (stream_handoff_enabled() and handoff_input_ok()))
+        with obs.span("prep.device", n=n):
+            dev_seq, _, m, lo, hi, pst = prepare_links(
+                jnp.asarray(tail), jnp.asarray(head), n,
+                with_pst=host_edges is None
+                and not (stream_handoff_enabled() and handoff_input_ok()))
         # full-graph prep: every vid holds a position, so the link
         # multiset carries no maskable pst-only records — the streaming
         # fold may accumulate pst when the loop skips straight to handoff
@@ -280,8 +284,9 @@ def build_graph_hybrid(tail: np.ndarray, head: np.ndarray,
             try:
                 if host_edges is not None:
                     t_np, h_np = host_edges
-                    fetched["seq"], fetched["pst"] = _host_seq_pst(
-                        t_np, h_np, n, seq=given_seq)
+                    with obs.span("prep.host", n=n):
+                        fetched["seq"], fetched["pst"] = _host_seq_pst(
+                            t_np, h_np, n, seq=given_seq)
                     # host seq is already trimmed to the m active slots,
                     # so its length replaces the device scalar fetch
                     # (~70ms tunneled)
@@ -449,7 +454,9 @@ class _StreamFetcher:
                                 width // self.slice_len)
         self.done_slices = 0
         self.failed = False
-        self.busy_s = 0.0  # thread time actually spent fetching slices
+        #: per-slice fetch seconds (obs.trace.timed — the one timing
+        #: path); ``busy_s`` below is the derived view
+        self._slice_s: list = []
         self._abort = False
         self._slices: list = []
         # one elementwise pack over the padded width: pow2 shapes only,
@@ -471,25 +478,31 @@ class _StreamFetcher:
     def _on_slice(self) -> None:
         pass
 
+    @property
+    def busy_s(self) -> float:
+        """Thread time actually spent fetching slices (the overlap
+        accounting's ``serialized`` fetch term)."""
+        return sum(self._slice_s)
+
     def _run(self) -> None:
         try:
             for i in range(self.total_slices):
                 self._wait_turn(i)
                 if self._abort:
                     return
-                t0 = time.perf_counter()
                 start = i * self.slice_len
-                if self.packed:
-                    self._slices.append(
-                        np.asarray(_slice_rows(self._dev, start,
-                                               self.slice_len)))
-                else:
-                    lo_d, hi_d = self._dev
-                    self._slices.append(
-                        (np.asarray(_slice_rows(lo_d, start, self.slice_len)),
-                         np.asarray(_slice_rows(hi_d, start,
-                                                self.slice_len))))
-                self.busy_s += time.perf_counter() - t0
+                with obs.timed("fetch.slice", out=self._slice_s, slice=i):
+                    if self.packed:
+                        self._slices.append(
+                            np.asarray(_slice_rows(self._dev, start,
+                                                   self.slice_len)))
+                    else:
+                        lo_d, hi_d = self._dev
+                        self._slices.append(
+                            (np.asarray(_slice_rows(lo_d, start,
+                                                    self.slice_len)),
+                             np.asarray(_slice_rows(hi_d, start,
+                                                    self.slice_len))))
                 self.done_slices = i + 1
                 self._on_slice()
         except Exception:
@@ -766,17 +779,18 @@ def _stream_tail(lo, hi, live: int, n: int, pst_h, accumulate: bool,
         if not accumulate:
             pst_arr = _as_u32(pst_h() if callable(pst_h) else pst_h)
         fold = links_fold(n, pst_arr)
-        for _ in range(w):
-            t0 = time.perf_counter()
-            wlo, whi = next(it)
-            keep = wlo < n
-            if not keep.all():
-                wlo, whi = wlo[keep], whi[keep]
-            t1 = time.perf_counter()
-            fold.block(_as_u32(wlo), _as_u32(whi))
-            t2 = time.perf_counter()
-            fetch_s.append(round(t1 - t0, 4))
-            fold_s.append(round(t2 - t1, 4))
+        # one accumulation path for the fetch/fold pairs (obs.trace.timed
+        # — spans when SHEEP_TRACE is on, the same measured series either
+        # way); the perf keys below are derived views of these lists
+        for k in range(w):
+            with obs.timed("handoff.fetch", out=fetch_s, window=k):
+                wlo, whi = next(it)
+                keep = wlo < n
+                if not keep.all():
+                    wlo, whi = wlo[keep], whi[keep]
+            with obs.timed("handoff.fold", out=fold_s, window=k,
+                           links=len(wlo)):
+                fold.block(_as_u32(wlo), _as_u32(whi))
             links_folded += len(wlo)
         parent, pst_out = fold.finish()
     except Exception as exc:
@@ -788,17 +802,14 @@ def _stream_tail(lo, hi, live: int, n: int, pst_h, accumulate: bool,
     if perf is not None:
         wall = time.perf_counter() - t_start
         fetch_busy = stream.busy_s if stream is not None else sum(fetch_s)
-        serialized = fetch_busy + sum(fold_s)
-        overlap_s = max(0.0, serialized - wall)
         perf.update({
             "stream_mode": "windowed",
             "fetch_windows": w,
-            "window_fetch_s": fetch_s,
-            "window_fold_s": fold_s,
+            "window_fetch_s": [round(x, 4) for x in fetch_s],
+            "window_fold_s": [round(x, 4) for x in fold_s],
             "fold_s": round(sum(fold_s), 4),
-            "overlap_s": round(overlap_s, 4),
-            "overlap_frac": round(overlap_s / serialized, 4)
-            if serialized > 0 else 0.0,
+            # THE shared overlap accounting (obs.trace.overlap_stats)
+            **obs.overlap_stats(fetch_busy + sum(fold_s), wall),
             "handoff_links": links_folded,
             "packed_handoff": stream.packed if stream is not None
             else False,
@@ -993,9 +1004,10 @@ def reduce_and_fetch_links(lo, hi, n: int, stop_live: int,
 
     spec = _SpecHandoff.maybe(n)
     t0 = time.perf_counter()
-    lo, hi, live, rounds, converged = reduce_links_hosted(
-        lo, hi, n, stop_live=stop_live, handoff_input=handoff_input,
-        watch=spec.on_chunk if spec is not None else None)
+    with obs.span("reduce.loop", stop_live=stop_live):
+        lo, hi, live, rounds, converged = reduce_links_hosted(
+            lo, hi, n, stop_live=stop_live, handoff_input=handoff_input,
+            watch=spec.on_chunk if spec is not None else None)
     t1 = time.perf_counter()
     if converged:
         if spec is not None:
@@ -1006,10 +1018,12 @@ def reduce_and_fetch_links(lo, hi, n: int, stop_live: int,
             if spec is not None:
                 perf.update(spec.stats)
         return "device", lo, hi, int(live), rounds
-    if spec is not None:
-        lo_h, hi_h = spec.complete(lo, hi, int(live))
-    else:
-        lo_h, hi_h, _ = fetch_links_host(lo, hi, int(live), n)
+    with obs.span("handoff.fetch", live=int(live),
+                  spec=spec is not None):
+        if spec is not None:
+            lo_h, hi_h = spec.complete(lo, hi, int(live))
+        else:
+            lo_h, hi_h, _ = fetch_links_host(lo, hi, int(live), n)
     if perf is not None:
         perf["loop_s"] = round(t1 - t0, 4)
         perf["fetch_tail_s"] = round(time.perf_counter() - t1, 4)
@@ -1068,7 +1082,8 @@ def reduce_and_finish_native(lo, hi, n: int, stop_live: int,
         if kind == "device":
             return "device", a, b, live, rounds
         t0 = time.perf_counter()
-        parent, pst = finish_native_host(a, b, n, pst_h)
+        with obs.span("handoff.fold", links=len(a)):
+            parent, pst = finish_native_host(a, b, n, pst_h)
         if perf is not None:
             # serial tail accounting mirrors the streamed one: the fold
             # is part of the handoff bill either way
@@ -1081,9 +1096,10 @@ def reduce_and_finish_native(lo, hi, n: int, stop_live: int,
     # handoff_sort=False: the streaming tail feeds the cache-blocked
     # kernel (raw order reads faster than the sort costs) or sorts by hi
     # itself for the window slices — either way _sorted_once is waste
-    lo, hi, live, rounds, converged = reduce_links_hosted(
-        lo, hi, n, stop_live=stop_live, handoff_input=handoff_input,
-        handoff_sort=False)
+    with obs.span("reduce.loop", stop_live=stop_live):
+        lo, hi, live, rounds, converged = reduce_links_hosted(
+            lo, hi, n, stop_live=stop_live, handoff_input=handoff_input,
+            handoff_sort=False)
     t1 = time.perf_counter()
     if perf is not None:
         perf["loop_s"] = round(t1 - t0, 4)
@@ -1099,12 +1115,14 @@ def reduce_and_finish_native(lo, hi, n: int, stop_live: int,
         # alive) + monolithic fold — bit-identical, just unoverlapped.
         # ``accumulate`` holds for the serial fold too (same multiset),
         # so pst_in=None lets the kernel count pst exactly as planned.
-        lo_h, hi_h, packed = fetch_links_host(lo, hi, int(live), n)
+        with obs.span("handoff.fetch", live=int(live), fallback=True):
+            lo_h, hi_h, packed = fetch_links_host(lo, hi, int(live), n)
         if perf is not None:
             perf["handoff_links"] = int(len(lo_h))
             perf["packed_handoff"] = packed
-        out = finish_native_host(lo_h, hi_h, n,
-                                 None if accumulate else pst_h)
+        with obs.span("handoff.fold", links=len(lo_h), fallback=True):
+            out = finish_native_host(lo_h, hi_h, n,
+                                     None if accumulate else pst_h)
     parent, pst = out
     if perf is not None:
         perf["fetch_tail_s"] = round(time.perf_counter() - t1, 4)

@@ -74,6 +74,7 @@ from ..integrity.errors import IntegrityError
 from ..integrity.sidecar import resolve_policy
 from ..io.edges import iter_dat_blocks
 from ..io.prefetch import BlockPrefetcher
+from ..obs import trace as obs
 from ..resources.governor import (EXT_PREFETCH, ResourceGovernor,
                                   ext_block_edges, ext_strategy_costs)
 from ..runtime.faults import fault_point
@@ -138,36 +139,39 @@ def streaming_degree_sequence(path: str, block_edges: int | None = None,
     policy = RetryPolicy(max_retries=max_retries,
                          backoff_base_s=backoff_base_s)
     attempt = 0
-    while True:
-        pf = BlockPrefetcher(
-            iter_dat_blocks(path, block, start_edge=done * block),
-            depth=EXT_PREFETCH)
-        try:
-            with pf:
-                for tail, head in pf:
-                    records += len(tail)
-                    mx = int(max(tail.max(initial=0),
-                                 head.max(initial=0)))
-                    max_vid = max(max_vid, mx)
-                    if mx >= len(deg):
-                        deg = np.concatenate(
-                            [deg,
-                             np.zeros(mx + 1 - len(deg), dtype=np.int64)])
-                    if native is not None:
-                        native.degree_histogram_acc(tail, head, deg)
-                    else:
-                        deg += np.bincount(tail, minlength=len(deg))
-                        deg += np.bincount(head, minlength=len(deg))
-                    done += 1
-            read_s += pf.busy_s
-            break
-        except OSError:
-            read_s += pf.busy_s
-            if attempt >= policy.max_retries:
-                raise
-            policy.sleep(policy.backoff(attempt))
-            attempt += 1
-    seq = degree_sequence_from_degrees(deg)
+    with obs.span("ext.seq", block_edges=block) as sp:
+        while True:
+            pf = BlockPrefetcher(
+                iter_dat_blocks(path, block, start_edge=done * block),
+                depth=EXT_PREFETCH, trace_name="ext.seq.read")
+            try:
+                with pf:
+                    for tail, head in pf:
+                        records += len(tail)
+                        mx = int(max(tail.max(initial=0),
+                                     head.max(initial=0)))
+                        max_vid = max(max_vid, mx)
+                        if mx >= len(deg):
+                            deg = np.concatenate(
+                                [deg,
+                                 np.zeros(mx + 1 - len(deg),
+                                          dtype=np.int64)])
+                        if native is not None:
+                            native.degree_histogram_acc(tail, head, deg)
+                        else:
+                            deg += np.bincount(tail, minlength=len(deg))
+                            deg += np.bincount(head, minlength=len(deg))
+                        done += 1
+                read_s += pf.busy_s
+                break
+            except OSError:
+                read_s += pf.busy_s
+                if attempt >= policy.max_retries:
+                    raise
+                policy.sleep(policy.backoff(attempt))
+                attempt += 1
+        sp.annotate(records=records, retries=attempt)
+        seq = degree_sequence_from_degrees(deg)
     if perf is not None:
         perf["seq_s"] = round(time.perf_counter() - t0, 4)
         perf["seq_read_s"] = round(read_s, 4)
@@ -324,7 +328,10 @@ def build_forest_extmem(path: str, block_edges: int | None = None,
             events.append(("ext-resume", done))
     policy = RetryPolicy(max_retries=max_retries,
                          backoff_base_s=backoff_base_s)
-    stats = {"read_s": 0.0, "fold_s": 0.0, "stream_s": 0.0}
+    # fold_series accumulates through obs.trace.timed (one code path
+    # with the windowed handoff); read_s is the prefetcher's producer
+    # busy time, itself accumulated through the same helper
+    stats = {"read_s": 0.0, "fold_series": [], "stream_s": 0.0}
     # progress is shared mutably with the attempt: on a mid-stream fault
     # the blocks folded BEFORE it must survive into the retry, or the
     # re-opened stream would refold them (parent is idempotent under a
@@ -353,16 +360,16 @@ def build_forest_extmem(path: str, block_edges: int | None = None,
         ckpt.clear()
     if perf is not None:
         wall = time.perf_counter() - t_start
-        serialized = stats["read_s"] + stats["fold_s"]
-        overlap = max(0.0, serialized - stats["stream_s"])
+        fold_s = sum(stats["fold_series"])
         perf.update({
             "ext_blocks": done,
             "block_edges": block,
             "read_s": round(stats["read_s"], 4),
-            "fold_s": round(stats["fold_s"], 4),
-            "overlap_s": round(overlap, 4),
-            "overlap_frac": round(overlap / serialized, 4)
-            if serialized > 0 else 0.0,
+            "fold_s": round(fold_s, 4),
+            # THE shared overlap accounting (obs.trace.overlap_stats):
+            # read+fold serialized vs the stream's realized wall
+            **obs.overlap_stats(stats["read_s"] + fold_s,
+                                stats["stream_s"]),
             "wall_s": round(wall, 4),
             "strategies": dict(fold.strategies),
             "retries": attempt,
@@ -381,12 +388,14 @@ def _stream_fold(path: str, block: int, seq: np.ndarray, sig: str,
     producer thread re-raises them typed at the consumption point."""
     t0 = time.perf_counter()
     it = iter_dat_blocks(path, block, start_edge=progress["done"] * block)
-    with BlockPrefetcher(it, depth=EXT_PREFETCH) as pf:
+    with obs.span("ext.stream", start_block=progress["done"]), \
+            BlockPrefetcher(it, depth=EXT_PREFETCH,
+                            trace_name="ext.read") as pf:
         try:
             for tail, head in pf:
-                t1 = time.perf_counter()
-                strat = fold.fold_block(tail, head)
-                stats["fold_s"] += time.perf_counter() - t1
+                with obs.timed("ext.fold", out=stats["fold_series"],
+                               block=progress["done"], records=len(tail)):
+                    strat = fold.fold_block(tail, head)
                 done = progress["done"] = progress["done"] + 1
                 events.append(("ext-block", done - 1,
                                len(fold.carry_lo), strat))

@@ -69,6 +69,7 @@ from jax import lax
 
 from .. import INVALID_JNID
 from ..core.forest import Forest
+from ..obs import trace as _obs
 
 _I32_MAX = np.int32(np.iinfo(np.int32).max)
 
@@ -906,6 +907,10 @@ def reduce_links_hosted(lo, hi, n: int, stop_live: int = 0,
         loop's return value, arrays restored to the original vertex
         space."""
         moved_i, live_i = (int(x) for x in np.asarray(stats))  # one sync
+        # flight recorder: one event per resolved chunk — the round-level
+        # record `sheep trace` rolls up (round counts from ONE code path)
+        _obs.event("reduce.chunk", live=live_i, moved=moved_i,
+                   rounds=rounds_ret)
         if moved_i == 0:
             rlo, rhi = _restore(alo, ahi)
             return (rlo, rhi, live_i, rounds_ret, True), live_i, moved_i

@@ -539,6 +539,11 @@ def reduce_links_sharded(lo, hi, n: int, mesh, global_f: bool,
             comm["sharded_global_rounds"] += j
             comm["pmin_payload_bytes"] += j * 4 * (n + 1)
         moved_i, live_i = (int(x) for x in fetch(stats))  # one sync
+        # flight recorder: the mesh loop's per-chunk record (same shape
+        # as the hosted loop's "reduce.chunk" — one rollup code path)
+        from ..obs import trace as _obs
+        _obs.event("reduce.chunk", live=live_i, moved=moved_i,
+                   rounds=rounds, mesh=True)
         if moved_i == 0:
             return lo, hi, rounds, False
         if max_rounds is not None and rounds >= max_rounds:

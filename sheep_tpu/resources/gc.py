@@ -40,6 +40,19 @@ def is_orphan_temp(name: str) -> bool:
     return bool(_TMP_RE.match(name))
 
 
+def is_live_temp(name: str, live_bases) -> bool:
+    """Is this dot-temp a LIVE writer's in-flight file?  ``live_bases``
+    are the final basenames concurrent writers are currently producing
+    (io/atomic.py names their temps ``.{base}.{random}.tmp``).  The
+    "orphan temps are debris" assumption only holds when nothing is
+    writing — a mid-run sweep (the supervisor's ENOSPC recovery, with
+    sibling attempts still in flight IN PROCESS) must leave these alone
+    or it unlinks a healthy attempt's rename source out from under it."""
+    if not _TMP_RE.match(name):
+        return False
+    return any(name.startswith(f".{b}.") for b in live_bases)
+
+
 def _candidates(directory: str, protect: set[str]) -> list[tuple]:
     """(mtime, size, path, is_temp) of every reclaimable file directly
     under ``directory`` (non-recursive: managed dirs are flat; a
@@ -74,18 +87,22 @@ def _candidates(directory: str, protect: set[str]) -> list[tuple]:
     return out
 
 
-def gc_orphan_temps(directory: str) -> list[str]:
-    """Remove every orphaned atomic-write temp under ``directory``.
-    A temp under the dot-name is by construction unpublished debris from
-    a killed or faulted writer — no reader ever opens one — so this is
-    safe at any time and runs at every resume entry point."""
+def gc_orphan_temps(directory: str, live_bases=()) -> list[str]:
+    """Remove orphaned atomic-write temps under ``directory``.  A temp
+    under the dot-name is unpublished debris from a killed or faulted
+    writer — no reader ever opens one — EXCEPT the in-flight temps of
+    writers that are still running: mid-run callers (the supervisor's
+    leg-failure sweep, with sibling attempts live in process) pass the
+    final basenames those writers are producing as ``live_bases`` so
+    their rename sources survive (:func:`is_live_temp`).  Resume entry
+    points have no concurrent writers and pass nothing."""
     removed = []
     try:
         names = os.listdir(directory)
     except OSError:
         return removed
     for name in names:
-        if is_orphan_temp(name):
+        if is_orphan_temp(name) and not is_live_temp(name, live_bases):
             path = os.path.join(directory, name)
             try:
                 os.unlink(path)
@@ -96,19 +113,23 @@ def gc_orphan_temps(directory: str) -> list[str]:
 
 
 def retention_gc(directory: str, protect=(), keep_last: int = 1,
-                 need: int = 0) -> tuple[int, list[str]]:
+                 need: int = 0, live_bases=()) -> tuple[int, list[str]]:
     """Reclaim at least ``need`` bytes from ``directory`` (0 = reclaim
     every eligible candidate) under the module-docstring policy.
 
     ``protect``: paths a resume still needs — never touched.
     ``keep_last``: newest unprotected non-temp survivors.
+    ``live_bases``: final basenames of writes currently in flight —
+    their dot-temps are rename sources, not debris (:func:`is_live_temp`).
 
     Returns (bytes_freed, removed_paths).  Best-effort: an unlinkable
     candidate is skipped, not fatal (the caller's budget re-check decides
     whether enough was reclaimed).
     """
     protect_real = {os.path.realpath(p) for p in protect}
-    cands = sorted(_candidates(directory, protect_real))
+    cands = sorted(c for c in _candidates(directory, protect_real)
+                   if not is_live_temp(os.path.basename(c[2]),
+                                       live_bases))
     # keep-last-k applies to real artifacts only; orphan temps are
     # always reclaimable
     non_temp = [c for c in cands if not c[3]]

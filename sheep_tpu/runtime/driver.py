@@ -45,8 +45,9 @@ from ..core.forest import Forest, build_forest_links, edges_to_positions
 from ..core.sequence import degree_sequence
 from ..integrity.errors import IntegrityError
 from ..integrity.sidecar import resolve_policy
+from ..obs import trace as obs
 from ..resources.errors import MemoryBudgetExceeded, ResourceError
-from ..resources.governor import ResourceGovernor
+from ..resources.governor import ResourceGovernor, rss_bytes
 from .faults import (RetryBudgetExhausted, fault_point, is_retryable,
                      reset_counters)
 from .retry import RetryPolicy, run_with_retry
@@ -207,6 +208,15 @@ class ChunkRuntime:
                 and self.governor.mem_pressure():
             j = max(1, j // 2)
             self.events.append(("mem-shrink", self.rung, site, j))
+
+        inner = fn
+
+        def fn(jj, _inner=inner, _site=site, _rung=self.rung):
+            # flight-recorder span per dispatch attempt (obs/trace.py:
+            # the no-op singleton when SHEEP_TRACE is unset)
+            with obs.span("dispatch", site=_site, rung=_rung, j=jj):
+                return _inner(jj)
+
         if self._promoted:
             try:
                 fault_point(site)
@@ -526,23 +536,39 @@ def build_graph_resilient(tail, head, num_vertices=None, num_workers=None,
         # host prep: exact core semantics (deterministic, rung-neutral).
         # lo of every kept record is a present position < n; hi >= n marks
         # pst-only links (absent endpoint) excluded from the tree links.
-        lo64, hi64 = edges_to_positions(tail, head, seq_h, max_vid)
-        pst = np.bincount(lo64, minlength=n)[:n].astype(np.uint32)
-        tree = hi64 < n
-        lo = lo64[tree].astype(np.int32)
-        hi = hi64[tree].astype(np.int32)
+        with obs.span("prep", n=n, edges=len(tail)):
+            lo64, hi64 = edges_to_positions(tail, head, seq_h, max_vid)
+            pst = np.bincount(lo64, minlength=n)[:n].astype(np.uint32)
+            tree = hi64 < n
+            lo = lo64[tree].astype(np.int32)
+            hi = hi64[tree].astype(np.int32)
         rounds = 0
 
     # Memory-budget ladder planning (ISSUE 5): price each rung's peak
     # analytically and route around the ones that cannot fit the
     # headroom — degrading up-front beats OOM-ing mid-rung.  The last
     # rung (spill: O(n + block) resident) always survives.
+    priced: list[dict] = []
+    price_of: dict[str, int] = {}
     if gov.active:
         rungs, trace = gov.plan_rungs(rungs, n, len(lo),
                                       num_workers or 1)
         for rung, est, verdict in trace:
+            priced.append({"rung": rung, "est_bytes": int(est),
+                           "verdict": verdict})
+            price_of[rung] = int(est)
             if verdict == "skip":
                 events.append(("mem-skip-rung", rung, est))
+    # the rung-decision record `sheep trace` explains: the planned order,
+    # each rung's governor price + keep/skip verdict, and the measured
+    # headroom the verdicts were made against
+    obs.event("ladder.plan", rungs=list(rungs), priced=priced,
+              headroom_bytes=gov.mem_headroom() if gov.active else None,
+              rss_bytes=rss_bytes() if gov.active else None,
+              budget_bytes=gov.mem_budget if gov.active else None)
+    if snap is not None:
+        obs.event("rung.resume", rung=snap.rung, boundary=snap.boundary,
+                  rounds=rounds)
 
     parent = None
     for i, rung in enumerate(rungs):
@@ -556,7 +582,10 @@ def build_graph_resilient(tail, head, num_vertices=None, num_workers=None,
             # resumes without re-running the degree sort / link mapping
             rt.boundary(0, lambda: (lo, hi))
         try:
-            parent = _RUNGS[rung](lo, hi, n, rt, num_workers)
+            with obs.span("rung", rung=rung, links=len(lo)):
+                parent = _RUNGS[rung](lo, hi, n, rt, num_workers)
+            obs.event("rung.ok", rung=rung, rss_bytes=rss_bytes(),
+                      est_bytes=price_of.get(rung))
             break
         except Exception as exc:
             # Memory exhaustion degrades DOWN the ladder (the cheaper
@@ -571,6 +600,8 @@ def build_graph_resilient(tail, head, num_vertices=None, num_workers=None,
                 raise
             events.append(("degrade", rung, rungs[i + 1],
                            f"{type(exc).__name__}: {exc}"))
+            obs.event("rung.degrade", rung=rung, next=rungs[i + 1],
+                      why=f"{type(exc).__name__}: {exc}")
             if ckpt is not None:
                 # Pick up whatever progress the failed rung checkpointed —
                 # but REFUSE a handoff whose checkpoint fails verification

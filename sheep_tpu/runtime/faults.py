@@ -135,6 +135,8 @@ def fault_point(site: str) -> int:
     _counters[site] = index + 1
     plan = active_plan()
     if plan is not None and plan.matches(site, index):
+        from ..obs import trace as obs
+        obs.event("fault", site=site, index=index, kind=plan.kind)
         plan.raise_fault(site, index)
     return index
 

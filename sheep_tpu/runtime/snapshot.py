@@ -218,10 +218,14 @@ class Checkpointer:
         self.boundary += 1
         snap.validate()
         est = self.preflight(snap.n, len(snap.lo))
+        from ..obs import trace as obs
         # The npz writer seeks (zip local headers), so the sidecar sums
         # the sealed temp by read-back (sealed_write) — sidecar first,
         # artifact second, like every publish in the system.
-        with sealed_write(self.path, "wb", expect_bytes=est) as f:
+        with obs.span("checkpoint.save", rung=snap.rung,
+                      boundary=snap.boundary, rounds=snap.rounds,
+                      links=len(snap.lo)), \
+                sealed_write(self.path, "wb", expect_bytes=est) as f:
             np.savez(
                 f,
                 version=np.int64(_VERSION),

@@ -56,6 +56,7 @@ from collections import deque
 from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass, field
 
+from ..obs.metrics import Registry
 from ..resources.errors import ResourceError
 from ..supervisor.heartbeat import HeartbeatWriter, maybe_start_from_env
 from . import faults as serve_faults
@@ -173,6 +174,17 @@ class ServeDaemon:
                          "shed": 0, "timeouts": 0, "readonly": 0,
                          "errors": 0, "faults": 0, "notleader": 0,
                          "stale": 0, "repl_quorum_fails": 0}
+        # flight-recorder metrics (ISSUE 10): per-daemon registry so
+        # in-process test clusters never share counters; exported raw
+        # over the METRICS verb and summarized into STATS (per-verb
+        # counts + p50/p99 come from THIS registry, one code path)
+        self.metrics = Registry()
+        self._m_requests = self.metrics.counter(
+            "sheep_serve_requests_total", "requests by verb")
+        self._m_latency = self.metrics.histogram(
+            "sheep_serve_request_seconds", "request latency by verb")
+        self._m_errors = self.metrics.counter(
+            "sheep_serve_errors_total", "typed ERR responses by code")
         self.hub = ReplicationHub(core, send=self._send_async,
                                   close=self._abort_async,
                                   hb_s=self.cluster.hb_s,
@@ -700,6 +712,26 @@ class ServeDaemon:
     # -- request lifecycle -------------------------------------------------
 
     def _handle_request(self, text: str) -> tuple[str, bool]:
+        """One request -> (response, close?), with the registry fed:
+        per-verb request counter + latency histogram (observed whatever
+        the outcome — a shed or timed-out request is latency a client
+        saw), ERR counter by code."""
+        t0 = time.monotonic()
+        resp, close = self._handle_one(text)
+        toks = text.split(None, 2)
+        verb = toks[0].upper() if toks else "?"
+        if verb.startswith("DEADLINE=") and len(toks) > 1:
+            verb = toks[1].upper()
+        if resp.startswith("ERR badreq"):
+            verb = "BAD"  # unparseable lines don't mint verb series
+        self._m_requests.labels(verb=verb).inc()
+        self._m_latency.labels(verb=verb).observe(time.monotonic() - t0)
+        if resp.startswith("ERR "):
+            code = resp.split(None, 2)[1]
+            self._m_errors.labels(code=code).inc()
+        return resp, close
+
+    def _handle_one(self, text: str) -> tuple[str, bool]:
         """One request -> (response line, close-connection?)."""
         self.counters["requests"] += 1
         t0 = time.monotonic()
@@ -808,6 +840,8 @@ class ServeDaemon:
                 return err_line("unavailable", str(exc)), False
         if verb == "STATS":
             return self._stats_line(), False
+        if verb == "METRICS":
+            return self._metrics_response(), False
         if verb == "INSERT":
             if self.role != "leader":
                 self.counters["notleader"] += 1
@@ -844,6 +878,44 @@ class ServeDaemon:
             return ok_kv(**core.repartition()), False
         raise BadRequest(f"unhandled verb {verb!r}")  # unreachable
 
+    def _render_metrics(self) -> str:
+        """The Prometheus scrape body: refresh the gauges from live
+        state, then render the whole registry (obs/metrics.py)."""
+        m = self.metrics
+        core = self.core
+        m.gauge("sheep_serve_applied_seqno",
+                "highest WAL seqno applied").set(core.applied_seqno)
+        m.gauge("sheep_serve_epoch",
+                "replication epoch (term)").set(core.epoch)
+        m.gauge("sheep_serve_inflight",
+                "requests holding admission slots").set(
+            self.admission.inflight)
+        m.gauge("sheep_serve_uptime_seconds", "daemon uptime").set(
+            round(time.monotonic() - self.started_at, 3))
+        lag = m.gauge("sheep_serve_repl_lag_records",
+                      "replication lag: max follower lag on a leader, "
+                      "own lag on a follower")
+        if self.role == "leader":
+            lags = self.hub.lag_report()
+            lag.set(max((f["lag"] for f in lags.values()), default=0))
+            fol = m.gauge("sheep_serve_follower_lag_records",
+                          "per-follower replication lag")
+            for node, f in sorted(lags.items()):
+                fol.labels(node=node).set(f["lag"])
+        else:
+            rep = self.replicator
+            lag.set(rep.lag if rep is not None else 0)
+        return m.render()
+
+    def _metrics_response(self) -> str:
+        """``METRICS`` -> ``OK bytes=<n>`` followed by the n-byte scrape
+        body (the snapshot-transfer shape: the one-line protocol stays
+        one HEADER line, the payload is length-prefixed raw bytes).  The
+        count includes the body's final newline, which the connection
+        writer appends to every response."""
+        body = self._render_metrics()  # always newline-terminated
+        return f"OK bytes={len(body)}\n" + body[:-1]
+
     def _stats_line(self) -> str:
         rec = self.core.stats()
         rec.update(self.counters)
@@ -867,6 +939,18 @@ class ServeDaemon:
             rec["repl_lag"] = rep.lag if rep is not None else 0
             rec["leader_seqno"] = (rep.leader_seqno if rep is not None
                                    else self.core.applied_seqno)
+        # per-verb counts + latency quantiles, derived from the SAME
+        # histogram registry the METRICS scrape exports (ISSUE 10) —
+        # the wire summary and the scrape cannot disagree
+        for key, child in sorted(self._m_requests.children().items()):
+            verb = dict(key).get("verb", "?").lower()
+            rec[f"req_{verb}"] = int(child.value)
+        for key, child in sorted(self._m_latency.children().items()):
+            if not child.count:
+                continue
+            verb = dict(key).get("verb", "?").lower()
+            rec[f"p50_{verb}_ms"] = round(child.quantile(0.5) * 1000, 3)
+            rec[f"p99_{verb}_ms"] = round(child.quantile(0.99) * 1000, 3)
         return ok_kv(**rec)
 
     # -- status file (the dead-daemon face of STATS) -----------------------

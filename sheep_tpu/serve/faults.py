@@ -178,6 +178,8 @@ def fire(site: str, hang_s: float = 0.0) -> str | None:
     kind = arm(site)
     if kind is None:
         return None
+    from ..obs import trace as obs
+    obs.event("serve.fault", site=site, kind=kind)
     if kind == "kill":
         plan = _active_plan()
         if plan is not None and plan.kill_mode == "raise":

@@ -12,7 +12,14 @@ so a reader never blocks mid-response.  Requests::
     ECV                  -> OK ecv_down=<n> baseline=<n> drift_cut=<n>
                             parts=<k>
     INSERT u v [u v...]  -> OK seq=<wal seqno> applied=<k>
-    STATS                -> OK key=value ...  (role/epoch/lag included)
+    STATS                -> OK key=value ...  (role/epoch/lag, plus the
+                            per-verb req_* counts and p50_*/p99_* request
+                            latencies derived from the metrics registry)
+    METRICS              -> OK bytes=<n>, followed by <n> raw bytes of
+                            Prometheus text exposition format (counters,
+                            gauges, per-verb latency histograms —
+                            obs/metrics.py; the snapshot-transfer shape:
+                            one header line + length-prefixed payload)
     SNAPSHOT             -> OK snap=<filename>
     REPARTITION          -> OK parts=<k> baseline=<n>
     PING                 -> OK pong
@@ -75,7 +82,8 @@ import time
 from dataclasses import dataclass, field
 
 #: verbs that read state (admission kind "query")
-QUERY_VERBS = ("PART", "PARENT", "SUBTREE", "ECV", "STATS", "PING")
+QUERY_VERBS = ("PART", "PARENT", "SUBTREE", "ECV", "STATS", "METRICS",
+               "PING")
 #: verbs that mutate state (admission kind "insert", shed first)
 INSERT_VERBS = ("INSERT",)
 #: operator verbs (admitted as queries; SNAPSHOT/REPARTITION do their own
@@ -235,6 +243,20 @@ class ServeClient:
         flat = " ".join(f"{int(u)} {int(v)}" for u, v in pairs)
         out = self._ok("INSERT " + flat)
         return int(dict(f.split("=", 1) for f in out)["seq"])
+
+    def metrics(self) -> str:
+        """``METRICS`` -> the Prometheus text scrape body (the header's
+        ``bytes=`` count covers the payload including its final
+        newline)."""
+        out = self._ok("METRICS")
+        n = int(dict(f.split("=", 1) for f in out)["bytes"])
+        data = b""
+        while len(data) < n:
+            chunk = self._rf.read(n - len(data))
+            if not chunk:
+                raise ConnectionError("server closed mid-METRICS payload")
+            data += chunk
+        return data.decode("ascii")
 
     def kv(self, verb: str) -> dict:
         """STATS / ECV / REPARTITION-style key=value responses."""

@@ -57,6 +57,7 @@ from ..integrity.errors import IntegrityError, MalformedArtifact
 from ..integrity.sidecar import resolve_policy
 from ..io import faultfs
 from ..io.atomic import _typed, atomic_write
+from ..obs import trace as _obs
 from ..resources.governor import ResourceGovernor
 
 WAL_NAME = "serve.wal"
@@ -304,7 +305,10 @@ class WalAppender:
             w.write(rec)
             self._f.flush()
             if sync:
-                os.fsync(self._f.fileno())
+                # flight-recorder span (obs/trace.py): WAL fsyncs are
+                # the serve write path's dominant latency term
+                with _obs.span("wal.fsync", seqno=seqno):
+                    os.fsync(self._f.fileno())
         except OSError as exc:
             try:
                 self._f.truncate(start)
@@ -334,7 +338,8 @@ class WalAppender:
             return
         try:
             self._f.flush()
-            os.fsync(self._f.fileno())
+            with _obs.span("wal.fsync", burst=True):
+                os.fsync(self._f.fileno())
         except OSError as exc:
             typed = _typed(exc, self.path)
             if typed is not exc:

@@ -64,6 +64,60 @@ def _newest_heartbeat_age(output: str, now: float) -> float | None:
     return None if newest is None else max(0.0, now - newest)
 
 
+def newest_trace_rollup(state_dir: str) -> dict | None:
+    """Phase rollup of the newest ``*.trace`` flight-recorder file in
+    the state dir (ISSUE 10), or None when there is none.  Read in
+    repair mode — the trace of a KILLED run is exactly what an operator
+    inspecting a state dir wants to see — torn tails reported, never
+    fatal to the status view."""
+    import warnings
+    newest, newest_m = None, None
+    try:
+        for name in os.listdir(state_dir):
+            if not name.endswith(".trace"):
+                continue
+            path = os.path.join(state_dir, name)
+            try:
+                m = os.path.getmtime(path)
+            except OSError:
+                continue
+            if newest_m is None or m > newest_m:
+                newest, newest_m = path, m
+    except OSError:
+        return None
+    if newest is None:
+        return None
+    from ..obs.trace import read_trace, rollup
+    try:
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            records, _, torn = read_trace(newest, "repair")
+    except Exception:
+        return {"path": newest, "error": "unreadable"}
+    return {"path": newest, "torn": torn,
+            "age_s": round(max(0.0, time.time() - newest_m), 3),
+            "phases": rollup(records)}
+
+
+def _trace_lines(state_dir: str) -> list[str]:
+    """The human face of :func:`newest_trace_rollup` (top phases by
+    total time), empty when the dir holds no trace."""
+    roll = newest_trace_rollup(state_dir)
+    if roll is None:
+        return []
+    lines = [f"trace: {os.path.basename(roll['path'])}"
+             + (" [torn tail]" if roll.get("torn") else "")
+             + (f"  ({_fmt_age(roll.get('age_s'))} old)"
+                if roll.get("age_s") is not None else "")]
+    phases = dict(roll.get("phases") or {})
+    phases.pop("_events", None)
+    top = sorted(phases.items(), key=lambda kv: -kv[1]["total_s"])[:6]
+    for name, p in top:
+        lines.append(f"      {name:<26} x{p['count']:<5} "
+                     f"{p['total_s']:.3f}s")
+    return lines
+
+
 def status_rows(manifest: Manifest, now: float | None = None) -> list[dict]:
     """One dict per leg: key/kind/round/state/dispatches/artifact bytes
     (None = absent)/heartbeat age seconds (None = never beat)."""
@@ -118,6 +172,10 @@ def status_json(state_dir: str, integrity: str | None = None,
             "headroom_bytes": (gov.mem_budget - rss
                                if gov.mem_budget is not None else None),
         },
+        # the newest flight-recorder file's phase rollup (ISSUE 10) —
+        # what the run was DOING, next to the heartbeat ages that say
+        # whether it still is
+        "trace": newest_trace_rollup(state_dir),
     }
     return out
 
@@ -166,6 +224,7 @@ def render_status(state_dir: str, integrity: str | None = None,
         mem += f", budget {_fmt_bytes(gov.mem_budget)} " \
                f"(headroom {_fmt_bytes(gov.mem_budget - rss)})"
     lines.append(mem)
+    lines += _trace_lines(state_dir)
     if not manifest.done():
         lines.append("resume: rerun `sheep supervise <graph> -d "
                      + state_dir + "` to fsck survivors and finish")
@@ -238,6 +297,7 @@ def serve_status_json(state_dir: str) -> dict:
                     out["applied_seqno"] = snap.applied_seqno
                 except Exception:
                     pass
+    out["trace"] = newest_trace_rollup(state_dir)
     return out
 
 
@@ -256,6 +316,7 @@ def render_serve_status(state_dir: str) -> str:
         lines.append("follower lag (records):")
         for node, lag in sorted(lags.items()):
             lines.append(f"  {node}: {lag}")
+    lines += _trace_lines(state_dir)
     return "\n".join(lines) + "\n"
 
 

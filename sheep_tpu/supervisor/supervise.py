@@ -475,7 +475,8 @@ class TournamentSupervisor:
             for att in atts:
                 protect.update((att.tmp, att.hb))
         freed, removed = retention_gc(self.state_dir, protect=protect,
-                                      keep_last=0, need=max(0, deficit))
+                                      keep_last=0, need=max(0, deficit),
+                                      live_bases=self._live_temp_bases())
         if removed:
             self.events.append(("gc", len(removed), freed))
         return freed
@@ -587,14 +588,30 @@ class TournamentSupervisor:
                 return
         self._publish(att)
 
+    def _live_temp_bases(self) -> set[str]:
+        """Final basenames of every still-running attempt's output (and
+        its sidecar): their atomic-write dot-temps are live rename
+        sources a mid-run sweep must not reclaim (resources/gc.py
+        is_live_temp — the InlineRunner runs sibling legs in THIS
+        process, so a sweep after one leg's fault races their writes)."""
+        out: set[str] = set()
+        for atts in self._running.values():
+            for a in atts:
+                base = os.path.basename(a.tmp)
+                out.add(base)
+                out.add(base + ".sum")
+        return out
+
     def _failed(self, att: _Attempt, reason: str) -> None:
         leg = att.leg
         _discard(att.tmp, att.tmp + ".sum", att.hb)
         self.events.append(("leg-failed", leg.key, reason))
         # an attempt that died on a full disk leaves the condition in
         # place for its retry: sweep write debris, and reclaim retired
-        # intermediates (all re-creatable) before dispatching again
-        gc_orphan_temps(self.state_dir)
+        # intermediates (all re-creatable) before dispatching again —
+        # sparing the dot-temps sibling attempts are writing RIGHT NOW
+        gc_orphan_temps(self.state_dir,
+                        live_bases=self._live_temp_bases())
         if "ENOSPC" in reason or "No space" in reason:
             self._maybe_gc(force=True)
         self._running[leg.key] = [

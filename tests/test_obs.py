@@ -1,0 +1,515 @@
+"""Flight-recorder tests (ISSUE 10): span nesting + thread-safety, the
+disabled-mode fast path, kill-at-every-span-boundary trace readability,
+the METRICS verb grammar, fsck's ``.trace`` rules, and the trace-on vs
+trace-off build parity sweep (bit-identical tree + equal ECV(down) —
+observability must never change what it observes).
+"""
+
+import json
+import os
+import threading
+import warnings
+
+import numpy as np
+import pytest
+
+from sheep_tpu.integrity.errors import IntegrityError, MalformedArtifact
+from sheep_tpu.obs import metrics as obs_metrics
+from sheep_tpu.obs import trace as obs_trace
+
+
+@pytest.fixture(autouse=True)
+def _clean_trace_env():
+    prev = os.environ.pop(obs_trace.ENV, None)
+    obs_trace.close_recorder()
+    yield
+    obs_trace.close_recorder()
+    if prev is None:
+        os.environ.pop(obs_trace.ENV, None)
+    else:
+        os.environ[obs_trace.ENV] = prev
+
+
+def _enable(tmp_path, name="run.trace"):
+    path = str(tmp_path / name)
+    os.environ[obs_trace.ENV] = path
+    return path
+
+
+def _finish():
+    obs_trace.close_recorder()
+    os.environ.pop(obs_trace.ENV, None)
+
+
+# -- span layer ------------------------------------------------------------
+
+
+def test_disabled_fast_path_is_noop_singleton():
+    assert not obs_trace.enabled()
+    s1 = obs_trace.span("a", x=1)
+    s2 = obs_trace.span("b")
+    # identity-stable: the disabled path allocates no span object
+    assert s1 is s2 is obs_trace.NOOP_SPAN
+    with s1:
+        s1.annotate(y=2)  # all no-ops
+    obs_trace.event("nothing", z=3)
+    obs_trace.annotate(w=4)
+    assert obs_trace.trace_summary() is None
+
+
+def test_span_nesting_ids(tmp_path):
+    path = _enable(tmp_path)
+    with obs_trace.span("outer", a=1):
+        with obs_trace.span("mid"):
+            with obs_trace.span("leaf"):
+                pass
+        obs_trace.event("marker", hit=True)
+    _finish()
+    records, _, torn = obs_trace.read_trace(path, "strict")
+    assert not torn
+    by_name = {r["name"]: r for r in records if r.get("k") == "span"}
+    outer, mid, leaf = by_name["outer"], by_name["mid"], by_name["leaf"]
+    assert outer["par"] is None
+    assert mid["par"] == outer["id"]
+    assert leaf["par"] == mid["id"]
+    # spans land at exit: children precede parents in the file
+    names = [r["name"] for r in records if r.get("k") == "span"]
+    assert names == ["leaf", "mid", "outer"]
+    ev = [r for r in records if r.get("k") == "ev"][0]
+    assert ev["name"] == "marker" and ev["par"] == outer["id"]
+    assert outer["a"] == {"a": 1}
+    # durations nest: the parent covers its children
+    assert outer["dur"] >= mid["dur"] >= leaf["dur"] >= 0.0
+
+
+def test_annotate_reaches_innermost_span(tmp_path):
+    path = _enable(tmp_path)
+    with obs_trace.span("outer"):
+        with obs_trace.span("inner") as sp:
+            sp.annotate(k=7)
+            obs_trace.annotate(via_module=True)
+    _finish()
+    records, _, _ = obs_trace.read_trace(path, "strict")
+    inner = [r for r in records if r.get("name") == "inner"][0]
+    assert inner["a"] == {"k": 7, "via_module": True}
+
+
+def test_span_thread_safety(tmp_path):
+    path = _enable(tmp_path)
+    n_threads, per = 8, 25
+
+    def worker(i):
+        for k in range(per):
+            with obs_trace.span("outer", i=i, k=k):
+                with obs_trace.span("inner", i=i, k=k):
+                    pass
+
+    threads = [threading.Thread(target=worker, args=(i,))
+               for i in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    _finish()
+    records, _, torn = obs_trace.read_trace(path, "strict")
+    assert not torn
+    spans = [r for r in records if r.get("k") == "span"]
+    outers = {r["id"]: r for r in spans if r["name"] == "outer"}
+    inners = [r for r in spans if r["name"] == "inner"]
+    assert len(outers) == n_threads * per and len(inners) == len(outers)
+    # every inner's parent is the outer of the SAME (i, k) — interleaved
+    # threads never cross-link their stacks
+    for r in inners:
+        parent = outers[r["par"]]
+        assert parent["a"] == r["a"]
+        assert parent["tid"] == r["tid"]
+    # ids are unique across threads
+    ids = [r["id"] for r in spans]
+    assert len(set(ids)) == len(ids)
+
+
+def test_timed_accumulates_without_tracing():
+    out = []
+    with obs_trace.timed("phase", out=out):
+        pass
+    with obs_trace.timed("phase", out=out):
+        pass
+    assert len(out) == 2 and all(s >= 0.0 for s in out)
+    assert not obs_trace.enabled()
+
+
+def test_overlap_stats_shared_accounting():
+    # fully serialized: no overlap
+    assert obs_trace.overlap_stats(2.0, 2.0) == \
+        {"overlap_s": 0.0, "overlap_frac": 0.0}
+    # perfect 2x overlap: half the serialized time was concurrent
+    st = obs_trace.overlap_stats(2.0, 1.0)
+    assert st == {"overlap_s": 1.0, "overlap_frac": 0.5}
+    # degenerate inputs never divide by zero or go negative
+    assert obs_trace.overlap_stats(0.0, 5.0) == \
+        {"overlap_s": 0.0, "overlap_frac": 0.0}
+    assert obs_trace.overlap_stats(1.0, 3.0)["overlap_s"] == 0.0
+
+
+def test_summary_counts_spans_and_events(tmp_path):
+    _enable(tmp_path)
+    for k in range(3):
+        with obs_trace.span("fold", block=k):
+            pass
+    obs_trace.event("fault", site="x")
+    summary = obs_trace.trace_summary()
+    assert summary["fold"]["count"] == 3
+    assert summary["fold"]["total_s"] >= 0.0
+    assert summary["_events"] == {"fault": 1}
+    _finish()
+
+
+# -- crash-safety: the torn-tail contract -----------------------------------
+
+
+def _write_sample_trace(tmp_path, spans=6):
+    path = _enable(tmp_path, "kill.trace")
+    for k in range(spans):
+        with obs_trace.span("phase", k=k):
+            pass
+    _finish()
+    return path
+
+
+def test_kill_at_every_byte_boundary_stays_readable(tmp_path):
+    """Truncate the file at EVERY byte boundary (the kill -9 sweep): the
+    repair read must always succeed with an intact prefix, and strict
+    must either succeed (cut on a line boundary) or refuse TYPED."""
+    path = _write_sample_trace(tmp_path)
+    data = open(path, "rb").read()
+    full, _, _ = obs_trace.read_trace(path, "strict")
+    cut_path = str(tmp_path / "cut.trace")
+    prev_count = None
+    for cut in range(len(data) + 1):
+        with open(cut_path, "wb") as f:
+            f.write(data[:cut])
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            records, _, torn = obs_trace.read_trace(cut_path, "repair")
+        # the salvaged prefix is a prefix of the full record list
+        assert records == full[:len(records)]
+        assert torn == (cut > 0 and not data[:cut].endswith(b"\n"))
+        if torn:
+            with pytest.raises(MalformedArtifact):
+                obs_trace.read_trace(cut_path, "strict")
+        # record count grows monotonically with the cut
+        if prev_count is not None:
+            assert len(records) >= prev_count - 0
+        prev_count = len(records)
+    assert prev_count == len(full)
+
+
+def test_mid_file_rot_refused_every_mode(tmp_path):
+    path = _write_sample_trace(tmp_path)
+    data = open(path, "rb").read().splitlines(keepends=True)
+    assert len(data) > 3
+    data[1] = b"\x00garbage\n"  # damage a line with intact lines after
+    with open(path, "wb") as f:
+        f.writelines(data)
+    for mode in ("strict", "repair", "trust"):
+        with pytest.raises(MalformedArtifact):
+            obs_trace.read_trace(path, mode)
+
+
+def test_repair_trace_truncates_tear(tmp_path):
+    path = _write_sample_trace(tmp_path)
+    full, _, _ = obs_trace.read_trace(path, "strict")
+    with open(path, "ab") as f:
+        f.write(b'{"k":"span","name":"torn')
+    assert obs_trace.repair_trace(path) == 24
+    records, _, torn = obs_trace.read_trace(path, "strict")
+    assert records == full and not torn
+    assert obs_trace.repair_trace(path) == 0  # idempotent on clean
+
+
+def test_fsck_trace_rules(tmp_path):
+    from sheep_tpu.integrity.fsck import fsck_file
+    path = _write_sample_trace(tmp_path)
+    detail = fsck_file(path)  # clean close sealed a sidecar
+    assert "spans=6" in detail and "sum=verified" in detail
+    # torn tail: strict refuses, repair reports truncatable
+    with open(path, "ab") as f:
+        f.write(b'{"k":"ev"')
+    with pytest.raises(IntegrityError):
+        fsck_file(path, "strict")
+    detail = fsck_file(path, "repair")
+    assert "torn_tail=truncatable" in detail
+    # a sidecar-less partial trace (the kill -9 shape) still fscks by
+    # structure alone
+    os.unlink(path + ".sum")
+    obs_trace.repair_trace(path)
+    detail = fsck_file(path, "strict")
+    assert "sum=absent" in detail
+
+
+def test_clean_close_seals_sidecar_reopen_drops_it(tmp_path):
+    path = _write_sample_trace(tmp_path)
+    assert os.path.exists(path + ".sum")
+    # re-opening for append invalidates the old seal: the recorder must
+    # drop it rather than leave a sidecar lying about the bytes
+    os.environ[obs_trace.ENV] = path
+    with obs_trace.span("more"):
+        pass
+    assert not os.path.exists(path + ".sum")
+    _finish()
+    assert os.path.exists(path + ".sum")
+    records, _, _ = obs_trace.read_trace(path, "strict")
+    assert sum(1 for r in records if r.get("k") == "meta") == 2
+
+
+# -- parity: tracing must not change the build -------------------------------
+
+
+def _ecv_down(tail, head, seq, forest, parts=2):
+    from sheep_tpu.partition.evaluate import evaluate_partition
+    from sheep_tpu.partition.partition import Partition
+
+    p = Partition.from_forest(seq, forest, parts)
+    rep = evaluate_partition(p.parts, tail, head, seq, p.num_parts)
+    return rep.ecv_down
+
+
+def test_traced_build_bit_identical_with_equal_ecv(tmp_path):
+    from sheep_tpu.core.forest import build_forest
+    from sheep_tpu.core.sequence import degree_sequence
+    from sheep_tpu.ops import build_graph_hybrid
+    from sheep_tpu.runtime import RuntimeConfig, build_graph_resilient
+    from sheep_tpu.utils.synth import rmat_edges
+
+    tail, head = rmat_edges(9, 4 << 9, seed=13)
+    want_seq = degree_sequence(tail, head)
+    want = build_forest(tail, head, want_seq)
+
+    path = _enable(tmp_path, "parity.trace")
+    seq_on, f_on = build_graph_resilient(
+        tail, head, config=RuntimeConfig(ladder=("single", "host")))
+    seq_h_on, fh_on = build_graph_hybrid(tail, head)
+    _finish()
+    seq_off, f_off = build_graph_resilient(
+        tail, head, config=RuntimeConfig(ladder=("single", "host")))
+    seq_h_off, fh_off = build_graph_hybrid(tail, head)
+
+    for seq, forest in ((seq_on, f_on), (seq_off, f_off),
+                        (seq_h_on, fh_on), (seq_h_off, fh_off)):
+        np.testing.assert_array_equal(forest.parent, want.parent)
+        np.testing.assert_array_equal(forest.pst_weight, want.pst_weight)
+    np.testing.assert_array_equal(seq_h_on, want_seq)
+    assert _ecv_down(tail, head, seq_h_on, fh_on) == \
+        _ecv_down(tail, head, seq_h_off, fh_off)
+
+    # and the trace actually recorded the build: rung decision + phases
+    records, _, torn = obs_trace.read_trace(path, "strict")
+    assert not torn
+    names = {r.get("name") for r in records if r.get("k") == "span"}
+    assert "rung" in names and "prep" in names
+    evs = {r.get("name") for r in records if r.get("k") == "ev"}
+    assert "ladder.plan" in evs and "rung.ok" in evs
+    assert any(r.get("name") == "reduce.chunk" for r in records
+               if r.get("k") == "ev")
+
+
+def test_trace_cli_rollup_and_rung_explanation(tmp_path, capsys):
+    from sheep_tpu.cli.trace import main as trace_main
+    from sheep_tpu.runtime import RuntimeConfig, build_graph_resilient
+    from sheep_tpu.utils.synth import rmat_edges
+
+    tail, head = rmat_edges(8, 4 << 8, seed=3)
+    path = _enable(tmp_path, "cli.trace")
+    build_graph_resilient(tail, head,
+                          config=RuntimeConfig(ladder=("host",)))
+    _finish()
+    assert trace_main([path]) == 0
+    out = capsys.readouterr().out
+    assert "phase rollup" in out
+    assert "ladder decisions" in out
+    assert "ran: rung 'host'" in out
+    assert "timeline" in out
+    # --json carries the same story machine-readably
+    assert trace_main(["--json", path]) == 0
+    rec = json.loads(capsys.readouterr().out)
+    assert rec["phases"]["rung"]["count"] == 1
+    assert any("host" in line for line in rec["ladder"])
+    assert rec["wall_s"] > 0
+
+
+def test_trace_cli_explains_governor_prices(tmp_path, capsys):
+    """The acceptance line: `sheep trace` explains which rung ran and
+    why — governor price vs measured headroom per rung."""
+    import sheep_tpu.resources.governor as G
+    from sheep_tpu.cli.trace import main as trace_main
+    from sheep_tpu.runtime import RuntimeConfig, build_graph_resilient
+    from sheep_tpu.utils.synth import rmat_edges
+
+    tail, head = rmat_edges(9, 4 << 9, seed=7)
+    prev = G.rss_bytes
+    G.rss_bytes = lambda: 0  # deterministic headroom for the plan
+    try:
+        n_est = 1 << 9
+        budget = (G.rung_peak_nbytes("stream", 2 * n_est, 4 << 9)
+                  + G.rung_peak_nbytes("host", 2 * n_est, 4 << 9)) // 2
+        path = _enable(tmp_path, "gov.trace")
+        cfg = RuntimeConfig(ladder=("host", "stream", "spill"),
+                            governor=G.ResourceGovernor(mem_budget=budget))
+        build_graph_resilient(tail, head, config=cfg)
+        _finish()
+    finally:
+        G.rss_bytes = prev
+    assert trace_main([path]) == 0
+    out = capsys.readouterr().out
+    assert "governor price" in out
+    assert "-> skip" in out or "-> keep" in out
+    assert "ran: rung" in out
+
+
+def test_supervise_status_shows_newest_trace_rollup(tmp_path):
+    from sheep_tpu.supervisor.status import newest_trace_rollup
+    assert newest_trace_rollup(str(tmp_path)) is None
+    _write_sample_trace(tmp_path)
+    roll = newest_trace_rollup(str(tmp_path))
+    assert roll is not None and not roll["torn"]
+    assert roll["phases"]["phase"]["count"] == 6
+    # a torn (killed-run) trace still reports, flagged
+    with open(roll["path"], "ab") as f:
+        f.write(b'{"k":')
+    roll = newest_trace_rollup(str(tmp_path))
+    assert roll["torn"] is True
+
+
+# -- metrics registry + METRICS verb ----------------------------------------
+
+
+def test_registry_counter_gauge_histogram_grammar():
+    r = obs_metrics.Registry()
+    c = r.counter("x_total", "things")
+    c.labels(verb="A").inc()
+    c.labels(verb="A").inc()
+    c.labels(verb="B").inc()
+    g = r.gauge("x_gauge")
+    g.set(2.5)
+    h = r.histogram("x_seconds")
+    for v in (0.0002, 0.003, 0.003, 7.0, 100.0):
+        h.observe(v)
+    text = r.render()
+    assert text.endswith("\n")
+    lines = text.splitlines()
+    assert "# TYPE x_total counter" in lines
+    assert 'x_total{verb="A"} 2' in lines
+    assert 'x_total{verb="B"} 1' in lines
+    assert "# TYPE x_gauge gauge" in lines
+    assert "x_gauge 2.5" in lines
+    assert "# TYPE x_seconds histogram" in lines
+    # bucket counts are cumulative and monotone, +Inf == count
+    buckets = [int(ln.rsplit(" ", 1)[1]) for ln in lines
+               if ln.startswith("x_seconds_bucket")]
+    assert buckets == sorted(buckets)
+    assert buckets[-1] == 5
+    assert "x_seconds_count 5" in lines
+    # quantile: bucket upper-bound estimate
+    assert h.quantile(0.5) == 0.0025 or h.quantile(0.5) == 0.005
+    assert h.quantile(0.99) == 10.0  # 100s observation lands in +Inf
+
+
+def test_histogram_quantile_empty_and_threaded():
+    h = obs_metrics.Histogram("h")
+    assert h.quantile(0.5) == 0.0
+
+    def hammer():
+        for _ in range(500):
+            h.observe(0.001)
+
+    ts = [threading.Thread(target=hammer) for _ in range(4)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert h.count == 2000
+    assert h.quantile(0.99) == 0.001
+
+
+@pytest.fixture
+def serve_daemon(tmp_path):
+    from sheep_tpu.io.edges import write_dat
+    from sheep_tpu.serve.daemon import ServeConfig, ServeDaemon
+    from sheep_tpu.serve.state import ServeCore
+    from sheep_tpu.utils.synth import rmat_edges
+
+    tail, head = rmat_edges(7, 4 << 7, seed=5)
+    g = str(tmp_path / "g.dat")
+    write_dat(g, tail, head)
+    core = ServeCore.bootstrap(str(tmp_path / "state"), graph_path=g,
+                               num_parts=3)
+    d = ServeDaemon(core, ServeConfig(deadline_s=10.0)).start()
+    yield d
+    d.shutdown()
+
+
+def test_metrics_verb_grammar_and_stats_quantiles(serve_daemon):
+    from sheep_tpu.serve.protocol import ServeClient
+    h, p = serve_daemon.address
+    with ServeClient(h, p) as c:
+        c.part([0, 1, 2])
+        c.part([3, 4])
+        c.insert([(1, 2)])
+        body = c.metrics()
+        lines = body.splitlines()
+        assert "# TYPE sheep_serve_requests_total counter" in lines
+        assert 'sheep_serve_requests_total{verb="PART"} 2' in lines
+        assert 'sheep_serve_requests_total{verb="INSERT"} 1' in lines
+        assert "# TYPE sheep_serve_request_seconds histogram" in lines
+        assert "sheep_serve_applied_seqno 1" in lines
+        assert any(ln.startswith("sheep_serve_repl_lag_records")
+                   for ln in lines)
+        # bucket series monotone per verb
+        part_buckets = [int(ln.rsplit(" ", 1)[1]) for ln in lines
+                        if ln.startswith("sheep_serve_request_seconds_"
+                                         "bucket")
+                        and 'verb="PART"' in ln]
+        assert part_buckets == sorted(part_buckets)
+        assert part_buckets[-1] == 2
+        # the connection stays line-clean after the payload (pipelining)
+        assert c.part([0]) is not None
+
+        # STATS derives per-verb counts + p50/p99 from the SAME registry
+        st = c.kv("STATS")
+        assert st["req_part"] == 3
+        assert st["req_insert"] == 1
+        assert st["req_metrics"] == 1
+        assert float(st["p50_part_ms"]) > 0
+        assert float(st["p99_part_ms"]) >= float(st["p50_part_ms"])
+        assert float(st["p99_insert_ms"]) > 0
+        # a second scrape shows the first one counted
+        body2 = c.metrics()
+        assert 'sheep_serve_requests_total{verb="METRICS"} 1' in body2
+        assert 'sheep_serve_requests_total{verb="STATS"} 1' in body2
+
+
+def test_metrics_error_counter_and_bad_lines(serve_daemon):
+    from sheep_tpu.serve.protocol import ServeClient, ServeError
+    h, p = serve_daemon.address
+    with ServeClient(h, p) as c:
+        with pytest.raises(ServeError):
+            c.part([])  # badreq
+        with pytest.raises(ServeError):
+            c.kv("SUBTREE 99999999")  # notfound
+        body = c.metrics()
+        assert 'sheep_serve_errors_total{code="badreq"} 1' in body
+        assert 'sheep_serve_errors_total{code="notfound"} 1' in body
+        # unparseable lines count under BAD, not as a minted verb
+        assert 'verb="BAD"' in body
+
+
+def test_wal_fsync_spans_traced(tmp_path, serve_daemon):
+    from sheep_tpu.serve.protocol import ServeClient
+    path = _enable(tmp_path, "serve.trace")
+    h, p = serve_daemon.address
+    with ServeClient(h, p) as c:
+        c.insert([(3, 4)])
+        c.insert([(5, 6)])
+    summary = obs_trace.trace_summary()
+    _finish()
+    assert summary["wal.fsync"]["count"] >= 2

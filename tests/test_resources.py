@@ -200,6 +200,30 @@ def test_gc_orphan_temps(tmp_path):
     assert os.listdir(tmp_path) == ["real.tre"]
 
 
+def test_gc_orphan_temps_spares_live_writers(tmp_path):
+    """The mid-run sweep (a sibling leg faulted while OTHER attempts are
+    still writing in process) must not unlink a live attempt's rename
+    source — the race that double-dispatched a healthy leg: its
+    atomic_write temp vanished between write and os.replace."""
+    from sheep_tpu.resources.gc import retention_gc
+    _touch(tmp_path / ".g01r0.tre.a1.rand42.tmp")       # live attempt
+    _touch(tmp_path / ".g01r0.tre.a1.sum.rand43.tmp")   # its sidecar temp
+    _touch(tmp_path / ".dead.tre.a9.rand44.tmp")        # true debris
+    live = {"g01r0.tre.a1", "g01r0.tre.a1.sum"}
+    removed = gc_orphan_temps(str(tmp_path), live_bases=live)
+    assert [os.path.basename(p) for p in removed] == \
+        [".dead.tre.a9.rand44.tmp"]
+    assert sorted(os.listdir(tmp_path)) == [
+        ".g01r0.tre.a1.rand42.tmp", ".g01r0.tre.a1.sum.rand43.tmp"]
+    # retention_gc honors the same protection
+    freed, removed = retention_gc(str(tmp_path), keep_last=0,
+                                  live_bases=live)
+    assert removed == []
+    # with no live writers declared, everything is debris again
+    removed = gc_orphan_temps(str(tmp_path))
+    assert len(removed) == 2 and os.listdir(tmp_path) == []
+
+
 def test_retention_gc_policy(tmp_path):
     # oldest-first, protect wins, sidecars travel, keep-last survives
     for i, name in enumerate(["a.tre", "b.tre", "c.tre"]):

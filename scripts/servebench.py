@@ -18,7 +18,26 @@ REAL ``bin/serve`` subprocess over real sockets:
                             acknowledged insert (nothing acked is lost)
 
 The record embeds ``env_capture`` (utils/envinfo.py) like every bench
-artifact since r06, so a slow host explains itself.
+artifact since r06, so a slow host explains itself.  Since r03, every
+arm ALSO embeds per-PROCESS accounting (``_proc_capture``: pid, cpu
+affinity, VmRSS/VmHWM, thread count, from /proc/<pid>/status) for the
+router, each daemon, and the client loop separately — so on a future
+multi-core host the record itself proves who ran where and the
+``read_scaleout 0.7`` one-core artifact note retires without record
+archaeology.
+
+``--fleet`` (SERVEBENCH_r03, ISSUE 11) measures the multi-tenant
+router tier: 2 replicated clusters (leader + follower each) hosting 4
+tenants placed by the consistent-hash ring, a ``bin/route`` process on
+top, per-tenant insert+query load through the router, kill -9 of one
+backing leader under load (zero acked-insert loss through failover,
+the killed leader restarted as a fenced follower), PLUS two A/B arms:
+
+  batch_ab          the vectorized 1000-key PART batch vs the r02
+                    scalar loop, single-core in-process best-of-reps
+                    (acceptance: >=5x)
+  trace_sample_ab   query qps untraced vs SHEEP_TRACE_SAMPLE=1/64
+                    per-request spans (acceptance: <2% overhead)
 
 ``--failover`` (SERVEBENCH_r02, ISSUE 7) measures the replicated
 cluster instead: 1 leader + 2 wire-bootstrapped followers over real
@@ -33,10 +52,11 @@ cluster instead: 1 leader + 2 wire-bootstrapped followers over real
                             follower reports role=leader (epoch bumped)
   recovered_applied_seqno   asserted == every acked insert (zero lost)
 
-Usage: python scripts/servebench.py [--failover] [graph] [out.json]
-Defaults: data/hep-th.dat, SERVEBENCH_r01.json (r02 for --failover) at
-the repo root.  All published numbers must come from serialized runs on
-the bench host (ROADMAP "Known bench context").
+Usage: python scripts/servebench.py [--failover | --fleet] [graph]
+[out.json].  Defaults: data/hep-th.dat, SERVEBENCH_r01.json (r02 for
+--failover, r03 for --fleet) at the repo root.  All published numbers
+must come from serialized runs on the bench host (ROADMAP "Known bench
+context").
 """
 
 from __future__ import annotations
@@ -57,15 +77,35 @@ from sheep_tpu.serve.protocol import ServeClient, connect_retry  # noqa: E402
 from sheep_tpu.utils.envinfo import env_capture  # noqa: E402
 
 
-def _spawn(state_dir, *args, env_extra=None):
+def _spawn(state_dir, *args, env_extra=None, module="sheep_tpu.cli.serve"):
     env = dict(os.environ)
     env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
     env.update(env_extra or {})
     return subprocess.Popen(
-        [sys.executable, "-m", "sheep_tpu.cli.serve", "-d", state_dir,
-         *args],
+        [sys.executable, "-m", module, "-d", state_dir, *args],
         stdout=subprocess.DEVNULL, stderr=subprocess.STDOUT, env=env,
         cwd=REPO)
+
+
+def _proc_capture(pid) -> dict:
+    """Per-process accounting from /proc/<pid>/status: who ran where,
+    with what memory — embedded per router/daemon/client so a future
+    multi-core record needs no archaeology to retire one-core caveats."""
+    rec = {"pid": pid}
+    try:
+        with open(f"/proc/{pid}/status") as f:
+            for line in f:
+                key = line.split(":", 1)[0]
+                if key in ("VmRSS", "VmHWM", "Threads",
+                           "Cpus_allowed_list"):
+                    rec[key.lower()] = line.split(":", 1)[1].strip()
+    except OSError as exc:
+        rec["error"] = str(exc)
+    try:
+        rec["affinity_cores"] = sorted(os.sched_getaffinity(pid))
+    except (AttributeError, OSError):
+        pass
+    return rec
 
 
 def _addr(state_dir, timeout=60.0):
@@ -242,14 +282,334 @@ def failover_bench(graph: str, out: str) -> int:
     return 0
 
 
+def batch_ab_arm(graph: str) -> dict:
+    """The vectorized-verb acceptance: 1000-key PART batch, scalar r02
+    path vs the numpy-gather path, SAME process, single core, best of
+    reps — the win is honest on a 1-core host because both sides are
+    serial Python."""
+    import tempfile
+    from sheep_tpu.io.edges import load_edges
+    from sheep_tpu.serve.protocol import ok_line, parse_vids, \
+        parse_vids_batch
+    from sheep_tpu.serve.state import ServeCore
+    work = tempfile.mkdtemp(prefix="servebench-batch-")
+    el = load_edges(graph)
+    core = ServeCore.bootstrap(os.path.join(work, "s"), graph_path=graph,
+                               num_parts=8)
+    keys = int(os.environ.get("SERVEBENCH_BATCH_KEYS", "1000"))
+    reps = int(os.environ.get("SERVEBENCH_BATCH_REPS", "50"))
+    args = [str((7 * i) % (el.max_vid + 200)) for i in range(keys)]
+
+    def scalar():
+        # the r02 dispatch, verbatim: int() loop + per-vid part() + join
+        vids = parse_vids(args)
+        return ok_line(*[core.part(v) for v in vids])
+
+    def batch():
+        return "OK " + core.part_tokens(parse_vids_batch(args))
+
+    assert scalar() == batch(), "batched PART diverged from scalar"
+    out = {"keys": keys, "reps": reps}
+    for fn, name in ((scalar, "scalar_us"), (batch, "batch_us")):
+        best = float("inf")
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            fn()
+            best = min(best, time.perf_counter() - t0)
+        out[name] = round(best * 1e6, 1)
+    out["speedup"] = round(out["scalar_us"] / out["batch_us"], 2)
+    core.close()
+    return out
+
+
+def trace_sample_ab_arm(graph: str, n_queries: int) -> dict:
+    """Per-request span overhead: the same query bursts against a
+    traced (SHEEP_TRACE_SAMPLE=1/64 per-request spans) and an untraced
+    daemon.  Bursts ALTERNATE between the two live daemons and each
+    side keeps its best — host drift between arms (the dominant noise
+    on a busy 1-core box) hits both sides equally."""
+    import tempfile
+    from sheep_tpu.io.edges import load_edges
+    el = load_edges(graph)
+    vids = list(range(0, el.max_vid + 1,
+                      max(1, (el.max_vid + 1) // 4096)))
+    out = {"sample": "1/64", "queries": n_queries}
+    work = tempfile.mkdtemp(prefix="servebench-ts-")
+    trace_path = os.path.join(work, "serve.trace")
+    arms = {}
+    for label, env_extra in (
+            ("untraced", {}),
+            ("traced", {"SHEEP_TRACE": trace_path,
+                        "SHEEP_TRACE_SAMPLE": "1/64"})):
+        state = os.path.join(work, label)
+        proc = _spawn(state, "-g", graph, "-k", "8",
+                      env_extra=env_extra)
+        host, port = _addr(state)
+        c = connect_retry(host, port, timeout_s=120)
+        _query_burst(c, vids, max(100, n_queries // 10))  # warm
+        arms[label] = (proc, c)
+    best = {"untraced": float("inf"), "traced": float("inf")}
+    for _ in range(4):  # interleaved best-of-reps
+        for label, (proc, c) in arms.items():
+            t0 = time.perf_counter()
+            _query_burst(c, vids, n_queries)
+            best[label] = min(best[label],
+                              time.perf_counter() - t0)
+    for label, (proc, c) in arms.items():
+        out[f"{label}_qps"] = round(n_queries / best[label], 1)
+        c.request("QUIT")
+        c.close()
+        proc.send_signal(signal.SIGTERM)
+        proc.wait(timeout=60)
+    out["trace_spans"] = sum(1 for ln in open(trace_path)
+                             if '"serve.req"' in ln)
+    out["overhead_pct"] = round(
+        100.0 * (1.0 - out["traced_qps"] / out["untraced_qps"]), 2)
+    return out
+
+
+def fleet_bench(graph: str, out: str) -> int:
+    """SERVEBENCH_r03: >=4 tenants on 2 replicated clusters behind the
+    consistent-hash router, kill -9 a backing leader under load, zero
+    acked-insert loss, per-process accounting throughout."""
+    import tempfile
+    from sheep_tpu.io.edges import load_edges
+    from sheep_tpu.serve.protocol import ServeError
+    from sheep_tpu.serve.router import HashRing
+
+    n_queries = int(os.environ.get("SERVEBENCH_QUERIES", "2000"))
+    n_inserts = int(os.environ.get("SERVEBENCH_INSERTS", "240"))
+    work = tempfile.mkdtemp(prefix="servebench-r03-")
+    el = load_edges(graph)
+    max_vid = el.max_vid
+    vids = list(range(0, max_vid + 1, max(1, (max_vid + 1) // 4096)))
+
+    tenants = ["t0", "t1", "t2", "t3"]
+    cluster_ids = ["c0", "c1"]
+    ring = HashRing(cluster_ids)
+    placement = {t: ring.lookup(t) for t in tenants}
+    rec = {"bench": "SERVEBENCH", "round": 3, "arm": "fleet",
+           "graph": graph, "records": el.num_edges,
+           "queries": n_queries, "inserts": n_inserts,
+           "tenants": tenants, "placement": placement,
+           "env": env_capture()}
+    rec["batch_ab"] = batch_ab_arm(graph)
+    rec["trace_sample_ab"] = trace_sample_ab_arm(graph, n_queries)
+
+    env = {"SHEEP_SERVE_REPL_HB_S": "0.2", "SHEEP_SERVE_FAILOVER_S": "1"}
+    procs: dict[str, subprocess.Popen] = {}
+    dirs: dict[str, dict[str, str]] = {}
+    t0 = time.perf_counter()
+    for cid in cluster_ids:
+        mine = [t for t in tenants if placement[t] == cid]
+        lead_d = os.path.join(work, f"{cid}-lead")
+        fol_d = os.path.join(work, f"{cid}-fol")
+        dirs[cid] = {"lead": lead_d, "fol": fol_d}
+        tenant_flags = []
+        for t in mine:
+            tenant_flags += ["--tenant",
+                             f"{t}={os.path.join(work, cid + '-' + t)}"
+                             f":{graph}:8"]
+        procs[f"{cid}-lead"] = _spawn(
+            lead_d, "-g", graph, "-k", "8", "--role", "leader",
+            "--node-id", f"{cid}-lead", "--peers", fol_d,
+            *tenant_flags, env_extra=env)
+        _addr(lead_d, timeout=300)
+        fol_flags = []
+        for t in mine:
+            fol_flags += ["--tenant",
+                          f"{t}={os.path.join(work, cid + '-fol-' + t)}"]
+        procs[f"{cid}-fol"] = _spawn(
+            fol_d, "--role", "follower", "--node-id", f"{cid}-fol",
+            "--peers", lead_d, *fol_flags, env_extra=env)
+        _addr(fol_d, timeout=300)
+    route_d = os.path.join(work, "router")
+    procs["router"] = _spawn(
+        route_d, "--cluster",
+        f"c0@{dirs['c0']['lead']},{dirs['c0']['fol']}",
+        "--cluster", f"c1@{dirs['c1']['lead']},{dirs['c1']['fol']}",
+        module="sheep_tpu.cli.route", env_extra=env)
+    deadline = time.monotonic() + 300
+    rh = rp = None
+    while time.monotonic() < deadline:
+        try:
+            rh, rp = open(os.path.join(route_d, "router.addr")).read() \
+                .split()
+            rp = int(rp)
+            break
+        except (OSError, ValueError):
+            time.sleep(0.1)
+    assert rh is not None, "router.addr never appeared"
+    c = connect_retry(rh, rp, timeout_s=300)
+    # wait until every tenant answers through the router (followers
+    # attached, tenant streams live)
+    for t in tenants:
+        c.tenant(t)
+        deadline = time.monotonic() + 300
+        while time.monotonic() < deadline:
+            try:
+                if c.kv("STATS").get("applied_seqno") == 0:
+                    break
+            except ServeError:
+                pass
+            time.sleep(0.2)
+    rec["fleet_start_s"] = round(time.perf_counter() - t0, 3)
+
+    # -- per-tenant insert throughput through the router -----------------
+    acked = {t: 0 for t in tenants}
+    pairs = [((7 * i) % (max_vid + 1), (13 * i + 1) % (max_vid + 1))
+             for i in range(n_inserts)]
+    t0 = time.perf_counter()
+    for i in range(0, n_inserts, 10):
+        t = tenants[(i // 10) % len(tenants)]
+        c.tenant(t)
+        c.insert(pairs[i:i + 10])
+        acked[t] += 1
+    rec["insert_per_sec_routed"] = round(
+        n_inserts / (time.perf_counter() - t0), 1)
+
+    # -- routed query throughput (reads spread over both members) --------
+    c.tenant("t0")
+    t0 = time.perf_counter()
+    lat = _query_burst(c, vids, n_queries)
+    rec["routed_qps"] = round(n_queries / (time.perf_counter() - t0), 1)
+    rec["routed_p50_ms"], rec["routed_p99_ms"] = _quantiles(lat)
+
+    # -- kill -9 the c0 leader UNDER insert load -------------------------
+    kill_cid = placement["t0"]
+    victim = f"{kill_cid}-lead"
+    stop = threading.Event()
+    killed_at = []
+    load_errors = []
+
+    def kill_load():
+        """Inserts into every tenant while the leader dies; typed
+        refusals are retried (they prove non-application), ambiguous
+        outcomes are surfaced and NOT blind-retried (the router
+        contract) — counted separately."""
+        with ServeClient(rh, rp, timeout_s=60) as kc:
+            i = 0
+            while not stop.is_set():
+                t = tenants[i % len(tenants)]
+                u = (11 * i) % (max_vid + 1)
+                v = (29 * i + 3) % (max_vid + 1)
+                try:
+                    kc.tenant(t)
+                    kc.insert([(u, v)])
+                    acked[t] += 1
+                except (ServeError, ConnectionError, OSError) as exc:
+                    load_errors.append(f"{t}: {exc}")
+                    time.sleep(0.05)
+                i += 1
+                time.sleep(0.002)
+
+    loader = threading.Thread(target=kill_load, daemon=True)
+    loader.start()
+    time.sleep(1.0)
+    rec["procs"] = {name: _proc_capture(p.pid)
+                    for name, p in procs.items()}
+    rec["procs"]["client"] = _proc_capture(os.getpid())
+    procs[victim].kill()
+    killed_at.append(time.monotonic())
+    procs[victim].wait(timeout=60)
+    os.unlink(os.path.join(dirs[kill_cid]["lead"], "serve.addr"))
+    # failover through the router: the killed cluster's tenants answer
+    # again once the follower promotes
+    with ServeClient(rh, rp, timeout_s=120) as pc:
+        pc.tenant("t0")
+        deadline = time.monotonic() + 300
+        promoted = None
+        while promoted is None and time.monotonic() < deadline:
+            try:
+                st = pc.kv("STATS")
+                if st.get("role") == "leader" and st.get("epoch", 0) >= 1:
+                    promoted = st
+            except (ServeError, ConnectionError, OSError):
+                time.sleep(0.1)
+        assert promoted is not None, "failover never surfaced via router"
+        rec["failover_via_router_s"] = round(
+            time.monotonic() - killed_at[0], 3)
+        rec["promoted_epoch"] = promoted["epoch"]
+    # restart the killed leader (rejoins as a fenced follower): write
+    # quorum for its tenants is restorable
+    mine = [t for t in tenants if placement[t] == kill_cid]
+    tenant_flags = []
+    for t in mine:
+        tenant_flags += ["--tenant",
+                         f"{t}={os.path.join(work, kill_cid + '-' + t)}"]
+    procs[victim] = _spawn(
+        dirs[kill_cid]["lead"], "--role", "leader",
+        "--node-id", f"{kill_cid}-lead",
+        "--peers", dirs[kill_cid]["fol"], *tenant_flags, env_extra=env)
+    _addr(dirs[kill_cid]["lead"], timeout=300)
+    time.sleep(2.0)
+    stop.set()
+    loader.join(timeout=30)
+    rec["load_refusals"] = len(load_errors)
+    rec["acked_per_tenant"] = dict(acked)
+
+    # -- zero acked loss: every acked batch is applied on the tenant's
+    # current leader (ambiguous/refused ones may add, never subtract)
+    c.close()
+    time.sleep(1.0)
+    with ServeClient(rh, rp, timeout_s=120) as vc:
+        applied = {}
+        for t in tenants:
+            vc.tenant(t)
+            deadline = time.monotonic() + 120
+            while True:
+                try:
+                    st = vc.kv("STATS")
+                    break
+                except ServeError:
+                    assert time.monotonic() < deadline
+                    time.sleep(0.2)
+            applied[t] = st["applied_seqno"]
+            assert applied[t] >= acked[t], \
+                f"acked inserts lost on {t}: {applied[t]} < {acked[t]}"
+        rec["applied_per_tenant"] = applied
+        rec["router_stats"] = {
+            k: v for k, v in vc.kv("ROUTER").items()
+            if k in ("requests", "reads", "writes", "retries",
+                     "reroutes", "errors", "insert_unknown")}
+        body = vc.metrics()
+        assert "sheep_serve_tenant_requests_total" in body
+        rec["tenant_label_series"] = sum(
+            1 for ln in body.splitlines()
+            if ln.startswith("sheep_serve_tenant_") and "tenant=" in ln)
+
+    for name, p in procs.items():
+        p.send_signal(signal.SIGTERM)
+    for name, p in procs.items():
+        try:
+            p.wait(timeout=60)
+        except subprocess.TimeoutExpired:
+            p.kill()
+
+    with open(out, "w") as f:
+        json.dump(rec, f, indent=1)
+        f.write("\n")
+    print(json.dumps({k: v for k, v in rec.items()
+                      if k not in ("env", "procs")}, indent=1))
+    print(f"servebench: fleet record written to {out}")
+    return 0
+
+
 def main() -> int:
-    args = [a for a in sys.argv[1:] if a != "--failover"]
+    args = [a for a in sys.argv[1:]
+            if a not in ("--failover", "--fleet")]
     failover = "--failover" in sys.argv[1:]
+    fleet = "--fleet" in sys.argv[1:]
     graph = args[0] if len(args) > 0 \
         else os.path.join(REPO, "data", "hep-th.dat")
-    default_out = "SERVEBENCH_r02.json" if failover \
-        else "SERVEBENCH_r01.json"
+    default_out = "SERVEBENCH_r01.json"
+    if failover:
+        default_out = "SERVEBENCH_r02.json"
+    elif fleet:
+        default_out = "SERVEBENCH_r03.json"
     out = args[1] if len(args) > 1 else os.path.join(REPO, default_out)
+    if fleet:
+        return fleet_bench(graph, out)
     if failover:
         return failover_bench(graph, out)
     n_queries = int(os.environ.get("SERVEBENCH_QUERIES", "2000"))

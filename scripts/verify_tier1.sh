@@ -499,4 +499,129 @@ then
 fi
 # -------------------------------------------------------------------------
 
+# --- fleet smoke (multi-tenant serving + router, ISSUE 11) ---------------
+# A replicated cluster hosting 2 tenants behind a bin/route process:
+# route queries+inserts to BOTH tenants, kill -9 the backing leader,
+# assert failover-through-router with zero acked-insert loss, restore
+# write quorum via the rejoined ex-leader, and scrape per-tenant METRICS
+# labels through the router.  Seconds of work; a regression anywhere in
+# the tenant/router stack fails the gate before pytest even runs.
+if ! python - <<'EOF'
+import os, signal, subprocess, sys, tempfile, time
+REPO = os.getcwd()
+sys.path.insert(0, REPO)
+from sheep_tpu.io.edges import write_dat
+from sheep_tpu.serve.protocol import ServeError, connect_retry
+from sheep_tpu.utils.synth import rmat_edges
+
+work = tempfile.mkdtemp()
+tail, head = rmat_edges(7, 4 << 7, seed=31)
+write_dat(work + "/g.dat", tail, head)
+lead_d, fol_d, route_d = work + "/lead", work + "/fol", work + "/route"
+env = dict(os.environ)
+env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+env["SHEEP_SERVE_REPL_HB_S"] = "0.1"
+env["SHEEP_SERVE_FAILOVER_S"] = "1"
+
+def addr(d, name="serve.addr", timeout=60.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        try:
+            host, port = open(f"{d}/{name}").read().split()
+            return host, int(port)
+        except (OSError, ValueError):
+            time.sleep(0.05)
+    raise SystemExit(f"{d}/{name} never appeared")
+
+def spawn(mod, d, *args):
+    return subprocess.Popen(
+        [sys.executable, "-m", mod, "-d", d, *args], env=env, cwd=REPO)
+
+lead = spawn("sheep_tpu.cli.serve", lead_d, "-g", work + "/g.dat",
+             "-k", "3", "--role", "leader", "--node-id", "lead",
+             "--peers", fol_d, "--tenant",
+             f"web={work}/lead-web:{work}/g.dat:3")
+addr(lead_d)
+fol = spawn("sheep_tpu.cli.serve", fol_d, "--role", "follower",
+            "--node-id", "fol", "--peers", lead_d,
+            "--tenant", f"web={work}/fol-web")
+addr(fol_d)
+router = spawn("sheep_tpu.cli.route", route_d,
+               "--cluster", f"{lead_d},{fol_d}")
+rh, rp = addr(route_d, name="router.addr")
+c = connect_retry(rh, rp, timeout_s=60)
+# both tenants reachable and streaming before the kill
+deadline = time.monotonic() + 60
+acked = {"default": 0, "web": 0}
+while time.monotonic() < deadline:
+    try:
+        c.tenant("web")
+        if c.kv("STATS").get("followers") == 1:
+            break
+    except ServeError:
+        pass
+    time.sleep(0.1)
+for t in ("default", "web"):
+    c.tenant(t)
+    for i in range(3):  # every OK = leader fsync + follower ack
+        c.insert([(int(tail[i]), int(head[(i + 5) % len(head)]))])
+        acked[t] += 1
+parts = {}
+for t in ("default", "web"):
+    c.tenant(t)
+    parts[t] = c.part(list(range(100)))
+    assert c.kv("STATS")["applied_seqno"] == acked[t]
+
+lead.send_signal(signal.SIGKILL)   # kill -9 the backing leader
+lead.wait(timeout=60)
+os.unlink(lead_d + "/serve.addr")
+# failover THROUGH the router: the promoted follower answers for both
+# tenants with zero acked-insert loss and identical parts
+deadline = time.monotonic() + 60
+promoted = None
+while promoted is None and time.monotonic() < deadline:
+    try:
+        c.tenant("default")
+        st = c.kv("STATS")
+        if st.get("role") == "leader" and st.get("epoch", 0) >= 1:
+            promoted = st
+    except (ServeError, ConnectionError, OSError):
+        time.sleep(0.1)
+assert promoted is not None, "failover never surfaced via router"
+for t in ("default", "web"):
+    c.tenant(t)
+    st = c.kv("STATS")
+    assert st["applied_seqno"] == acked[t], ("acked loss", t, st)
+    assert c.part(list(range(100))) == parts[t], f"{t} parts diverged"
+# rejoined ex-leader restores the write quorum, through the router
+ex = spawn("sheep_tpu.cli.serve", lead_d, "--role", "leader",
+           "--node-id", "lead", "--peers", fol_d,
+           "--tenant", f"web={work}/lead-web")
+addr(lead_d)
+deadline = time.monotonic() + 60
+while time.monotonic() < deadline:
+    c.tenant("web")
+    if c.kv("STATS").get("followers") == 1:
+        break
+    time.sleep(0.1)
+c.insert([(int(tail[7]), int(head[2]))])
+assert c.kv("STATS")["applied_seqno"] == acked["web"] + 1
+# per-tenant labels in the METRICS scrape, fetched through the router
+body = c.metrics()
+assert 'sheep_serve_tenant_requests_total{tenant="web"' in body, body[:400]
+assert 'sheep_serve_tenant_resident{tenant="web"} 1' in body
+assert 'sheep_serve_requests_total{verb="PART"}' in body
+c.request("QUIT")
+c.close()
+for p in (router, ex, fol):
+    p.send_signal(signal.SIGTERM)
+    p.wait(timeout=60)
+EOF
+then
+  echo "FLEET SMOKE FAILED: 2-tenant router failover lost acked inserts" \
+       "or per-tenant metrics" >&2
+  exit 1
+fi
+# -------------------------------------------------------------------------
+
 set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=${PIPESTATUS[0]}; echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c); exit $rc

@@ -10,6 +10,8 @@ line protocol (sheep_tpu.serve.protocol).
     bin/serve -d state/                            # restart: snapshot+WAL
     bin/serve -d lead/ -g g.dat --role leader --peers f1/,f2/
     bin/serve -d f1/ --role follower --peers lead/,f2/   # joins + streams
+    bin/serve -d state/ -g g.dat --tenant web=web/:web.dat:8 \
+              --tenant social=soc/                 # multi-tenant (ISSUE 11)
 
 First start (artifact flags given) bootstraps the state dir: artifacts
 load through the strict integrity readers, generation-0 snapshot seals
@@ -38,9 +40,21 @@ Options:
              addr file (default SHEEP_SERVE_PEERS)
   --node-id N  this node's id for election tie-breaks and lag reports
              (default SHEEP_SERVE_NODE_ID or host:port)
+  --tenant name=dir[:graph[:k]]   host another serve state dir behind
+             this daemon (repeatable; also SHEEP_SERVE_TENANTS as a
+             comma list of the same entries).  Connections select it
+             with the ``TENANT name`` verb; an empty dir bootstraps
+             from its :graph (or, on a clustered follower, over the
+             wire from the leader's same-named tenant).  Cold tenants
+             evict to their sealed snapshot under memory pressure
+             (SHEEP_MEM_BUDGET / SHEEP_SERVE_MAX_RESIDENT) and restore
+             lazily on the next touch.
 
 Env: SHEEP_SERVE_DEADLINE_S, SHEEP_SERVE_MAX_INFLIGHT,
 SHEEP_SERVE_SNAP_EVERY, SHEEP_SERVE_DRIFT, SHEEP_SERVE_DRIFT_MIN,
+SHEEP_SERVE_TENANTS (comma list of name=dir[:graph[:k]]),
+SHEEP_SERVE_MAX_RESIDENT (resident-tenant cap; cold ones evict),
+SHEEP_TRACE_SAMPLE (1/N per-request serve.req span sampling),
 SHEEP_SERVE_ROLE, SHEEP_SERVE_PEERS, SHEEP_SERVE_NODE_ID,
 SHEEP_SERVE_REPL_ACKS (follower acks per insert OK, default 1),
 SHEEP_SERVE_REPL_HB_S, SHEEP_SERVE_FAILOVER_S, SHEEP_SERVE_MAX_LAG
@@ -65,14 +79,15 @@ from ..integrity.sidecar import POLICIES
 USAGE = ("USAGE: serve -d state_dir [-g graph] [-T tree -s seq] [-P parts]"
          " [-k num_parts] [-p port] [-H host] [-m strict|repair]"
          " [-b balance] [--role leader|follower] [--peers p1,p2]"
-         " [--node-id id]")
+         " [--node-id id] [--tenant name=dir[:graph[:k]] ...]")
 
 
 def main(argv: list[str] | None = None) -> int:
     argv = sys.argv[1:] if argv is None else argv
     try:
         opts, args = getopt.gnu_getopt(argv, "d:g:T:s:P:k:p:H:m:b:",
-                                       ["role=", "peers=", "node-id="])
+                                       ["role=", "peers=", "node-id=",
+                                        "tenant="])
     except getopt.GetoptError as exc:
         print(f"Unknown option character '{(exc.opt or '?')[:1]}'.")
         return 2
@@ -85,6 +100,7 @@ def main(argv: list[str] | None = None) -> int:
     mode = None
     balance = 1.03
     cluster_kw: dict = {}
+    tenant_args: list[str] = []
     for o, a in opts:
         if o == "-d":
             state_dir = a
@@ -117,18 +133,21 @@ def main(argv: list[str] | None = None) -> int:
                                    if p.strip()]
         elif o == "--node-id":
             cluster_kw["node_id"] = a.strip()
+        elif o == "--tenant":
+            tenant_args.append(a.strip())
 
     if state_dir is None or args:
         print(USAGE)
         return 2
 
     from ..serve import (ClusterConfig, ServeConfig, ServeCore,
-                         ServeDaemon)
+                         ServeDaemon, TenantManager, parse_tenant_specs)
     from ..serve.state import snap_paths
 
     config = ServeConfig.from_env(host=host, port=port)
     try:
         cluster = ClusterConfig.from_env(**cluster_kw)
+        tenant_specs = parse_tenant_specs(",".join(tenant_args))
     except ValueError as exc:
         print(f"serve: {exc}", file=sys.stderr)
         return 2
@@ -176,7 +195,33 @@ def main(argv: list[str] | None = None) -> int:
         print(f"serve: {exc}", file=sys.stderr)
         return 1
 
-    daemon = ServeDaemon(core, config, cluster=cluster).start()
+    try:
+        tenants = TenantManager.from_env(core, extra_specs=tenant_specs,
+                                         open_kw=core_kw)
+    except ValueError as exc:
+        print(f"serve: {exc}", file=sys.stderr)
+        return 2
+    if cluster.clustered and cluster.role == "follower":
+        # named tenants with empty dirs bootstrap over the wire from the
+        # leader's same-named tenant, exactly like the default did above
+        from ..serve.cluster import find_leader
+        from ..serve.replicate import bootstrap_state_dir
+        for name in tenants.names():
+            t = tenants.get(name)
+            if t.core is not None or (os.path.isdir(t.state_dir)
+                                      and snap_paths(t.state_dir)):
+                continue
+            found = find_leader(cluster.peers, cluster.poll_timeout_s)
+            if found is None:
+                print(f"serve: tenant {name!r} bootstrap found no "
+                      f"reachable leader", file=sys.stderr)
+                return 1
+            lhost, _, lport = found[0].rpartition(":")
+            bootstrap_state_dir(t.state_dir, lhost, int(lport),
+                                tenant=name)
+
+    daemon = ServeDaemon(core, config, cluster=cluster,
+                         tenants=tenants).start()
     h, p = daemon.address
     st = core.stats()
     print(f"serve: listening on {h}:{p}", flush=True)

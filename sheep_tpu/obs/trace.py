@@ -298,6 +298,79 @@ def event(name: str, **attrs) -> None:
         rec.event(name, attrs)
 
 
+SAMPLE_ENV = "SHEEP_TRACE_SAMPLE"
+
+_sample_spec: str | None = None
+_sample_every = 1
+_sample_counters: dict[str, int] = {}
+_sample_lock = threading.Lock()
+#: calls between environ re-reads of the sample rate: the environ
+#: lookup is ~2us (bytes round-trip through os.environ) and the skip
+#: path runs once per REQUEST, so the rate is cached and re-read every
+#: this-many calls (an env flip lands within one window)
+_SAMPLE_RECHECK = 512
+_sample_countdown = 0
+
+
+def sample_every() -> int:
+    """The parsed ``SHEEP_TRACE_SAMPLE`` rate: ``1/N`` (or a bare
+    ``N``) means one span per N calls, 1 means every call (the
+    default).  Garbage never breaks the traced server: it warns once
+    and samples everything.  Calling this directly re-reads the env NOW
+    (tests do); the hot path re-reads every :data:`_SAMPLE_RECHECK`
+    calls."""
+    global _sample_spec, _sample_every, _sample_countdown
+    _sample_countdown = _SAMPLE_RECHECK
+    spec = os.environ.get(SAMPLE_ENV, "")
+    if spec != _sample_spec:
+        _sample_spec = spec
+        n = 1
+        if spec:
+            try:
+                num, _, den = spec.partition("/")
+                n = int(den) if den else int(num)
+                if den and int(num) != 1:
+                    raise ValueError
+                if n < 1:
+                    raise ValueError
+            except ValueError:
+                warnings.warn(f"{SAMPLE_ENV}={spec!r} is not 1/N or N; "
+                              f"sampling every span")
+                n = 1
+        _sample_every = n
+        with _sample_lock:
+            _sample_counters.clear()
+    return _sample_every
+
+
+def sampled_span(name: str, **attrs):
+    """:func:`span` under the ``SHEEP_TRACE_SAMPLE=1/N`` gate (ISSUE
+    11): per-REQUEST spans on a loaded server would blow the <2% trace
+    overhead budget at tens of thousands of lines per second, so only
+    every Nth call of each span name records — enough that traces
+    exist under load, cheap enough to leave on.  Disabled tracing or a
+    skipped sample returns the shared no-op singleton; a recorded span
+    carries ``sample=N`` so readers can re-scale counts."""
+    rec = _current()
+    if rec is None:
+        return NOOP_SPAN
+    global _sample_countdown
+    _sample_countdown -= 1
+    if _sample_countdown <= 0:
+        sample_every()  # re-read the env once per window
+    n = _sample_every
+    if n > 1:
+        # deliberately lock-free: a racy lost increment only nudges the
+        # sampling cadence, and the skip path runs once per REQUEST on
+        # a loaded server — the lock was most of the <2% budget
+        c = _sample_counters.get(name, 0)
+        _sample_counters[name] = c + 1
+        if c % n:
+            return NOOP_SPAN
+        attrs["sample"] = n
+    return rec.span(name, attrs)
+
+
 @contextlib.contextmanager
 def timed(name: str, out: list | None = None, **attrs):
     """:func:`span` that ALWAYS measures: appends the phase's seconds to

@@ -19,7 +19,12 @@ dies with the leader.
   daemon.py     selectors I/O loop, deadlines, fault hooks, heartbeat
                 liveness, cluster roles
   replicate.py  WAL shipping: frame codec, leader hub, follower applier
-  cluster.py    membership, leader discovery, epoch-fenced failover
+  cluster.py    membership, leader discovery, quorum-vote elections,
+                epoch-fenced failover
+  tenants.py    multi-tenancy (ISSUE 11): N state dirs behind one
+                daemon, TENANT selector, governor-priced eviction
+  router.py     the fleet tier (ISSUE 11): consistent-hash tenants
+                onto clusters, read spreading, epoch-safe retries
   faults.py     SHEEP_SERVE_FAULT_PLAN (kill/hang/slow @ request sites)
   netfaults.py  SHEEP_SERVE_NETFAULT_PLAN (drop/partition/slow/dup @
                 replication frame sites)
@@ -30,7 +35,10 @@ epoch chains across promotion boundaries).
 """
 
 from .admission import AdmissionController, Overloaded, ReadOnly
-from .cluster import ClusterConfig, choose_successor, find_leader
+from .cluster import (ClusterConfig, choose_successor, find_leader,
+                      request_vote)
+from .tenants import (DEFAULT_TENANT, TenantManager, TenantSpec,
+                      UnknownTenant, parse_tenant_specs)
 from .daemon import ServeConfig, ServeDaemon
 from .faults import (SERVE_FAULT_PLAN_ENV, ServeKilled,
                      parse_serve_fault_plan)
@@ -38,12 +46,22 @@ from .netfaults import NETFAULT_PLAN_ENV, parse_netfault_plan
 from .protocol import ServeClient, ServeError, connect_retry
 from .replicate import (ReplApplier, ReplicationHub, Replicator,
                         bootstrap_state_dir, encode_append, parse_frame)
+from .router import HashRing, Router, parse_clusters
 from .state import (ReplicationGap, ServeCore, ecv_down, insert_link)
 from .wal import WalAppender, create_wal, read_wal, repair_wal
 
 __all__ = [
     "AdmissionController",
     "ClusterConfig",
+    "DEFAULT_TENANT",
+    "TenantManager",
+    "TenantSpec",
+    "UnknownTenant",
+    "parse_tenant_specs",
+    "request_vote",
+    "HashRing",
+    "Router",
+    "parse_clusters",
     "NETFAULT_PLAN_ENV",
     "Overloaded",
     "ReadOnly",

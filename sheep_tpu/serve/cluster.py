@@ -26,14 +26,19 @@ transition is a deterministic rule:
               unacknowledged tail is rolled back by snapshot re-sync
               when it rejoins as a follower.
 
-Honest limit: with no quorum, a SYMMETRIC partition (two candidates that
-can each reach clients but not each other) can elect two leaders; the
-epoch fence resolves the split deterministically on heal (the lower
-(epoch, applied, node) demotes and re-syncs), and writes need
+Quorum votes (ISSUE 11, closing the PR-7 symmetric-partition hole): a
+candidate that wins the deterministic rule must ALSO collect ``REPL
+VOTE`` grants from a majority of its reachable peers before promoting.
+Each node grants at most one candidate per epoch and only while its own
+replication stream is stale (daemon.grant_vote), so two candidates that
+share ANY voter can never both promote into the same epoch — a
+symmetric partition now produces at most one leader per epoch instead
+of two leaders in one.  A candidate whose whole peer set is unreachable
+(the 2-node cluster after its leader dies) needs zero votes: that is
+the availability the PR-7 design chose, and the epoch fence still
+resolves any cross-epoch split deterministically on heal.  Writes need
 ``repl_acks`` follower acknowledgements to be acked at all, so no
-acknowledged insert is ever lost to the split.  Deployments that need
-symmetric-partition safety put an odd number of nodes in the peer set
-and set ``repl_acks`` to a majority.
+acknowledged insert is ever lost to a split either way.
 
 Peer specs: ``host:port``, or a serve state-dir path (its ``serve.addr``
 file is read fresh on every poll — ephemeral ports move across
@@ -167,6 +172,27 @@ def find_leader(peers, timeout_s: float = 2.0,
     return best
 
 
+def request_vote(spec: str, epoch: int, candidate: str, seqno: int,
+                 timeout_s: float = 2.0) -> bool:
+    """Ask one peer to grant ``candidate`` its vote for ``epoch``.
+    Returns True only on an explicit ``grant=1`` — unreachable peers
+    and refusals count identically (no grant)."""
+    from .protocol import ServeClient, parse_kv_args
+    addr = resolve_peer(spec)
+    if addr is None:
+        return False
+    try:
+        with ServeClient(addr[0], addr[1], timeout_s=timeout_s) as c:
+            resp = c.request(f"REPL VOTE epoch={epoch} "
+                             f"candidate={candidate} seqno={seqno}")
+            toks = resp.split()
+            if not toks or toks[0] != "OK":
+                return False
+            return parse_kv_args(toks[1:]).get("grant") == "1"
+    except Exception:
+        return False
+
+
 def choose_successor(candidates: list[tuple[int, str]]) -> str:
     """The deterministic election rule: highest ``(applied_seqno,
     node_id)`` wins.  ``candidates`` must include the caller; every
@@ -196,6 +222,7 @@ class FailoverWatcher:
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
         self.elections = 0
+        self.votes_denied = 0
 
     def start(self) -> "FailoverWatcher":
         self._thread = threading.Thread(
@@ -250,5 +277,25 @@ class FailoverWatcher:
         candidates.append((self.daemon.core.applied_seqno,
                            self.config.node_id))
         self.elections += 1
-        if choose_successor(candidates) == self.config.node_id:
-            self.daemon.promote(top_epoch + 1)
+        if choose_successor(candidates) != self.config.node_id:
+            return
+        # the quorum vote (module docstring): a majority of the
+        # REACHABLE peers must grant this epoch before promotion — an
+        # empty reachable set needs no votes (the 2-node availability
+        # choice), a shared voter forbids same-epoch dual leaders
+        reachable = [spec for spec, st in stats if st is not None]
+        need = len(reachable) // 2 + 1 if reachable else 0
+        grants = 0
+        for spec in reachable:
+            if request_vote(spec, top_epoch + 1, self.config.node_id,
+                            self.daemon.core.applied_seqno,
+                            self.config.poll_timeout_s):
+                grants += 1
+            if grants >= need:
+                break
+        if grants < need:
+            self.votes_denied += 1
+            self.daemon.config.events.append(
+                ("election_denied", top_epoch + 1, grants, need))
+            return
+        self.daemon.promote(top_epoch + 1)

@@ -41,6 +41,15 @@ Every insert is durable (WAL fsync) before its ``OK`` leaves the process;
 a kill -9 anywhere in the lifecycle loses at most inserts that were never
 acknowledged — the restart contract tests/test_serve.py and the tier-1
 smoke enforce, now cluster-wide (tests/test_replicate.py).
+
+Multi-tenancy (ISSUE 11, serve/tenants.py): the daemon hosts N tenant
+cores behind this one loop.  A connection's ``TENANT <name>`` selector
+re-points its verbs; every tenant gets its own admission slots and (on
+a clustered daemon) its own replication hub/stream, and the hot read
+verbs answer as single numpy gathers over the selected tenant's
+arrays.  Election is quorum-voted (``REPL VOTE``, :meth:`ServeDaemon.
+grant_vote`): one grant per epoch per voter, majority of reachable
+peers to promote.
 """
 
 from __future__ import annotations
@@ -62,10 +71,13 @@ from ..supervisor.heartbeat import HeartbeatWriter, maybe_start_from_env
 from . import faults as serve_faults
 from .admission import AdmissionController, AdmissionRefused
 from .cluster import ClusterConfig, FailoverWatcher, find_leader
+from ..obs import trace
 from .protocol import (MAX_LINE, BadRequest, err_line, ok_kv, ok_line,
-                       parse_kv_args, parse_request, parse_vids)
+                       parse_kv_args, parse_request, parse_vids,
+                       parse_vids_batch)
 from .replicate import ReplicationHub, Replicator, payload_crc
-from .state import ServeCore
+from .state import PARENT_ABSENT, PARENT_ROOT, ServeCore
+from .tenants import DEFAULT_TENANT, Tenant, TenantManager, UnknownTenant
 
 ADDR_FILE = "serve.addr"
 HEARTBEAT_FILE = "serve.hb"
@@ -123,7 +135,7 @@ class _Conn:
 
     __slots__ = ("sock", "inbuf", "outbuf", "pending", "busy", "repl",
                  "paused", "close_after_flush", "abort", "closed",
-                 "outbuf_cap")
+                 "outbuf_cap", "tenant", "hub")
 
     def __init__(self, sock: socket.socket):
         self.sock = sock
@@ -137,6 +149,8 @@ class _Conn:
         self.abort = False         # close NOW, drop unflushed bytes
         self.closed = False
         self.outbuf_cap = OUTBUF_CAP
+        self.tenant = DEFAULT_TENANT  # connection-scoped TENANT selector
+        self.hub = None            # the hub owning a repl stream conn
 
 
 class ServeDaemon:
@@ -144,16 +158,33 @@ class ServeDaemon:
     hooks + replication roles around one core."""
 
     def __init__(self, core: ServeCore, config: ServeConfig | None = None,
-                 cluster: ClusterConfig | None = None):
+                 cluster: ClusterConfig | None = None,
+                 tenants: TenantManager | None = None):
         self.core = core
         self.config = config or ServeConfig.from_env()
         self.cluster = cluster or ClusterConfig.from_env()
         self.role = self.cluster.role
         self.node_id = self.cluster.node_id  # finalized at bind
+        # the tenant table (ISSUE 11): the default tenant IS this core;
+        # a bare ServeDaemon(core) hosts exactly one tenant and speaks
+        # the PR-7 grammar byte for byte
+        self.tenants = tenants if tenants is not None \
+            else TenantManager(core)
         self.admission = AdmissionController(
             max_inflight=self.config.max_inflight,
             governor=core.governor,
             read_only=self.config.read_only)
+        # per-tenant admission: each tenant gets its own slot pool so a
+        # hot tenant's burst sheds ITS load, not its neighbors'
+        for name in self.tenants.names():
+            t = self.tenants.get(name)
+            if name == DEFAULT_TENANT:
+                t.admission = self.admission
+            elif t.admission is None:
+                t.admission = AdmissionController(
+                    max_inflight=self.config.max_inflight,
+                    governor=core.governor,
+                    read_only=self.config.read_only)
         self._listener: socket.socket | None = None
         self._sel: selectors.DefaultSelector | None = None
         self._wake_r: socket.socket | None = None
@@ -185,12 +216,24 @@ class ServeDaemon:
             "sheep_serve_request_seconds", "request latency by verb")
         self._m_errors = self.metrics.counter(
             "sheep_serve_errors_total", "typed ERR responses by code")
+        # per-tenant request accounting rides its OWN series so the
+        # PR-10 unlabeled series (and everything scraping it) is
+        # untouched by multi-tenancy
+        self._m_tenant_requests = self.metrics.counter(
+            "sheep_serve_tenant_requests_total",
+            "requests by tenant and verb")
         self.hub = ReplicationHub(core, send=self._send_async,
                                   close=self._abort_async,
                                   hb_s=self.cluster.hb_s,
                                   on_fenced=self._on_fenced)
-        self.replicator: Replicator | None = None
+        self.tenants.get(DEFAULT_TENANT).hub = self.hub
         self.watcher: FailoverWatcher | None = None
+        #: quorum-vote state (ISSUE 11): the newest (epoch, candidate)
+        #: this node granted — one vote per epoch is what makes two
+        #: same-epoch leaders impossible (serve/cluster.py)
+        self._vote: tuple[int, str] | None = None
+        self.votes_granted = 0
+        self.votes_refused = 0
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -236,6 +279,11 @@ class ServeDaemon:
                                            daemon=True, name="serve-io")
         self._io_thread.start()
 
+        # every hosted tenant opens (or first-touch bootstraps) before
+        # the cluster join: followers HELLO per tenant immediately, and
+        # a leader must be able to answer those HELLOs
+        self.tenants.open_all()
+
         if self.cluster.clustered:
             if self.role == "leader":
                 # a returning ex-leader must discover its fencing BEFORE
@@ -249,7 +297,7 @@ class ServeDaemon:
                         ("fenced_at_start",
                          int(other[1].get("epoch", 0))))
             if self.role == "follower":
-                self._start_replicator()
+                self._start_replicators()
             self.watcher = FailoverWatcher(self, self.cluster).start()
         self._write_status(force=True)
         return self
@@ -264,9 +312,12 @@ class ServeDaemon:
         self._wake()
         if self.watcher is not None:
             self.watcher.stop()
-        if self.replicator is not None:
-            self.replicator.stop()
-        self.hub.stop()
+        for t in self._tenant_entries():
+            if t.replicator is not None:
+                t.replicator.stop()
+                t.replicator = None
+            if t.hub is not None:
+                t.hub.stop()
         if self._io_thread is not None:
             self._io_thread.join(timeout=5.0)
         if self._pool is not None:
@@ -281,17 +332,48 @@ class ServeDaemon:
         if self._env_hb is not None:
             self._env_hb.stop()
         self._write_status(force=True)
-        self.core.close()
+        self.tenants.close_all()
 
     # -- cluster role transitions ------------------------------------------
 
-    def _start_replicator(self) -> None:
-        if self.replicator is not None:
-            return
-        self.replicator = Replicator(
-            self.core, self.node_id, self._discover_leader,
-            hb_s=self.cluster.hb_s,
-            events=self.config.events).start()
+    def _tenant_entries(self) -> list[Tenant]:
+        return [self.tenants.get(n) for n in self.tenants.names()]
+
+    @property
+    def replicator(self) -> Replicator | None:
+        """The DEFAULT tenant's replication stream — the one the
+        cluster's liveness/staleness machinery keys on (named tenants
+        ride their own streams to the same leader)."""
+        return self.tenants.get(DEFAULT_TENANT).replicator
+
+    def _hub_for(self, t: Tenant) -> ReplicationHub:
+        """The tenant's leader-side hub, rebuilt if an evict/restore
+        cycle replaced the core object underneath it (only possible
+        with zero attached followers — tenants.Tenant.evictable)."""
+        core = self.tenants.core_of(t.name)
+        hub = t.hub
+        if hub is None or hub.core is not core:
+            if hub is not None:
+                hub.stop()
+            hub = ReplicationHub(core, send=self._send_async,
+                                 close=self._abort_async,
+                                 hb_s=self.cluster.hb_s,
+                                 on_fenced=self._on_fenced)
+            t.hub = hub
+            if t.name == DEFAULT_TENANT:
+                self.hub = hub
+        return hub
+
+    def _start_replicators(self) -> None:
+        """One follower stream per hosted tenant, all discovering the
+        same leader (the cluster is one unit; tenants are state dirs)."""
+        for t in self._tenant_entries():
+            if t.replicator is not None:
+                continue
+            t.replicator = Replicator(
+                self.tenants.core_of(t.name), self.node_id,
+                self._discover_leader, hb_s=self.cluster.hb_s,
+                events=self.config.events, tenant=t.name).start()
 
     def _discover_leader(self) -> tuple[str, int] | None:
         """Replication discovery: the reachable peer that is leader at
@@ -316,15 +398,33 @@ class ServeDaemon:
 
     def promote(self, new_epoch: int) -> None:
         """Epoch-fenced promotion (the election winner's side): stop
-        following, seal the boundary DURABLY, only then start taking
-        writes.  A failed seal leaves this node a follower."""
+        following, seal the boundary DURABLY — on EVERY hosted tenant,
+        evicted ones restored first, so the whole daemon changes term as
+        one unit — only then start taking writes.  A failed seal on the
+        default tenant leaves this node a follower."""
         with self._role_lock:
             if self.role == "leader" or self._stop.is_set():
                 return
-            if self.replicator is not None:
-                self.replicator.stop()
-                self.replicator = None
+            for t in self._tenant_entries():
+                if t.replicator is not None:
+                    t.replicator.stop()
+                    t.replicator = None
+            # the default tenant's seal is the promotion gate; named
+            # tenants follow (their cores adopt the same epoch — a
+            # failed named seal is retried by the applier's epoch fence
+            # when that tenant next streams)
             self.core.advance_epoch(new_epoch)
+            for t in self._tenant_entries():
+                if t.name == DEFAULT_TENANT:
+                    continue
+                core = self.tenants.core_of(t.name)
+                if core.epoch < new_epoch:
+                    try:
+                        core.advance_epoch(new_epoch)
+                    except OSError as exc:
+                        self.config.events.append(
+                            ("tenant_epoch_seal_failed",
+                             f"{t.name}: {exc}"))
             self.role = "leader"
             self.config.events.append(("promote", new_epoch))
             self._write_status(force=True)
@@ -338,15 +438,57 @@ class ServeDaemon:
             if self.role == "follower" or self._stop.is_set():
                 return
             self.role = "follower"
-            self.hub.disconnect_all()
+            for t in self._tenant_entries():
+                if t.hub is not None:
+                    t.hub.disconnect_all()
             self.config.events.append(("demote", fenced_by))
-            self._start_replicator()
+            self._start_replicators()
             self._write_status(force=True)
 
     def _on_fenced(self, fenced_by: int) -> None:
         """Hub callback: a follower answered REPL FENCED — a later
         epoch exists even if no peer poll has seen it yet."""
         self.demote(None, fenced_by)
+
+    def grant_vote(self, epoch: int, candidate: str, seqno: int) -> bool:
+        """The voter's half of the quorum-vote election (ISSUE 11,
+        closing the PR-7 symmetric-partition hole): grant at most ONE
+        candidate per epoch, and only when this node has itself lost
+        its leader — so two candidates that share any voter can never
+        both promote into the same epoch.  Refusals:
+
+          - I am a live leader (the candidate should fence on me), or
+            the proposed epoch does not advance mine;
+          - my replication stream is FRESH (a leader is alive from
+            where I stand; the candidate is partitioned, not bereaved);
+          - the candidate has not applied everything I have (electing
+            it would lose acknowledged inserts);
+          - I already voted for a different candidate at this or a
+            later epoch.
+        """
+        with self._role_lock:
+            ok = True
+            if self.role == "leader" or epoch <= self.core.epoch:
+                ok = False
+            elif seqno < self.core.applied_seqno:
+                ok = False
+            else:
+                rep = self.replicator
+                age = rep.stream_age_s() if rep is not None else None
+                if age is not None and age <= self.cluster.failover_s:
+                    ok = False
+                elif self._vote is not None:
+                    ve, vc = self._vote
+                    if ve > epoch or (ve == epoch and vc != candidate):
+                        ok = False
+            if ok:
+                self._vote = (epoch, candidate)
+                self.votes_granted += 1
+                self.config.events.append(
+                    ("vote_granted", epoch, candidate))
+            else:
+                self.votes_refused += 1
+            return ok
 
     # -- the I/O loop ------------------------------------------------------
 
@@ -465,7 +607,7 @@ class ServeDaemon:
             conn.closed = True
             self._conns.pop(id(conn), None)
         if conn.repl:
-            self.hub.detach(conn)
+            (conn.hub or self.hub).detach(conn)
         try:
             self._sel.unregister(conn.sock)
         except (KeyError, ValueError, OSError):
@@ -515,9 +657,11 @@ class ServeDaemon:
                 break
             if conn.repl:
                 # stream connection: ACK/NACK/FENCED go straight to the
-                # hub — never through admission, never to the pool
+                # hub that owns this stream (one hub per tenant) —
+                # never through admission, never to the pool
                 try:
-                    self.hub.on_line(conn, raw.decode("ascii").strip())
+                    (conn.hub or self.hub).on_line(
+                        conn, raw.decode("ascii").strip())
                 except UnicodeDecodeError:
                     pass
                 continue
@@ -597,7 +741,7 @@ class ServeDaemon:
                         conn.busy = False
                     return
                 continue
-            resp, close = self._handle_request(text)
+            resp, close = self._handle_request(text, conn)
             alive = self._send_async(conn, (resp + "\n").encode("ascii"))
             if close:
                 with self._io_lock:
@@ -621,7 +765,10 @@ class ServeDaemon:
             if sub == "HELLO":
                 return self._repl_hello(conn, toks[2:])
             if sub == "SNAPSHOT":
-                self._repl_snapshot(conn)
+                self._repl_snapshot(conn, toks[2:])
+                return False
+            if sub == "VOTE":
+                self._repl_vote(conn, toks[2:])
                 return False
             self._send_async(conn, (err_line(
                 "badrepl", f"unknown replication request {sub!r}")
@@ -640,12 +787,20 @@ class ServeDaemon:
         epoch = int(kv.get("epoch", 0))
         seqno = int(kv.get("seqno", 0))
         sig = kv.get("sig", "-")
+        tname = kv.get("tenant", DEFAULT_TENANT)
         if self.role != "leader":
             self.counters["notleader"] += 1
             self._send_async(conn, (err_line(
                 "notleader", self.leader_addr()) + "\n").encode("ascii"))
             return False
-        core = self.core
+        try:
+            tenant = self.tenants.get(tname)
+        except UnknownTenant as exc:
+            self._send_async(conn, (err_line("badrepl", exc.message)
+                                    + "\n").encode("ascii"))
+            return False
+        hub = self._hub_for(tenant)
+        core = hub.core
         if sig != "-" and sig != core.sig:
             self._send_async(conn, (err_line(
                 "badrepl", f"replica belongs to a different build input "
@@ -685,23 +840,32 @@ class ServeDaemon:
             from_seqno = snap_seqno
         with self._io_lock:
             conn.repl = True
+            conn.hub = hub
             # re-queue any lines the client pipelined behind HELLO so
             # the hub sees them (normally none)
             leftover = list(conn.pending)
             conn.pending.clear()
         for raw in leftover:
             try:
-                self.hub.on_line(conn, raw.decode("ascii").strip())
+                hub.on_line(conn, raw.decode("ascii").strip())
             except UnicodeDecodeError:
                 pass
-        self.hub.attach(conn, node, from_seqno)
-        self.config.events.append(("repl_attach", node))
+        hub.attach(conn, node, from_seqno)
+        self.config.events.append(("repl_attach", f"{node}:{tname}"
+                                   if tname != DEFAULT_TENANT else node))
         return True
 
-    def _repl_snapshot(self, conn: _Conn) -> None:
+    def _repl_snapshot(self, conn: _Conn, args: list[str]) -> None:
         """Bootstrap fetch: one snapshot blob, connection stays
         line-mode (the follower opens its stream separately)."""
-        core = self.core
+        kv = parse_kv_args(args)
+        tname = kv.get("tenant", DEFAULT_TENANT)
+        try:
+            core = self.tenants.core_of(tname)
+        except UnknownTenant as exc:
+            self._send_async(conn, (err_line("badrepl", exc.message)
+                                    + "\n").encode("ascii"))
+            return
         blob, seqno, epoch = core.snapshot_bytes()
         with self._io_lock:
             conn.outbuf_cap = max(conn.outbuf_cap, len(blob) + OUTBUF_CAP)
@@ -709,29 +873,56 @@ class ServeDaemon:
                        crc=payload_crc(blob), sig=core.sig) + "\n"
         self._send_async(conn, header.encode("ascii") + blob)
 
+    def _repl_vote(self, conn: _Conn, args: list[str]) -> None:
+        """``REPL VOTE epoch=E candidate=C seqno=S`` — the election
+        quorum's ballot (serve/cluster.py requests these before a
+        candidate may promote).  Line-mode, never converts the
+        connection."""
+        kv = parse_kv_args(args)
+        try:
+            epoch = int(kv["epoch"])
+            seqno = int(kv["seqno"])
+            candidate = kv["candidate"]
+        except (KeyError, ValueError):
+            raise BadRequest(
+                "VOTE wants epoch=<int> candidate=<id> seqno=<int>")
+        granted = self.grant_vote(epoch, candidate, seqno)
+        self._send_async(conn, (ok_kv(
+            grant=int(granted), epoch=self.core.epoch,
+            node=self.node_id) + "\n").encode("ascii"))
+
     # -- request lifecycle -------------------------------------------------
 
-    def _handle_request(self, text: str) -> tuple[str, bool]:
+    def _handle_request(self, text: str,
+                        conn: _Conn | None = None) -> tuple[str, bool]:
         """One request -> (response, close?), with the registry fed:
         per-verb request counter + latency histogram (observed whatever
         the outcome — a shed or timed-out request is latency a client
-        saw), ERR counter by code."""
+        saw), ERR counter by code, and the per-tenant series.  A
+        sampled ``serve.req`` span (SHEEP_TRACE_SAMPLE, obs/trace.py)
+        wraps the whole thing so traces exist under load inside the
+        <2% overhead budget."""
         t0 = time.monotonic()
-        resp, close = self._handle_one(text)
-        toks = text.split(None, 2)
-        verb = toks[0].upper() if toks else "?"
-        if verb.startswith("DEADLINE=") and len(toks) > 1:
-            verb = toks[1].upper()
-        if resp.startswith("ERR badreq"):
-            verb = "BAD"  # unparseable lines don't mint verb series
+        tname = conn.tenant if conn is not None else DEFAULT_TENANT
+        with trace.sampled_span("serve.req") as sp:
+            resp, close = self._handle_one(text, conn)
+            toks = text.split(None, 2)
+            verb = toks[0].upper() if toks else "?"
+            if verb.startswith("DEADLINE=") and len(toks) > 1:
+                verb = toks[1].upper()
+            if resp.startswith("ERR badreq"):
+                verb = "BAD"  # unparseable lines don't mint verb series
+            sp.annotate(verb=verb, tenant=tname, ok=resp[:2] == "OK")
         self._m_requests.labels(verb=verb).inc()
         self._m_latency.labels(verb=verb).observe(time.monotonic() - t0)
+        self._m_tenant_requests.labels(tenant=tname, verb=verb).inc()
         if resp.startswith("ERR "):
             code = resp.split(None, 2)[1]
             self._m_errors.labels(code=code).inc()
         return resp, close
 
-    def _handle_one(self, text: str) -> tuple[str, bool]:
+    def _handle_one(self, text: str,
+                    conn: _Conn | None = None) -> tuple[str, bool]:
         """One request -> (response line, close-connection?)."""
         self.counters["requests"] += 1
         t0 = time.monotonic()
@@ -745,8 +936,18 @@ class ServeDaemon:
         deadline = t0 + budget
         kind = req.kind
         self.counters["inserts" if kind == "insert" else "queries"] += 1
+        if req.verb == "TENANT":
+            # the connection-scoped selector: touches no tenant state,
+            # so it never holds (or is refused) an admission slot
+            return self._handle_tenant(req, conn)
         try:
-            with self.admission.admit(kind):
+            tenant = self.tenants.get(
+                conn.tenant if conn is not None else DEFAULT_TENANT)
+        except UnknownTenant as exc:
+            self.counters["errors"] += 1
+            return err_line("notfound", exc.message), False
+        try:
+            with (tenant.admission or self.admission).admit(kind):
                 # fault hooks run INSIDE admission: an injected hang/slow
                 # occupies a slot exactly like a real slow client
                 hang = max(0.0, min(deadline - time.monotonic() + 0.05,
@@ -761,7 +962,7 @@ class ServeDaemon:
                         "timeout",
                         f"request exceeded its {budget:g}s deadline "
                         f"before dispatch"), False
-                return self._dispatch(req, deadline)
+                return self._dispatch(req, deadline, tenant)
         except BadRequest as exc:
             # argument-level parse errors surface from dispatch
             self.counters["errors"] += 1
@@ -787,12 +988,31 @@ class ServeDaemon:
             return err_line("internal", f"{type(exc).__name__}: {exc}"), \
                 False
 
-    def _check_staleness(self) -> str | None:
+    def _handle_tenant(self, req, conn: _Conn | None) -> tuple[str, bool]:
+        """``TENANT`` -> current selection; ``TENANT <name>`` re-points
+        THIS connection at another hosted tenant (the default grammar
+        is untouched for connections that never select)."""
+        cur = conn.tenant if conn is not None else DEFAULT_TENANT
+        if not req.args:
+            return ok_kv(tenant=cur), False
+        if len(req.args) != 1:
+            raise BadRequest("TENANT wants at most one tenant name")
+        name = req.args[0]
+        try:
+            self.tenants.get(name)
+        except UnknownTenant as exc:
+            self.counters["errors"] += 1
+            return err_line("notfound", exc.message), False
+        if conn is not None:
+            conn.tenant = name
+        return ok_kv(tenant=name), False
+
+    def _check_staleness(self, tenant: Tenant) -> str | None:
         """Follower bounded-staleness guarantee: None = fresh enough to
         answer, else the typed refusal line."""
         if self.role != "follower" or self.cluster.max_lag is None:
             return None
-        rep = self.replicator
+        rep = tenant.replicator
         lag = rep.lag if rep is not None else 0
         if rep is None or rep.connected_to is None:
             lag = max(lag, 1)  # disconnected: staleness is unbounded
@@ -803,43 +1023,57 @@ class ServeDaemon:
                 f"record staleness bound; retry or read the leader")
         return None
 
-    def _dispatch(self, req, deadline: float) -> tuple[str, bool]:
-        core = self.core
+    def _dispatch(self, req, deadline: float,
+                  tenant: Tenant) -> tuple[str, bool]:
         verb = req.verb
+        # verbs that never touch tenant state run BEFORE the core
+        # resolves — a PING or an EVICT must not thaw a cold tenant
         if verb == "PING":
             return ok_line("pong"), False
         if verb == "QUIT":
             return ok_line("bye"), True
+        if verb == "EVICT":
+            return self._handle_evict(req), False
+        core = self.tenants.core_of(tenant.name)
         if verb in ("PART", "PARENT", "SUBTREE", "ECV"):
-            stale = self._check_staleness()
+            stale = self._check_staleness(tenant)
             if stale is not None:
                 return stale, False
+        # the vectorized hot verbs (ISSUE 11): one numpy parse + one
+        # gather + one join per batch, byte-identical to the scalar loop
         if verb == "PART":
-            vids = parse_vids(req.args)
-            return ok_line(*[core.part(v) for v in vids]), False
+            vids = parse_vids_batch(req.args)
+            return "OK " + core.part_tokens(vids), False
         if verb == "PARENT":
-            if len(req.args) != 1:
-                raise BadRequest("PARENT wants exactly one vertex")
-            (vid,) = parse_vids(req.args)
-            p = core.parent_vid(vid)
-            return ok_line("absent" if p is None else p), False
+            vids = parse_vids_batch(req.args)
+            res = core.parent_batch(vids).tolist()
+            return "OK " + " ".join(
+                "absent" if r == PARENT_ABSENT
+                else "root" if r == PARENT_ROOT else str(r)
+                for r in res), False
         if verb == "SUBTREE":
-            if len(req.args) != 1:
-                raise BadRequest("SUBTREE wants exactly one vertex")
-            (vid,) = parse_vids(req.args)
-            st = core.subtree(vid)
-            if st is None:
-                return err_line("notfound",
-                                f"vertex {vid} is not in the sequence"), \
-                    False
-            return ok_kv(size=st[0], pst=st[1]), False
+            vids = parse_vids_batch(req.args)
+            if len(vids) == 1:
+                # the PR-6 single-vid grammar, byte for byte (kv form,
+                # typed notfound); batches answer positionally instead
+                st = core.subtree(int(vids[0]))
+                if st is None:
+                    return err_line(
+                        "notfound",
+                        f"vertex {int(vids[0])} is not in the "
+                        f"sequence"), False
+                return ok_kv(size=st[0], pst=st[1]), False
+            sizes, psts = core.subtree_batch(vids)
+            return "OK " + " ".join(
+                "absent" if s < 0 else f"{s}:{w}"
+                for s, w in zip(sizes.tolist(), psts.tolist())), False
         if verb == "ECV":
             try:
                 return ok_kv(**core.ecv()), False
             except RuntimeError as exc:
                 return err_line("unavailable", str(exc)), False
         if verb == "STATS":
-            return self._stats_line(), False
+            return self._stats_line(tenant), False
         if verb == "METRICS":
             return self._metrics_response(), False
         if verb == "INSERT":
@@ -856,8 +1090,9 @@ class ServeDaemon:
                 # durable on repl_acks followers too, so failover to the
                 # best-caught-up replica cannot lose it
                 left = max(0.05, deadline - time.monotonic())
-                if not self.hub.wait_acks(seqno, self.cluster.repl_acks,
-                                          left):
+                hub = self._hub_for(tenant)
+                if not hub.wait_acks(seqno, self.cluster.repl_acks,
+                                     left):
                     self.counters["repl_quorum_fails"] += 1
                     return err_line(
                         "unavailable",
@@ -866,7 +1101,8 @@ class ServeDaemon:
                         f"seqno {seqno}); the insert is durable locally "
                         f"and will replicate, but is NOT acknowledged"), \
                         False
-            self._maybe_background_repartition()
+            self._maybe_background_repartition(core)
+            self.tenants.maybe_evict_cold()
             return ok_kv(seq=seqno, applied=len(pairs)), False
         if verb == "SNAPSHOT":
             path = core.seal_snapshot()
@@ -877,6 +1113,35 @@ class ServeDaemon:
                 return err_line("notleader", self.leader_addr()), False
             return ok_kv(**core.repartition()), False
         raise BadRequest(f"unhandled verb {verb!r}")  # unreachable
+
+    def _handle_evict(self, req) -> str:
+        """``EVICT <tenant>``: seal the tenant to a snapshot generation
+        and drop it from memory (the deterministic face of the
+        governor's pressure-driven eviction — tests and operators name
+        the victim instead of waiting for the budget)."""
+        if len(req.args) != 1:
+            raise BadRequest("EVICT wants exactly one tenant name")
+        name = req.args[0]
+        try:
+            t = self.tenants.get(name)
+        except UnknownTenant as exc:
+            return err_line("notfound", exc.message)
+        if name == DEFAULT_TENANT:
+            return err_line("badreq",
+                            "the default tenant cannot be evicted")
+        if not t.resident:
+            return ok_kv(tenant=name, resident=0)  # already cold
+        try:
+            if not self.tenants.evict(name):
+                return err_line(
+                    "unavailable",
+                    f"tenant {name} has replication streams attached; "
+                    f"evicting it would strand them")
+        except OSError as exc:
+            return err_line("unavailable",
+                            f"eviction seal failed ({exc}); tenant "
+                            f"{name} stays resident")
+        return ok_kv(tenant=name, resident=0)
 
     def _render_metrics(self) -> str:
         """The Prometheus scrape body: refresh the gauges from live
@@ -905,6 +1170,24 @@ class ServeDaemon:
         else:
             rep = self.replicator
             lag.set(rep.lag if rep is not None else 0)
+        # per-tenant labels (ISSUE 11): residency, applied seqno, and
+        # evict/restore counters per hosted tenant
+        res = m.gauge("sheep_serve_tenant_resident",
+                      "1 = tenant state is in memory, 0 = evicted to "
+                      "its sealed snapshot")
+        app = m.gauge("sheep_serve_tenant_applied_seqno",
+                      "highest WAL seqno applied, per tenant")
+        evg = m.gauge("sheep_serve_tenant_evictions_total",
+                      "cold evictions per tenant")
+        rsg = m.gauge("sheep_serve_tenant_restores_total",
+                      "lazy restores per tenant")
+        for name in self.tenants.names():
+            t = self.tenants.get(name)
+            res.labels(tenant=name).set(int(t.resident))
+            if t.core is not None:
+                app.labels(tenant=name).set(t.core.applied_seqno)
+            evg.labels(tenant=name).set(t.evictions)
+            rsg.labels(tenant=name).set(t.restores)
         return m.render()
 
     def _metrics_response(self) -> str:
@@ -916,29 +1199,38 @@ class ServeDaemon:
         body = self._render_metrics()  # always newline-terminated
         return f"OK bytes={len(body)}\n" + body[:-1]
 
-    def _stats_line(self) -> str:
-        rec = self.core.stats()
+    def _stats_line(self, tenant: Tenant | None = None) -> str:
+        if tenant is None:
+            tenant = self.tenants.get(DEFAULT_TENANT)
+        core = self.tenants.core_of(tenant.name)
+        rec = core.stats()
         rec.update(self.counters)
-        rec["inflight"] = self.admission.inflight
+        adm = tenant.admission or self.admission
+        rec["inflight"] = adm.inflight
         rec["uptime_s"] = round(time.monotonic() - self.started_at, 3)
-        rec["read_only"] = int(self.admission.read_only
-                               or self.core.governor.mem_pressure())
+        rec["read_only"] = int(adm.read_only
+                               or core.governor.mem_pressure())
         rec["role"] = self.role
         rec["node"] = self.node_id
         rec["leader"] = self.leader_addr()
         if self.role == "leader":
-            lags = self.hub.lag_report()
+            hub = tenant.hub if tenant.hub is not None else self.hub
+            lags = hub.lag_report()
             rec["followers"] = len(lags)
             rec["repl_lag"] = max((f["lag"] for f in lags.values()),
                                   default=0)
             for node, f in sorted(lags.items()):
                 rec[f"lag_{node}"] = f["lag"]
         else:
-            rep = self.replicator
+            rep = tenant.replicator
             rec["followers"] = 0
             rec["repl_lag"] = rep.lag if rep is not None else 0
             rec["leader_seqno"] = (rep.leader_seqno if rep is not None
-                                   else self.core.applied_seqno)
+                                   else core.applied_seqno)
+        if len(self.tenants) > 1:
+            rec["tenant"] = tenant.name
+            rec["tenants"] = len(self.tenants)
+            rec["tenants_resident"] = len(self.tenants.resident_names())
         # per-verb counts + latency quantiles, derived from the SAME
         # histogram registry the METRICS scrape exports (ISSUE 10) —
         # the wire summary and the scrape cannot disagree
@@ -976,6 +1268,13 @@ class ServeDaemon:
             out["repl_lag"] = rep.lag if rep is not None else 0
             out["stream_age_s"] = (rep.stream_age_s()
                                    if rep is not None else None)
+        if len(self.tenants) > 1:
+            out["tenants"] = {
+                name: {"resident": int(t.resident),
+                       "evictions": t.evictions,
+                       "restores": t.restores}
+                for name in self.tenants.names()
+                for t in (self.tenants.get(name),)}
         return out
 
     def _write_status(self, force: bool = False) -> None:
@@ -992,19 +1291,22 @@ class ServeDaemon:
         except OSError:
             pass  # status is advisory; never let it hurt serving
 
-    def _maybe_background_repartition(self) -> None:
+    def _maybe_background_repartition(self, core: ServeCore) -> None:
         """Kick the drift-triggered repartition exactly once at a time;
-        queries serve the stale partition until the swap (state.py)."""
-        if not self.core.drift_exceeded():
+        queries serve the stale partition until the swap (state.py).
+        One at a time is daemon-wide, not per tenant — the partitioner
+        is the expensive thing being rationed, tenants just take
+        turns."""
+        if not core.drift_exceeded():
             return
         if not self._repartitioning.acquire(blocking=False):
             return  # one already running
 
         def work():
             try:
-                self.core.repartition()
+                core.repartition()
                 self.config.events.append(("repartition",
-                                           self.core.repartitions))
+                                           core.repartitions))
             finally:
                 self._repartitioning.release()
 

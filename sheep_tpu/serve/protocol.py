@@ -7,8 +7,19 @@ so a reader never blocks mid-response.  Requests::
     [DEADLINE=<seconds>] VERB [args...]
 
     PART v [v...]        -> OK p [p...]          (-1 = vertex has no part)
-    PARENT v             -> OK <vid> | OK root | OK absent
+    PARENT v [v...]      -> OK t [t...]   (t = <vid> | root | absent;
+                            single-vid responses unchanged from PR 6)
     SUBTREE v            -> OK size=<n> pst=<w>
+    SUBTREE v v [v...]   -> OK s:w [s:w...]      (absent = vid not in the
+                            sequence; batches answer positionally, only
+                            the single-vid form refuses typed notfound)
+    TENANT [name]        -> OK tenant=<name>     (connection-scoped
+                            selector, ISSUE 11: re-points THIS
+                            connection's verbs at another hosted
+                            tenant; with no arg reports the selection)
+    EVICT name           -> OK tenant=<name> resident=0  (seal the
+                            tenant to its snapshot + drop from memory;
+                            next touch lazily restores)
     ECV                  -> OK ecv_down=<n> baseline=<n> drift_cut=<n>
                             parts=<k>
     INSERT u v [u v...]  -> OK seq=<wal seqno> applied=<k>
@@ -32,9 +43,16 @@ serve/replicate.py for the frame codec and the stream lifecycle::
         -> OK mode=stream epoch=<E> seqno=<S>     (conn becomes a stream)
         -> OK mode=snapshot bytes=<n> seqno=<S> epoch=<E> crc=<c>
            followed by <n> raw snapshot bytes, then the stream
-    REPL SNAPSHOT        -> OK bytes=<n> seqno=<S> epoch=<E> crc=<c>
+    REPL SNAPSHOT [tenant=<t>]
+                         -> OK bytes=<n> seqno=<S> epoch=<E> crc=<c>
                             sig=<sig>, followed by <n> raw bytes
                             (bootstrap fetch; conn stays line-mode)
+    REPL VOTE epoch=<e> candidate=<id> seqno=<s>
+                         -> OK grant=0|1 epoch=<mine> node=<me>
+                            (quorum-vote election ballot, ISSUE 11:
+                            one grant per epoch per voter; conn stays
+                            line-mode.  HELLO takes tenant=<t> too —
+                            one stream per tenant per follower.)
     leader -> follower stream frames (one line each):
         REPL APPEND epoch=<E> seqno=<n> crc=<c> data=<base64>
         REPL PING epoch=<E> seqno=<S>
@@ -81,14 +99,15 @@ import socket
 import time
 from dataclasses import dataclass, field
 
-#: verbs that read state (admission kind "query")
+#: verbs that read state (admission kind "query"); TENANT is the
+#: connection-scoped selector (ISSUE 11) and never holds a slot
 QUERY_VERBS = ("PART", "PARENT", "SUBTREE", "ECV", "STATS", "METRICS",
-               "PING")
+               "PING", "TENANT")
 #: verbs that mutate state (admission kind "insert", shed first)
 INSERT_VERBS = ("INSERT",)
 #: operator verbs (admitted as queries; SNAPSHOT/REPARTITION do their own
-#: locking in the core)
-ADMIN_VERBS = ("SNAPSHOT", "REPARTITION", "QUIT")
+#: locking in the core, EVICT seals a cold tenant out of memory)
+ADMIN_VERBS = ("SNAPSHOT", "REPARTITION", "EVICT", "QUIT")
 #: the replication family (serve/replicate.py): handled OUTSIDE admission
 #: — a configured replica is cluster plumbing, not client load, and
 #: shedding it would turn an overload into a lag spiral
@@ -151,6 +170,42 @@ def parse_kv_args(args: list[str]) -> dict:
             raise BadRequest(f"expected key=value, got {tok!r}")
         out[k] = v
     return out
+
+
+def parse_vids_batch(args: list[str]):
+    """The vectorized vid-list decode (ISSUE 11): one numpy parse of the
+    whole token list instead of a Python int() loop — the front half of
+    the batched-verb fast path (state.part_batch is the back half).
+
+    Errors carry the EXACT offending token and its 0-based position, and
+    every bad batch is a typed ``ERR badreq`` with nothing answered —
+    the same all-or-nothing contract as the scalar parser."""
+    if not args:
+        raise BadRequest("expected vertex ids")
+    import numpy as np
+    try:
+        vids = np.array(args, dtype=np.int64)
+    except (ValueError, OverflowError):
+        # slow path: name the exact bad token, or clamp a valid-but-
+        # oversized id (any id past int64 is outside every table, so it
+        # answers the same absent sentinel the scalar path gave it)
+        vids = np.empty(len(args), dtype=np.int64)
+        for i, a in enumerate(args):
+            try:
+                v = int(a)
+            except ValueError:
+                raise BadRequest(
+                    f"non-integer vertex id {a!r} at position {i}")
+            if v < 0:
+                raise BadRequest(
+                    f"negative vertex id {args[i]} at position {i}")
+            vids[i] = min(v, (1 << 63) - 1)
+        return vids
+    neg = np.flatnonzero(vids < 0)
+    if neg.size:
+        i = int(neg[0])
+        raise BadRequest(f"negative vertex id {args[i]} at position {i}")
+    return vids
 
 
 def parse_vids(args: list[str], want_pairs: bool = False) -> list[int]:
@@ -237,6 +292,17 @@ class ServeClient:
     def part(self, vids) -> list[int]:
         out = self._ok("PART " + " ".join(str(v) for v in vids))
         return [int(p) for p in out]
+
+    def parent(self, vids) -> list:
+        """Batched PARENT: per-vid parent vid, ``"root"``, or
+        ``"absent"``."""
+        out = self._ok("PARENT " + " ".join(str(v) for v in vids))
+        return [t if t in ("root", "absent") else int(t) for t in out]
+
+    def tenant(self, name: str) -> str:
+        """Select ``name`` for every later verb on THIS connection."""
+        out = self._ok(f"TENANT {name}")
+        return dict(f.split("=", 1) for f in out)["tenant"]
 
     def insert(self, pairs) -> int:
         """pairs: iterable of (u, v); returns the batch's WAL seqno."""

@@ -76,8 +76,15 @@ def encode_ping(epoch: int, seqno: int) -> str:
     return f"REPL PING epoch={epoch} seqno={seqno}"
 
 
-def encode_hello(node: str, epoch: int, seqno: int, sig: str) -> str:
-    return f"REPL HELLO node={node} epoch={epoch} seqno={seqno} sig={sig}"
+def encode_hello(node: str, epoch: int, seqno: int, sig: str,
+                 tenant: str | None = None) -> str:
+    """The stream handshake; ``tenant`` names a non-default tenant's
+    stream (ISSUE 11) and is omitted otherwise so the single-tenant
+    handshake stays byte-identical to PR 7."""
+    line = f"REPL HELLO node={node} epoch={epoch} seqno={seqno} sig={sig}"
+    if tenant is not None and tenant != "default":
+        line += f" tenant={tenant}"
+    return line
 
 
 def encode_ack(seqno: int) -> str:
@@ -553,12 +560,16 @@ def parse_snapshot_header(line: str) -> dict:
     return kv
 
 
-def fetch_snapshot(host: str, port: int, timeout_s: float = 60.0):
-    """Bootstrap fetch: ``REPL SNAPSHOT`` against a leader.  Returns
-    ``(blob, seqno, epoch, sig)`` with the crc already verified."""
+def fetch_snapshot(host: str, port: int, timeout_s: float = 60.0,
+                   tenant: str | None = None):
+    """Bootstrap fetch: ``REPL SNAPSHOT`` against a leader (for
+    ``tenant``'s state dir when named).  Returns ``(blob, seqno, epoch,
+    sig)`` with the crc already verified."""
+    line = b"REPL SNAPSHOT\n" if tenant in (None, "default") \
+        else f"REPL SNAPSHOT tenant={tenant}\n".encode("ascii")
     with socket.create_connection((host, port), timeout=timeout_s) as s:
         rf = s.makefile("rb")
-        s.sendall(b"REPL SNAPSHOT\n")
+        s.sendall(line)
         line = rf.readline().decode("ascii").strip()
         kv = parse_snapshot_header(line)
         blob = recv_exact(rf, int(kv["bytes"]))
@@ -569,7 +580,8 @@ def fetch_snapshot(host: str, port: int, timeout_s: float = 60.0):
 
 
 def bootstrap_state_dir(state_dir: str, host: str, port: int,
-                        timeout_s: float = 60.0) -> int:
+                        timeout_s: float = 60.0,
+                        tenant: str | None = None) -> int:
     """First start of a follower with an EMPTY state dir: fetch the
     leader's snapshot, seal it locally (sidecar resealed — the blob was
     crc-verified in flight), lay down a fresh WAL at the leader's epoch.
@@ -578,7 +590,8 @@ def bootstrap_state_dir(state_dir: str, host: str, port: int,
     from ..integrity.sidecar import write_sidecar
     from .state import snap_name
     from .wal import create_wal, wal_path
-    blob, seqno, epoch, sig = fetch_snapshot(host, port, timeout_s)
+    blob, seqno, epoch, sig = fetch_snapshot(host, port, timeout_s,
+                                             tenant=tenant)
     os.makedirs(state_dir, exist_ok=True)
     path = os.path.join(state_dir, snap_name(seqno))
     tmp = path + ".fetch"
@@ -611,10 +624,11 @@ class Replicator:
 
     def __init__(self, core: ServeCore, node_id: str, discover,
                  hb_s: float = DEFAULT_HB_S, retry_s: float = 0.2,
-                 events: list | None = None):
+                 events: list | None = None, tenant: str | None = None):
         self.core = core
         self.node_id = node_id
         self.discover = discover
+        self.tenant = tenant  # None/"default": the PR-7 handshake bytes
         self.hb_s = hb_s
         self.retry_s = retry_s
         self.events = events if events is not None else []
@@ -674,7 +688,8 @@ class Replicator:
                 as sock:
             rf = sock.makefile("rb")
             hello = encode_hello(self.node_id, self.core.epoch,
-                                 self.core.applied_seqno, self.core.sig)
+                                 self.core.applied_seqno, self.core.sig,
+                                 tenant=self.tenant)
             sock.sendall((hello + "\n").encode("ascii"))
             line = rf.readline().decode("ascii").strip()
             toks = line.split()

@@ -1,0 +1,452 @@
+"""The router tier: consistent-hash tenants onto serve clusters.
+
+One serve cluster answers for the graphs it hosts; a FLEET needs a tier
+above the clusters that (1) maps tenant -> cluster without a config
+push per tenant, (2) spreads reads over every replica instead of
+hammering the leader, and (3) rides through failover without the client
+noticing — the sharded-serving shape "Scalable Edge Partitioning"
+(PAPERS.md) assumes of any partitioner claiming production scale.
+
+**Placement** is a consistent-hash ring (:class:`HashRing`): each
+cluster contributes ``vnodes`` sha1 points, a tenant id hashes to the
+first point at-or-after it.  Adding a cluster moves ~1/N of tenants,
+removing one moves only its own — no rendezvous table to version.
+
+**Request handling** speaks the serve line grammar verbatim, so every
+existing client (and ``nc``) works through the router unchanged:
+
+  TENANT x     handled locally: selects the tenant AND the cluster for
+               this connection (forwarded as the upstream selector when
+               the proxied connection is opened).
+  reads        PART / PARENT / SUBTREE / ECV / PING round-robin over
+               the tenant's cluster members — leader and followers
+               alike (the follower bounded-staleness refusal is typed,
+               so a stale follower re-routes instead of lying).
+  writes       INSERT / REPARTITION / SNAPSHOT / EVICT go to the
+               cluster's current leader.
+  STATS/METRICS  pinned to the leader (the authoritative view).
+  ROUTER       answered by the router itself: per-router counters.
+
+**Failover contract** (the epoch-safe retry rule): a request that died
+with a TYPED refusal was not applied — ``notleader`` re-resolves and
+retries transparently, ``stale`` tries the next replica.  A connection
+that died AFTER an INSERT was sent with no response is ambiguous: the
+insert may be durable on the old leader's chain, so the router NEVER
+re-sends it to a new epoch on its own — it answers ``ERR unavailable
+... outcome unknown`` and the client (who owns idempotency) decides.
+Reads are safely re-sent anywhere.  ``ERR unavailable``/``fenced``
+responses re-resolve the leader before the next request.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import socket
+import threading
+import time
+
+from .cluster import find_leader, resolve_peer
+from .protocol import ServeClient, ServeError, err_line, ok_kv
+from .tenants import DEFAULT_TENANT
+
+CLUSTERS_ENV = "SHEEP_ROUTE_CLUSTERS"
+VNODES_ENV = "SHEEP_ROUTE_VNODES"
+
+ADDR_FILE = "router.addr"
+
+#: reads that spread across every cluster member
+SPREAD_VERBS = ("PART", "PARENT", "SUBTREE", "ECV", "PING")
+#: verbs pinned to the tenant's cluster leader
+LEADER_VERBS = ("INSERT", "REPARTITION", "SNAPSHOT", "EVICT", "STATS",
+                "METRICS")
+
+_DEADLINE_PREFIX = "DEADLINE="
+
+
+class HashRing:
+    """Consistent hashing of tenant ids onto cluster ids."""
+
+    def __init__(self, cluster_ids, vnodes: int = 64):
+        if not cluster_ids:
+            raise ValueError("a ring needs at least one cluster")
+        self.cluster_ids = list(cluster_ids)
+        self.vnodes = vnodes
+        points = []
+        for cid in self.cluster_ids:
+            for i in range(vnodes):
+                points.append((self._hash(f"{cid}#{i}"), cid))
+        points.sort()
+        self._points = points
+
+    @staticmethod
+    def _hash(key: str) -> int:
+        return int.from_bytes(
+            hashlib.sha1(key.encode("utf-8")).digest()[:8], "big")
+
+    def lookup(self, key: str) -> str:
+        """The cluster id owning ``key``: first ring point at or after
+        the key's hash (wrapping)."""
+        h = self._hash(key)
+        pts = self._points
+        lo, hi = 0, len(pts)
+        while lo < hi:
+            mid = (lo + hi) // 2
+            if pts[mid][0] < h:
+                lo = mid + 1
+            else:
+                hi = mid
+        return pts[lo % len(pts)][1]
+
+
+def parse_clusters(spec: str) -> dict[str, list[str]]:
+    """``[name@]peer,peer[;...]`` -> {cluster_id: [peer specs]}.
+    Unnamed clusters get positional ids c0, c1, ... (stable for a fixed
+    spec; name clusters explicitly if the set will grow)."""
+    out: dict[str, list[str]] = {}
+    for i, entry in enumerate(s for s in spec.split(";") if s.strip()):
+        entry = entry.strip()
+        name, sep, peers = entry.partition("@")
+        if not sep:
+            name, peers = f"c{i}", entry
+        name = name.strip()
+        plist = [p.strip() for p in peers.split(",") if p.strip()]
+        if not name or not plist:
+            raise ValueError(
+                f"cluster entry {entry!r}: want [name@]peer,peer")
+        if name in out:
+            raise ValueError(f"cluster {name!r} named twice")
+        out[name] = plist
+    if not out:
+        raise ValueError(f"no clusters in {spec!r}")
+    return out
+
+
+class _Upstream:
+    """One proxied connection to one backend node, tenant-stamped."""
+
+    __slots__ = ("client", "tenant")
+
+    def __init__(self, client: ServeClient):
+        self.client = client
+        self.tenant = DEFAULT_TENANT
+
+
+class _Cluster:
+    """One serve cluster as the router sees it: peer specs, a cached
+    leader, and a read-spread cursor."""
+
+    def __init__(self, cid: str, peers: list[str],
+                 poll_timeout_s: float = 2.0):
+        self.cid = cid
+        self.peers = peers
+        self.poll_timeout_s = poll_timeout_s
+        self._leader: tuple[str, int] | None = None
+        self._rr = 0
+        self._lock = threading.Lock()
+
+    def nodes(self) -> list[tuple[str, int]]:
+        out = []
+        for spec in self.peers:
+            addr = resolve_peer(spec)
+            if addr is not None and addr not in out:
+                out.append(addr)
+        return out
+
+    def leader(self, refresh: bool = False) -> tuple[str, int] | None:
+        with self._lock:
+            if self._leader is not None and not refresh:
+                return self._leader
+        found = find_leader(self.peers, self.poll_timeout_s)
+        addr = None
+        if found is not None:
+            host, _, port = found[0].rpartition(":")
+            addr = (host, int(port))
+        with self._lock:
+            self._leader = addr
+        return addr
+
+    def set_leader_hint(self, hint: str) -> None:
+        """``ERR notleader host:port`` carried the answer — use it."""
+        host, _, port = hint.rpartition(":")
+        try:
+            addr = (host, int(port))
+        except ValueError:
+            return
+        with self._lock:
+            self._leader = addr
+
+    def forget_leader(self) -> None:
+        with self._lock:
+            self._leader = None
+
+    def read_targets(self) -> list[tuple[str, int]]:
+        """Cluster members, rotated one step per call — the read spread
+        across followers AND leader; retries walk the rest of the
+        list."""
+        nodes = self.nodes()
+        if not nodes:
+            return []
+        with self._lock:
+            self._rr = (self._rr + 1) % len(nodes)
+            k = self._rr
+        return nodes[k:] + nodes[:k]
+
+
+class Router:
+    """The daemon: thread-per-connection proxy over the cluster map.
+
+    Deliberately simpler than the serve daemon's selectors loop — the
+    router holds no graph state, so a stalled connection costs one
+    thread, not a tenant; and the bench measures it as its own process
+    (pinned separately, scripts/servebench.py) so its cost never hides
+    inside a daemon's numbers.
+    """
+
+    def __init__(self, clusters: dict[str, list[str]],
+                 host: str = "127.0.0.1", port: int = 0,
+                 state_dir: str | None = None, vnodes: int = 64,
+                 retries: int = 4, poll_timeout_s: float = 2.0):
+        self.clusters = {cid: _Cluster(cid, peers, poll_timeout_s)
+                         for cid, peers in clusters.items()}
+        self.ring = HashRing(sorted(self.clusters), vnodes=vnodes)
+        self.host = host
+        self.port = port
+        self.state_dir = state_dir
+        self.retries = retries
+        self._listener: socket.socket | None = None
+        self._accept_thread: threading.Thread | None = None
+        self._stop = threading.Event()
+        self._lock = threading.Lock()
+        self.counters = {"conns": 0, "requests": 0, "reads": 0,
+                         "writes": 0, "retries": 0, "reroutes": 0,
+                         "errors": 0, "insert_unknown": 0}
+
+    # -- lifecycle ---------------------------------------------------------
+
+    @property
+    def address(self) -> tuple[str, int]:
+        assert self._listener is not None, "router not started"
+        return self._listener.getsockname()[:2]
+
+    def start(self) -> "Router":
+        self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._listener.bind((self.host, self.port))
+        self._listener.listen(128)
+        if self.state_dir:
+            os.makedirs(self.state_dir, exist_ok=True)
+            h, p = self.address
+            with open(os.path.join(self.state_dir, ADDR_FILE), "w") as f:
+                f.write(f"{h} {p}\n")
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, daemon=True, name="route-accept")
+        self._accept_thread.start()
+        return self
+
+    def run_forever(self) -> None:
+        while not self._stop.wait(0.5):
+            pass
+
+    def shutdown(self) -> None:
+        self._stop.set()
+        if self._listener is not None:
+            try:
+                self._listener.close()
+            except OSError:
+                pass
+        if self._accept_thread is not None:
+            self._accept_thread.join(timeout=5.0)
+
+    def _accept_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                sock, _ = self._listener.accept()
+            except OSError:
+                return
+            self.counters["conns"] += 1
+            threading.Thread(target=self._serve_conn, args=(sock,),
+                             daemon=True, name="route-conn").start()
+
+    # -- placement ---------------------------------------------------------
+
+    def cluster_for(self, tenant: str) -> _Cluster:
+        return self.clusters[self.ring.lookup(tenant)]
+
+    # -- one client connection ---------------------------------------------
+
+    def _serve_conn(self, sock: socket.socket) -> None:
+        upstreams: dict[tuple[str, int], _Upstream] = {}
+        tenant = DEFAULT_TENANT
+        try:
+            rf = sock.makefile("rb")
+            while not self._stop.is_set():
+                raw = rf.readline()
+                if not raw:
+                    return
+                try:
+                    text = raw.decode("ascii").strip()
+                except UnicodeDecodeError:
+                    sock.sendall((err_line(
+                        "badreq", "non-ascii request line") + "\n")
+                        .encode("ascii"))
+                    continue
+                if not text:
+                    continue
+                self.counters["requests"] += 1
+                toks = text.split(None, 2)
+                verb = toks[0].upper()
+                if verb.startswith(_DEADLINE_PREFIX) and len(toks) > 1:
+                    verb = toks[1].upper()
+                if verb == "QUIT":
+                    sock.sendall(b"OK bye\n")
+                    return
+                if verb == "TENANT":
+                    tenant, resp = self._handle_tenant(toks, tenant)
+                    sock.sendall((resp + "\n").encode("ascii"))
+                    continue
+                if verb == "ROUTER":
+                    sock.sendall((self._router_stats(tenant) + "\n")
+                                 .encode("ascii"))
+                    continue
+                resp, payload = self._forward(text, verb, tenant,
+                                              upstreams)
+                sock.sendall((resp + "\n").encode(
+                    "ascii", errors="replace") + payload)
+        except (OSError, ConnectionError):
+            pass
+        finally:
+            for up in upstreams.values():
+                try:
+                    up.client.close()
+                except Exception:
+                    pass
+            try:
+                sock.close()
+            except OSError:
+                pass
+
+    def _handle_tenant(self, toks, tenant) -> tuple[str, str]:
+        args = toks[1:] if len(toks) > 1 else []
+        if len(args) > 1:
+            return tenant, err_line("badreq",
+                                    "TENANT wants at most one name")
+        if not args:
+            return tenant, ok_kv(tenant=tenant)
+        name = args[0]
+        return name, ok_kv(tenant=name,
+                           cluster=self.ring.lookup(name))
+
+    def _router_stats(self, tenant: str) -> str:
+        rec = dict(self.counters)
+        rec["clusters"] = len(self.clusters)
+        rec["tenant"] = tenant
+        rec["cluster"] = self.ring.lookup(tenant)
+        return ok_kv(**rec)
+
+    # -- forwarding --------------------------------------------------------
+
+    def _upstream(self, upstreams, addr, tenant) -> ServeClient:
+        up = upstreams.get(addr)
+        if up is None:
+            up = _Upstream(ServeClient(addr[0], addr[1], timeout_s=30.0))
+            upstreams[addr] = up
+        if up.tenant != tenant:
+            up.client._ok(f"TENANT {tenant}")  # ServeError propagates
+            up.tenant = tenant
+        return up.client
+
+    def _drop(self, upstreams, addr) -> None:
+        up = upstreams.pop(addr, None)
+        if up is not None:
+            try:
+                up.client.close()
+            except Exception:
+                pass
+
+    def _forward(self, text: str, verb: str, tenant: str,
+                 upstreams) -> tuple[str, bytes]:
+        """Route one request line; returns (response line, extra payload
+        bytes) — the payload is only ever the METRICS scrape body."""
+        cluster = self.cluster_for(tenant)
+        is_read = verb in SPREAD_VERBS
+        self.counters["reads" if is_read else "writes"] += 1
+        last_err = "no reachable cluster member"
+        for attempt in range(self.retries + 1):
+            if attempt:
+                self.counters["retries"] += 1
+            if is_read:
+                targets = cluster.read_targets()
+            else:
+                leader = cluster.leader(refresh=attempt > 0)
+                targets = [leader] if leader is not None else []
+            if not targets:
+                time.sleep(0.05 * attempt)
+                continue
+            for addr in targets if is_read else targets[:1]:
+                try:
+                    # connect + tenant-select: a failure HERE means the
+                    # request was never sent — always safe to retry
+                    client = self._upstream(upstreams, addr, tenant)
+                except ServeError as exc:
+                    last_err = f"{exc.code}: {exc.detail}"
+                    self._drop(upstreams, addr)
+                    continue
+                except (OSError, ConnectionError) as exc:
+                    self._drop(upstreams, addr)
+                    last_err = f"{addr[0]}:{addr[1]} unreachable ({exc})"
+                    if not is_read:
+                        cluster.forget_leader()
+                    continue
+                try:
+                    if verb == "METRICS":
+                        # re-frame: header line + the full n-byte body
+                        body = client.metrics().encode("ascii")
+                        return f"OK bytes={len(body)}", body
+                    resp = client.request(text)
+                except ServeError as exc:  # METRICS refused typed
+                    last_err = f"{exc.code}: {exc.detail}"
+                    self._drop(upstreams, addr)
+                    continue
+                except (OSError, ConnectionError) as exc:
+                    self._drop(upstreams, addr)
+                    last_err = f"connection to {addr[0]}:{addr[1]} " \
+                               f"died mid-request ({exc})"
+                    if verb == "INSERT":
+                        # the epoch-safe rule (module docstring): an
+                        # un-answered INSERT may be durable on the old
+                        # chain — never re-sent to a new epoch by us
+                        self.counters["insert_unknown"] += 1
+                        cluster.forget_leader()
+                        return (err_line(
+                            "unavailable",
+                            f"insert outcome unknown ({last_err}); "
+                            f"not retried across failover - re-send "
+                            f"only if idempotent for you"), b"")
+                    cluster.forget_leader()
+                    continue
+                # a complete response line: decide retry vs passthrough
+                if resp.startswith("ERR notleader"):
+                    self.counters["reroutes"] += 1
+                    hint = resp.split()[2] if len(resp.split()) > 2 \
+                        else "-"
+                    if hint != "-":
+                        cluster.set_leader_hint(hint)
+                    else:
+                        cluster.forget_leader()
+                    last_err = "notleader"
+                    break  # next attempt re-resolves
+                if resp.startswith("ERR stale") and is_read:
+                    last_err = "stale replica"
+                    continue  # typed, unanswered: next replica
+                if resp.startswith(("ERR fenced", "ERR unavailable")):
+                    # surface typed (an INSERT here is durable-but-
+                    # unacked territory: the client decides), but make
+                    # the NEXT request re-resolve
+                    cluster.forget_leader()
+                    return resp, b""
+                return resp, b""
+        self.counters["errors"] += 1
+        return err_line("unavailable",
+                        f"cluster {cluster.cid} did not answer after "
+                        f"{self.retries + 1} attempts ({last_err})"), b""

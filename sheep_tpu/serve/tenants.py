@@ -1,0 +1,300 @@
+"""Multi-tenancy: N serve state dirs behind one daemon (ISSUE 11).
+
+One daemon process used to mean one graph.  Production framing — many
+graphs, many users — wants N graphs behind one selectors loop instead of
+N processes each paying a listener, a worker pool, and an idle-time RSS
+floor.  A *tenant* is exactly one PR-6 serve state dir (snapshots + WAL
++ drift accounting), and everything crash-safety already proved about
+one state dir holds per tenant by construction: the cores never share a
+single array, WAL, or admission slot pool.
+
+    SHEEP_SERVE_TENANTS = entry[,entry...]
+    entry               = name=state_dir[:graph[:num_parts]]
+
+(also ``--tenant name=dir[:graph[:k]]``, repeatable, on ``bin/serve``).
+The ``default`` tenant is the daemon's ``-d`` state dir and is what a
+connection talks to until it selects otherwise — the PR-7 wire grammar
+is byte-identical for it.  ``TENANT <name>`` is connection-scoped: it
+re-points THAT connection's verbs at another tenant's core (the router
+issues it once per upstream connection).
+
+**Memory: governor-priced eviction.**  Resident tenants are priced by
+:func:`~sheep_tpu.resources.governor.serve_tenant_nbytes`; when the
+process crosses the ``SHEEP_MEM_BUDGET`` soft threshold (the same
+signal that turns inserts read-only) — or the operator capped resident
+tenants with ``SHEEP_SERVE_MAX_RESIDENT`` — the coldest evictable
+tenant is sealed to a snapshot generation and dropped from memory.
+Eviction is the clean-shutdown path (seal + close), so the evicted
+state is bit-identical by the same argument a restart is; the next verb
+that touches the tenant lazily restores it through ``ServeCore.open`` —
+the exact crash-recovery path, exercised on every eviction cycle.  A
+tenant with attached replication streams never evicts (its followers
+would have to re-handshake for nothing); the default tenant never
+evicts (it IS the daemon's published identity).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from dataclasses import dataclass
+
+from ..resources.governor import ResourceGovernor, serve_tenant_nbytes
+from .state import ServeCore, snap_paths
+
+TENANTS_ENV = "SHEEP_SERVE_TENANTS"
+MAX_RESIDENT_ENV = "SHEEP_SERVE_MAX_RESIDENT"
+
+DEFAULT_TENANT = "default"
+
+
+@dataclass
+class TenantSpec:
+    """One parsed ``name=dir[:graph[:k]]`` entry."""
+
+    name: str
+    state_dir: str
+    graph: str | None = None
+    num_parts: int = 2
+
+
+def parse_tenant_specs(spec: str) -> list[TenantSpec]:
+    """``SHEEP_SERVE_TENANTS`` / ``--tenant`` grammar -> specs.  Raises
+    ValueError on garbage — a misspelled tenant must never silently
+    vanish from the fleet."""
+    out: list[TenantSpec] = []
+    seen = set()
+    for entry in spec.split(","):
+        entry = entry.strip()
+        if not entry:
+            continue
+        name, sep, rest = entry.partition("=")
+        name = name.strip()
+        if not sep or not name or not rest:
+            raise ValueError(
+                f"tenant entry {entry!r}: want name=dir[:graph[:k]]")
+        if name == DEFAULT_TENANT:
+            raise ValueError(
+                f"tenant entry {entry!r}: {DEFAULT_TENANT!r} is the "
+                f"daemon's -d state dir, not a named tenant")
+        if name in seen:
+            raise ValueError(f"tenant {name!r} named twice")
+        seen.add(name)
+        parts = rest.split(":")
+        state_dir = parts[0]
+        graph = parts[1] if len(parts) > 1 and parts[1] else None
+        k = int(parts[2]) if len(parts) > 2 and parts[2] else 2
+        if not state_dir:
+            raise ValueError(f"tenant entry {entry!r}: empty state dir")
+        out.append(TenantSpec(name=name, state_dir=state_dir,
+                              graph=graph, num_parts=k))
+    return out
+
+
+class UnknownTenant(KeyError):
+    """``TENANT x`` named a tenant this daemon does not host."""
+
+    def __init__(self, name: str, known):
+        super().__init__(name)
+        self.name = name
+        self.message = (f"unknown tenant {name!r} (hosting: "
+                        f"{'/'.join(sorted(known))})")
+
+
+class Tenant:
+    """One tenant's runtime state inside the daemon."""
+
+    __slots__ = ("name", "state_dir", "graph", "num_parts", "core",
+                 "admission", "hub", "replicator", "last_touch",
+                 "evictions", "restores")
+
+    def __init__(self, name: str, state_dir: str, graph: str | None,
+                 num_parts: int, core: ServeCore | None):
+        self.name = name
+        self.state_dir = state_dir
+        self.graph = graph
+        self.num_parts = num_parts
+        self.core = core
+        self.admission = None      # set by the daemon (per-tenant slots)
+        self.hub = None            # leader-side ReplicationHub
+        self.replicator = None     # follower-side Replicator
+        self.last_touch = time.monotonic()
+        self.evictions = 0
+        self.restores = 0
+
+    @property
+    def resident(self) -> bool:
+        return self.core is not None
+
+    def evictable(self) -> bool:
+        """Cold-evictable: resident, not the default, and no replication
+        machinery would be stranded by dropping the core."""
+        if self.name == DEFAULT_TENANT or self.core is None:
+            return False
+        if self.replicator is not None:
+            return False
+        return self.hub is None or self.hub.follower_count() == 0
+
+    def priced_nbytes(self) -> int:
+        core = self.core
+        if core is None:
+            return 0
+        return serve_tenant_nbytes(len(core.seq), len(core.parts),
+                                   len(core.ins_tail))
+
+
+class TenantManager:
+    """The daemon's tenant table: selection, lazy restore, and the
+    governor-priced eviction policy.  Thread-safe: one RLock guards the
+    table; restore/evict run under it (restores are rare and bounded by
+    snapshot load time)."""
+
+    def __init__(self, default_core: ServeCore,
+                 specs: list[TenantSpec] | None = None,
+                 governor: ResourceGovernor | None = None,
+                 open_kw: dict | None = None,
+                 max_resident: int | None = None):
+        self.governor = governor if governor is not None \
+            else default_core.governor
+        self.open_kw = dict(open_kw or {})
+        if max_resident is None and os.environ.get(MAX_RESIDENT_ENV):
+            max_resident = int(os.environ[MAX_RESIDENT_ENV])
+        self.max_resident = max_resident
+        self._lock = threading.RLock()
+        self._tenants: dict[str, Tenant] = {}
+        dflt = Tenant(DEFAULT_TENANT, default_core.state_dir, None, 2,
+                      default_core)
+        self._tenants[DEFAULT_TENANT] = dflt
+        for spec in specs or []:
+            self._tenants[spec.name] = Tenant(
+                spec.name, spec.state_dir, spec.graph, spec.num_parts,
+                None)
+
+    @classmethod
+    def from_env(cls, default_core: ServeCore, extra_specs=None,
+                 **kw) -> "TenantManager":
+        specs = list(extra_specs or [])
+        env = os.environ.get(TENANTS_ENV, "")
+        if env:
+            names = {s.name for s in specs}
+            specs += [s for s in parse_tenant_specs(env)
+                      if s.name not in names]
+        return cls(default_core, specs, **kw)
+
+    # -- lookups -----------------------------------------------------------
+
+    def names(self) -> list[str]:
+        with self._lock:
+            return sorted(self._tenants)
+
+    def __len__(self) -> int:
+        return len(self._tenants)
+
+    def get(self, name: str) -> Tenant:
+        """The tenant entry (resident or not); UnknownTenant if this
+        daemon does not host ``name``."""
+        with self._lock:
+            t = self._tenants.get(name)
+            if t is None:
+                raise UnknownTenant(name, self._tenants)
+            return t
+
+    def resident_names(self) -> list[str]:
+        with self._lock:
+            return sorted(n for n, t in self._tenants.items()
+                          if t.resident)
+
+    # -- the touch path ----------------------------------------------------
+
+    def core_of(self, name: str, _count_restore: bool = True) -> ServeCore:
+        """The tenant's live core, lazily restored (or first-touch
+        bootstrapped from its spec'd graph) when evicted.  The ONE entry
+        point the request path uses — every touch stamps LRU time."""
+        with self._lock:
+            t = self.get(name)
+            t.last_touch = time.monotonic()
+            if t.core is None:
+                t.core = self._open(t)
+                if _count_restore:
+                    t.restores += 1
+            return t.core
+
+    def _open(self, t: Tenant) -> ServeCore:
+        if os.path.isdir(t.state_dir) and snap_paths(t.state_dir):
+            return ServeCore.open(t.state_dir, governor=self.governor,
+                                  **self.open_kw)
+        if t.graph is None:
+            raise FileNotFoundError(
+                f"tenant {t.name!r}: {t.state_dir} holds no snapshots "
+                f"and no graph was spec'd to bootstrap from")
+        return ServeCore.bootstrap(t.state_dir, graph_path=t.graph,
+                                   num_parts=t.num_parts,
+                                   governor=self.governor,
+                                   **self.open_kw)
+
+    def open_all(self) -> None:
+        """Eagerly open/bootstrap every tenant (daemon start on a leader
+        or standalone: followers must be able to HELLO immediately).
+        The start-time open is not a "restore" — that counter tracks
+        evict/lazy-restore cycles."""
+        for name in self.names():
+            self.core_of(name, _count_restore=False)
+
+    # -- eviction ----------------------------------------------------------
+
+    def evict(self, name: str) -> bool:
+        """Seal ``name`` to a snapshot generation and drop its core.
+        False when it is not evictable (default, already cold, or has
+        replication attached); raises OSError when the seal itself
+        fails — the tenant then STAYS resident (nothing was lost)."""
+        with self._lock:
+            t = self.get(name)
+            if not t.evictable():
+                return False
+            core = t.core
+            core.seal_snapshot()  # OSError propagates, core untouched
+            core.close()
+            t.core = None
+            t.evictions += 1
+            return True
+
+    def priced_resident_nbytes(self) -> int:
+        with self._lock:
+            return sum(t.priced_nbytes()
+                       for t in self._tenants.values())
+
+    def maybe_evict_cold(self) -> list[str]:
+        """The pressure valve, called after state-growing requests:
+        while the governor reports memory pressure (or the resident
+        count exceeds ``SHEEP_SERVE_MAX_RESIDENT``), seal-and-drop the
+        coldest evictable tenant.  Returns the names evicted (empty
+        almost always).  A failed seal stops the sweep — disk trouble
+        must not cascade into a tenant massacre."""
+        evicted: list[str] = []
+        while True:
+            with self._lock:
+                over_count = (
+                    self.max_resident is not None
+                    and sum(1 for t in self._tenants.values()
+                            if t.resident) > self.max_resident)
+                if not over_count and not self.governor.mem_pressure():
+                    return evicted
+                victims = sorted(
+                    (t for t in self._tenants.values() if t.evictable()),
+                    key=lambda t: t.last_touch)
+                if not victims:
+                    return evicted
+                try:
+                    if not self.evict(victims[0].name):
+                        return evicted
+                except OSError:
+                    return evicted
+                evicted.append(victims[0].name)
+
+    def close_all(self) -> None:
+        with self._lock:
+            for t in self._tenants.values():
+                if t.core is not None:
+                    t.core.close()
+                    t.core = None

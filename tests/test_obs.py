@@ -513,3 +513,77 @@ def test_wal_fsync_spans_traced(tmp_path, serve_daemon):
     summary = obs_trace.trace_summary()
     _finish()
     assert summary["wal.fsync"]["count"] >= 2
+
+
+# -- span sampler (ISSUE 11) -------------------------------------------------
+
+
+def test_sample_every_grammar(monkeypatch):
+    monkeypatch.delenv(obs_trace.SAMPLE_ENV, raising=False)
+    assert obs_trace.sample_every() == 1
+    monkeypatch.setenv(obs_trace.SAMPLE_ENV, "1/8")
+    assert obs_trace.sample_every() == 8
+    monkeypatch.setenv(obs_trace.SAMPLE_ENV, "16")
+    assert obs_trace.sample_every() == 16
+    with pytest.warns(UserWarning):
+        monkeypatch.setenv(obs_trace.SAMPLE_ENV, "2/8")
+        assert obs_trace.sample_every() == 1
+    with pytest.warns(UserWarning):
+        monkeypatch.setenv(obs_trace.SAMPLE_ENV, "garbage")
+        assert obs_trace.sample_every() == 1
+    monkeypatch.delenv(obs_trace.SAMPLE_ENV, raising=False)
+    assert obs_trace.sample_every() == 1
+
+
+def test_sampled_span_records_one_in_n(tmp_path, monkeypatch):
+    """SHEEP_TRACE_SAMPLE=1/N records exactly ceil(k/N) of k spans,
+    each carrying sample=N so readers can re-scale; disabled tracing
+    stays the shared no-op singleton."""
+    monkeypatch.setenv(obs_trace.SAMPLE_ENV, "1/4")
+    assert obs_trace.sampled_span("x") is obs_trace.NOOP_SPAN  # untraced
+    path = _enable(tmp_path, "sampled.trace")
+    obs_trace.sample_every()  # reset the per-name counters
+    for _ in range(10):
+        with obs_trace.sampled_span("serve.req") as sp:
+            sp.annotate(ok=True)  # works on sampled AND no-op spans
+    _finish()
+    monkeypatch.delenv(obs_trace.SAMPLE_ENV, raising=False)
+    records, _, _ = obs_trace.read_trace(path, "strict")
+    spans = [r for r in records
+             if r.get("k") == "span" and r["name"] == "serve.req"]
+    assert len(spans) == 3  # calls 0, 4, 8 of 10
+    assert all(s["a"].get("sample") == 4 for s in spans)
+
+
+def test_serve_requests_sampled_under_load(tmp_path, monkeypatch):
+    """The daemon's per-request spans exist under SHEEP_TRACE_SAMPLE
+    and carry verb/tenant attributes."""
+    import numpy as np
+    from sheep_tpu.io.edges import write_dat
+    from sheep_tpu.serve import ServeConfig, ServeCore, ServeDaemon
+    from sheep_tpu.serve.protocol import ServeClient
+    from sheep_tpu.utils.synth import rmat_edges
+    tail, head = rmat_edges(6, 4 << 6, seed=3)
+    write_dat(str(tmp_path / "g.dat"), tail, head)
+    core = ServeCore.bootstrap(str(tmp_path / "s"),
+                               graph_path=str(tmp_path / "g.dat"),
+                               num_parts=3)
+    monkeypatch.setenv(obs_trace.SAMPLE_ENV, "1/5")
+    path = _enable(tmp_path, "serve-req.trace")
+    obs_trace.sample_every()
+    d = ServeDaemon(core, ServeConfig()).start()
+    try:
+        h, p = d.address
+        with ServeClient(h, p) as c:
+            for _ in range(20):
+                c.part([0, 1, 2])
+    finally:
+        d.shutdown()
+        _finish()
+        monkeypatch.delenv(obs_trace.SAMPLE_ENV, raising=False)
+    records, _, _ = obs_trace.read_trace(path, "repair")
+    spans = [r for r in records
+             if r.get("k") == "span" and r["name"] == "serve.req"]
+    assert 2 <= len(spans) <= 6, len(spans)  # ~20/5, not 20
+    assert all(s["a"]["verb"] == "PART" for s in spans)
+    assert all(s["a"]["tenant"] == "default" for s in spans)

@@ -1,0 +1,378 @@
+"""Router + quorum-vote tests (ISSUE 11): consistent-hash placement,
+read spreading, epoch-safe failover retries with zero acked-insert
+loss, the un-acked-INSERT ambiguity contract, and the vote rule that
+closes the PR-7 symmetric-partition hole (no dual-leader epoch)."""
+
+import os
+import time
+
+import numpy as np
+import pytest
+
+from sheep_tpu.io import faultfs
+from sheep_tpu.io.edges import write_dat
+from sheep_tpu.serve import faults as serve_faults
+from sheep_tpu.serve import netfaults
+from sheep_tpu.serve.cluster import ClusterConfig, request_vote
+from sheep_tpu.serve.daemon import ServeConfig, ServeDaemon
+from sheep_tpu.serve.protocol import ServeClient, ServeError
+from sheep_tpu.serve.replicate import bootstrap_state_dir
+from sheep_tpu.serve.router import HashRing, Router, parse_clusters
+from sheep_tpu.serve.state import ServeCore
+from sheep_tpu.serve.tenants import TenantManager, TenantSpec
+from sheep_tpu.utils.synth import rmat_edges
+
+
+@pytest.fixture(autouse=True)
+def _clean_fault_plans():
+    faultfs.clear_plan()
+    serve_faults.clear_plan()
+    netfaults.clear_plan()
+    yield
+    faultfs.clear_plan()
+    serve_faults.clear_plan()
+    netfaults.clear_plan()
+
+
+def _wait_until(cond, timeout_s=20.0, poll_s=0.02, what="condition"):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if cond():
+            return
+        time.sleep(poll_s)
+    raise TimeoutError(f"{what} not reached in {timeout_s}s")
+
+
+def _make_state(tmp_path, name, seed=5, log2=7, parts=3):
+    tail, head = rmat_edges(log2, 4 << log2, seed=seed)
+    g = str(tmp_path / f"{name}.dat")
+    write_dat(g, tail, head)
+    sd = str(tmp_path / name)
+    core = ServeCore.bootstrap(sd, graph_path=g, num_parts=parts)
+    return core, sd, tail, head
+
+
+def _abrupt_kill(daemon):
+    """In-process kill -9: sockets die, nothing flushes or demotes."""
+    daemon._stop.set()
+    daemon._wake()
+    if daemon.watcher is not None:
+        daemon.watcher.stop()
+    for t in daemon._tenant_entries():
+        if t.hub is not None:
+            t.hub.stop()
+    try:
+        daemon._listener.close()
+    except OSError:
+        pass
+    for conn in list(daemon._conns.values()):
+        try:
+            conn.sock.close()
+        except OSError:
+            pass
+    if daemon._hb is not None:
+        daemon._hb.stop()
+    try:
+        os.unlink(os.path.join(daemon.core.state_dir, "serve.addr"))
+    except OSError:
+        pass
+
+
+# ---------------------------------------------------------------------------
+# the ring + cluster grammar
+# ---------------------------------------------------------------------------
+
+
+def test_hash_ring_deterministic_and_stable():
+    r1 = HashRing(["a", "b", "c"])
+    r2 = HashRing(["c", "b", "a"])
+    for t in (f"tenant{i}" for i in range(64)):
+        assert r1.lookup(t) == r2.lookup(t)  # order-independent
+    # removing a cluster only moves ITS tenants
+    r3 = HashRing(["a", "b"])
+    for i in range(128):
+        t = f"tenant{i}"
+        if r1.lookup(t) != "c":
+            assert r3.lookup(t) == r1.lookup(t)
+
+
+def test_hash_ring_balance():
+    ring = HashRing(["a", "b", "c", "d"])
+    counts = {"a": 0, "b": 0, "c": 0, "d": 0}
+    n = 2000
+    for i in range(n):
+        counts[ring.lookup(f"graph-{i}")] += 1
+    for c in counts.values():  # rough balance: within 2.2x of fair
+        assert n / 4 / 2.2 < c < n / 4 * 2.2, counts
+
+
+def test_parse_clusters_grammar():
+    out = parse_clusters("d1/,d2/;x@h:1,h:2")
+    assert out == {"c0": ["d1/", "d2/"], "x": ["h:1", "h:2"]}
+    for bad in ("", ";;", "x@", "a@p;a@q"):
+        with pytest.raises(ValueError):
+            parse_clusters(bad)
+    with pytest.raises(ValueError):
+        HashRing([])
+
+
+# ---------------------------------------------------------------------------
+# routing: placement, read spread, failover retries
+# ---------------------------------------------------------------------------
+
+
+def test_router_places_and_isolates_tenants(tmp_path):
+    """Two single-node clusters, four tenants: every tenant's insert
+    lands on its ring-assigned cluster and nowhere else; reads through
+    the router answer exactly what the backing core answers."""
+    ring = HashRing(["c0", "c1"])
+    tenants = ["t0", "t1", "t2", "t3"]
+    daemons, mgrs = {}, {}
+    for cid in ("c0", "c1"):
+        core, sd, *_ = _make_state(tmp_path, f"{cid}-dflt", seed=5)
+        specs = [TenantSpec(t, str(tmp_path / f"{cid}-{t}"),
+                            str(tmp_path / f"{cid}-dflt.dat"), 3)
+                 for t in tenants if ring.lookup(t) == cid]
+        mgrs[cid] = TenantManager(core, specs)
+        daemons[cid] = ServeDaemon(core, ServeConfig(),
+                                   tenants=mgrs[cid]).start()
+    router = Router({cid: [d.core.state_dir]
+                     for cid, d in daemons.items()}).start()
+    try:
+        rh, rp = router.address
+        with ServeClient(rh, rp) as c:
+            for t in tenants:
+                assert c.tenant(t) == t
+                c.insert([(1, 4), (2, 9)])
+                cid = ring.lookup(t)
+                assert mgrs[cid].get(t).core.applied_seqno == 1
+                other = "c1" if cid == "c0" else "c0"
+                with pytest.raises(Exception):
+                    mgrs[other].get(t)  # not even hosted there
+                want = [mgrs[cid].get(t).core.part(v) for v in range(30)]
+                assert c.part(list(range(30))) == want
+            rs = c.kv("ROUTER")
+            assert rs["writes"] == len(tenants)
+            assert rs["clusters"] == 2
+    finally:
+        router.shutdown()
+        for d in daemons.values():
+            d.shutdown()
+
+
+def _replicated_cluster(tmp_path, failover_s=0.6):
+    lcore, lsd, tail, head = _make_state(tmp_path, "lead")
+    fsd = str(tmp_path / "fol")
+    lead = ServeDaemon(
+        lcore, ServeConfig(),
+        cluster=ClusterConfig(node_id="L", role="leader", peers=[fsd],
+                              hb_s=0.05, failover_s=failover_s,
+                              poll_timeout_s=1.0)).start()
+    lh, lp = lead.address
+    bootstrap_state_dir(fsd, lh, lp)
+    fol = ServeDaemon(
+        ServeCore.open(fsd), ServeConfig(),
+        cluster=ClusterConfig(node_id="F", role="follower", peers=[lsd],
+                              hb_s=0.05, failover_s=failover_s,
+                              poll_timeout_s=1.0)).start()
+    _wait_until(lambda: lead.hub.follower_count() == 1,
+                what="follower attached")
+    return lead, fol, lsd, fsd
+
+
+def test_router_failover_zero_acked_loss(tmp_path):
+    """The kill-a-node acceptance, through the router: inserts stream
+    through the router, the backing leader dies abruptly, the router
+    rides the epoch-fenced promotion — every insert the client saw OK
+    for is on the promoted leader, and ambiguous in-flight inserts
+    surfaced typed, never silently re-sent across the epoch."""
+    lead, fol, lsd, fsd = _replicated_cluster(tmp_path)
+    router = Router({"c0": [lsd, fsd]}, retries=8,
+                    poll_timeout_s=0.5).start()
+    acked = 0
+    ambiguous = 0
+    refusals = 0
+    ex = None
+    try:
+        rh, rp = router.address
+        with ServeClient(rh, rp, timeout_s=60.0) as c:
+            for i in range(10):
+                c.insert([(i, i + 9)])
+                acked += 1
+            _abrupt_kill(lead)
+            _wait_until(lambda: fol.role == "leader", what="promotion")
+            # the ex-leader rejoins as a fenced follower so the write
+            # quorum is restorable (the PR-7 contract)
+            ex = ServeDaemon(
+                ServeCore.open(lsd), ServeConfig(),
+                cluster=ClusterConfig(node_id="L", role="leader",
+                                      peers=[fsd], hb_s=0.05,
+                                      failover_s=0.6,
+                                      poll_timeout_s=1.0)).start()
+            _wait_until(lambda: fol.hub.follower_count() == 1,
+                        what="ex-leader rejoined")
+            for i in range(10, 22):
+                try:
+                    c.insert([(i, i + 9)])
+                    acked += 1
+                except ServeError as exc:
+                    # typed = not applied (or ambiguous, counted apart)
+                    if "outcome unknown" in exc.detail:
+                        ambiguous += 1
+                    else:
+                        refusals += 1
+                        assert exc.code in ("unavailable", "notleader")
+            # reads still answer through the router
+            assert c.part([0, 1, 2]) == [fol.core.part(v)
+                                         for v in (0, 1, 2)]
+            st = c.kv("STATS")
+        assert st["role"] == "leader" and st["epoch"] == 1
+        # ZERO acked loss: everything the client saw OK for is applied
+        # (ambiguous inserts may also be durable — never fewer)
+        assert fol.core.applied_seqno >= acked
+        assert fol.core.applied_seqno <= acked + ambiguous + refusals
+        assert acked >= 15, (acked, ambiguous, refusals)
+    finally:
+        router.shutdown()
+        if ex is not None:
+            ex.shutdown()
+        fol.shutdown()
+
+
+def test_router_insert_ambiguity_is_typed(tmp_path):
+    """An INSERT whose connection dies before the response is NEVER
+    retried by the router: the client gets the typed outcome-unknown
+    refusal and owns the decision."""
+    core, sd, *_ = _make_state(tmp_path, "solo")
+    d = ServeDaemon(core, ServeConfig()).start()
+    router = Router({"c0": [sd]}, retries=2).start()
+    try:
+        rh, rp = router.address
+        with ServeClient(rh, rp, timeout_s=30.0) as c:
+            c.insert([(1, 5)])  # healthy path, warms the upstream
+            applied_before = core.applied_seqno
+            _abrupt_kill(d)
+            with pytest.raises(ServeError) as ei:
+                c.insert([(2, 6)])
+            assert ei.value.code == "unavailable"
+            assert "outcome unknown" in ei.value.detail
+            assert router.counters["insert_unknown"] == 1
+        assert core.applied_seqno == applied_before  # nothing re-sent
+    finally:
+        router.shutdown()
+
+
+def test_router_spreads_reads_across_members(tmp_path):
+    """Read verbs rotate over cluster members: both the leader and the
+    follower see PART traffic."""
+    lead, fol, lsd, fsd = _replicated_cluster(tmp_path, failover_s=30.0)
+    router = Router({"c0": [lsd, fsd]}).start()
+    try:
+        rh, rp = router.address
+        with ServeClient(rh, rp) as c:
+            for _ in range(12):
+                c.part([0, 1, 2])
+        lead_parts = lead.metrics.counter(
+            "sheep_serve_requests_total").labels(verb="PART").value
+        fol_parts = fol.metrics.counter(
+            "sheep_serve_requests_total").labels(verb="PART").value
+        assert lead_parts > 0 and fol_parts > 0, (lead_parts, fol_parts)
+        assert lead_parts + fol_parts == 12
+    finally:
+        router.shutdown()
+        lead.shutdown()
+        fol.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# quorum-vote election (the symmetric-partition fix)
+# ---------------------------------------------------------------------------
+
+
+def test_vote_rule_one_grant_per_epoch(tmp_path):
+    """The invariant that forbids same-epoch dual leaders: a voter
+    grants at most one candidate per epoch."""
+    core, sd, *_ = _make_state(tmp_path, "voter")
+    d = ServeDaemon(core, ServeConfig(),
+                    cluster=ClusterConfig(node_id="V", role="follower"))
+    applied = core.applied_seqno
+    assert d.grant_vote(1, "A", applied + 5)
+    assert not d.grant_vote(1, "B", applied + 5)   # same epoch: taken
+    assert d.grant_vote(1, "A", applied + 5)       # idempotent re-ask
+    assert d.grant_vote(2, "B", applied + 5)       # later epoch: fresh
+    assert not d.grant_vote(1, "C", applied + 5)   # stale epoch
+    assert not d.grant_vote(3, "C", applied - 1) if applied else True
+    core.close()
+
+
+def test_vote_refused_by_leader_and_by_fresh_stream(tmp_path):
+    """A live leader refuses to vote itself out, and a follower whose
+    stream is FRESH refuses too — which is exactly what stops a
+    symmetric-partitioned candidate from promoting while the leader
+    still serves the voter."""
+    lead, fol, lsd, fsd = _replicated_cluster(tmp_path, failover_s=30.0)
+    try:
+        # wait for the stream to carry its first frame: freshness is
+        # what the refusal keys on
+        _wait_until(lambda: fol.replicator is not None
+                    and fol.replicator.stream_age_s() is not None,
+                    what="first stream frame")
+        seq = lead.core.applied_seqno + 10
+        # over the wire, like a real candidate would ask
+        assert not request_vote(lsd, lead.core.epoch + 1, "X", seq)
+        assert not request_vote(fsd, fol.core.epoch + 1, "X", seq)
+        assert lead.votes_refused >= 1 and fol.votes_refused >= 1
+    finally:
+        lead.shutdown()
+        fol.shutdown()
+
+
+def test_failover_election_collects_votes_no_dual_leader(tmp_path):
+    """1 leader + 2 followers; kill the leader.  The winning candidate
+    must collect the other follower's vote before promoting — the
+    cluster converges to EXACTLY one leader, and no epoch ever saw two
+    (each voter granted its epoch once)."""
+    lcore, lsd, tail, head = _make_state(tmp_path, "lead")
+    dirs = {"F0": str(tmp_path / "f0"), "F1": str(tmp_path / "f1")}
+    lead = ServeDaemon(
+        lcore, ServeConfig(),
+        cluster=ClusterConfig(node_id="L", role="leader",
+                              peers=list(dirs.values()), hb_s=0.05,
+                              failover_s=0.6, poll_timeout_s=1.0)).start()
+    lh, lp = lead.address
+    fols = {}
+    for nid, fsd in dirs.items():
+        bootstrap_state_dir(fsd, lh, lp)
+        peers = [lsd] + [d for d in dirs.values() if d != fsd]
+        fols[nid] = ServeDaemon(
+            ServeCore.open(fsd), ServeConfig(),
+            cluster=ClusterConfig(node_id=nid, role="follower",
+                                  peers=peers, hb_s=0.05,
+                                  failover_s=0.6,
+                                  poll_timeout_s=1.0)).start()
+    try:
+        _wait_until(lambda: lead.hub.follower_count() == 2,
+                    what="both followers attached")
+        with ServeClient(lh, lp) as c:
+            for i in range(4):
+                c.insert([(i, i + 7)])
+        _abrupt_kill(lead)
+        _wait_until(lambda: any(f.role == "leader"
+                                for f in fols.values()),
+                    what="promotion")
+        time.sleep(0.5)  # let any second candidate try (and fail)
+        leaders = [f for f in fols.values() if f.role == "leader"]
+        assert len(leaders) == 1, "dual leader"
+        winner = leaders[0]
+        loser = next(f for f in fols.values() if f is not winner)
+        assert winner.core.epoch == 1
+        # no dual-leader EPOCH: the loser never promoted into epoch 1,
+        # and the voter granted epoch 1 exactly once
+        assert loser.core.epoch <= 1 and loser.role == "follower"
+        grants = [e for e in loser.config.events
+                  if e[0] == "vote_granted"]
+        assert len(grants) <= 1
+        assert winner.core.applied_seqno == 4  # zero acked loss
+    finally:
+        for f in fols.values():
+            f.shutdown()

@@ -1,0 +1,387 @@
+"""Multi-tenant serving tests (ISSUE 11): batched-verb grammar held
+bit-identical to the scalar path by property, tenant isolation (one
+tenant's inserts never move another's tree), governor-priced eviction
+with bit-identical lazy restore, kill-at-every-boundary across an
+eviction cycle, and the spec grammar."""
+
+import os
+import zlib
+
+import numpy as np
+import pytest
+
+from sheep_tpu import INVALID_PART
+from sheep_tpu.io import faultfs
+from sheep_tpu.io.edges import write_dat
+from sheep_tpu.resources.governor import (ResourceGovernor,
+                                          serve_tenant_nbytes)
+from sheep_tpu.serve import faults as serve_faults
+from sheep_tpu.serve import (ServeClient, ServeConfig, ServeCore,
+                             ServeDaemon, ServeError, TenantManager,
+                             TenantSpec, UnknownTenant,
+                             parse_tenant_specs)
+from sheep_tpu.serve.protocol import BadRequest, parse_vids, \
+    parse_vids_batch
+from sheep_tpu.serve.tenants import DEFAULT_TENANT
+from sheep_tpu.utils.synth import rmat_edges
+
+
+@pytest.fixture(autouse=True)
+def _clean_fault_plans():
+    faultfs.clear_plan()
+    serve_faults.clear_plan()
+    yield
+    faultfs.clear_plan()
+    serve_faults.clear_plan()
+
+
+def _graph(tmp_path, name, seed):
+    tail, head = rmat_edges(7, 4 << 7, seed=seed)
+    g = str(tmp_path / f"{name}.dat")
+    write_dat(g, tail, head)
+    return g, tail, head
+
+
+def _two_tenant_daemon(tmp_path, **mgr_kw):
+    g0, *_ = _graph(tmp_path, "g0", 5)
+    g1, *_ = _graph(tmp_path, "g1", 9)
+    core = ServeCore.bootstrap(str(tmp_path / "dflt"), graph_path=g0,
+                               num_parts=3)
+    mgr = TenantManager(
+        core, [TenantSpec("t1", str(tmp_path / "t1"), g1, 3)], **mgr_kw)
+    d = ServeDaemon(core, ServeConfig(), tenants=mgr).start()
+    return d, core, mgr
+
+
+# ---------------------------------------------------------------------------
+# batched-verb grammar: bit-identical to the scalar path, by property
+# ---------------------------------------------------------------------------
+
+
+def test_parse_vids_batch_matches_scalar_property():
+    rng = np.random.default_rng(7)
+    for _ in range(50):
+        n = int(rng.integers(1, 40))
+        args = [str(int(v)) for v in rng.integers(0, 10 ** 6, size=n)]
+        assert parse_vids_batch(args).tolist() == parse_vids(args)
+
+
+def test_parse_vids_batch_bad_token_position():
+    with pytest.raises(BadRequest, match=r"'x' at position 2"):
+        parse_vids_batch(["1", "2", "x", "4"])
+    with pytest.raises(BadRequest, match="position 1"):
+        parse_vids_batch(["0", "-3"])
+    with pytest.raises(BadRequest, match="expected vertex ids"):
+        parse_vids_batch([])
+    # a valid-but-oversized id clamps to an absent sentinel, like the
+    # scalar path answered it
+    assert parse_vids_batch([str(10 ** 25)])[0] == (1 << 63) - 1
+
+
+def test_batched_verbs_bit_identical_to_scalar(tmp_path):
+    """The acceptance property: for random vid lists (present, absent,
+    and out-of-range mixed), the batched PART/PARENT/SUBTREE wire
+    responses equal the response the scalar path composes."""
+    g, tail, head = _graph(tmp_path, "g", 3)
+    core = ServeCore.bootstrap(str(tmp_path / "s"), graph_path=g,
+                               num_parts=4)
+    core.insert(np.array([[2, 9], [400, 401]], np.uint32))  # grow vids
+    d = ServeDaemon(core, ServeConfig()).start()
+    try:
+        h, p = d.address
+        rng = np.random.default_rng(11)
+        hi = len(core.parts) + 50
+        with ServeClient(h, p) as c:
+            for _ in range(20):
+                n = int(rng.integers(1, 64))
+                vids = [int(v) for v in rng.integers(0, hi, size=n)]
+                # PART: scalar compose vs batch response
+                want = "OK " + " ".join(str(core.part(v)) for v in vids)
+                got = c.request("PART " + " ".join(map(str, vids)))
+                assert got == want
+                # PARENT: scalar tokens vs batch response
+                toks = []
+                for v in vids:
+                    pv = core.parent_vid(v)
+                    toks.append("absent" if pv is None else str(pv))
+                got = c.request("PARENT " + " ".join(map(str, vids)))
+                assert got == "OK " + " ".join(toks)
+                # SUBTREE: batch form vs scalar subtree()
+                sts = [core.subtree(v) for v in vids]
+                if len(vids) == 1:
+                    want = (f"OK size={sts[0][0]} pst={sts[0][1]}"
+                            if sts[0] is not None else None)
+                    got = c.request(f"SUBTREE {vids[0]}")
+                    if want is None:
+                        assert got.startswith("ERR notfound")
+                    else:
+                        assert got == want
+                else:
+                    want = "OK " + " ".join(
+                        "absent" if st is None else f"{st[0]}:{st[1]}"
+                        for st in sts)
+                    assert c.request(
+                        "SUBTREE " + " ".join(map(str, vids))) == want
+            # bad tokens are typed with their position, nothing answered
+            with pytest.raises(ServeError) as ei:
+                c.part(["7", "nope"])
+            assert ei.value.code == "badreq"
+            assert "position 1" in ei.value.detail
+    finally:
+        d.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# tenant grammar + selection
+# ---------------------------------------------------------------------------
+
+
+def test_parse_tenant_specs_grammar():
+    specs = parse_tenant_specs("a=/x/a,b=/x/b:/g/b.dat,c=/x/c:/g/c.dat:8")
+    assert [(s.name, s.state_dir, s.graph, s.num_parts)
+            for s in specs] == [
+        ("a", "/x/a", None, 2),
+        ("b", "/x/b", "/g/b.dat", 2),
+        ("c", "/x/c", "/g/c.dat", 8)]
+    for bad in ("noeq", "=dir", "a=", "default=/x", "a=/x,a=/y"):
+        with pytest.raises(ValueError):
+            parse_tenant_specs(bad)
+
+
+def test_tenant_selector_and_isolation(tmp_path):
+    """Insert into tenant A never moves tenant B's tree CRC, and the
+    selector is connection-scoped (a second connection still sees the
+    default)."""
+    d, core, mgr = _two_tenant_daemon(tmp_path)
+    try:
+        h, p = d.address
+        with ServeClient(h, p) as c, ServeClient(h, p) as c2:
+            assert c.tenant("t1") == "t1"
+            dflt_crc = core.state_crc()
+            c.insert([(3, 9), (2, 7)])
+            c.insert([(1, 8)])
+            # tenant B (default) untouched, bit for bit
+            assert core.state_crc() == dflt_crc
+            assert core.applied_seqno == 0
+            assert mgr.get("t1").core.applied_seqno == 2
+            # the OTHER connection still talks to the default
+            assert c2.kv("STATS")["applied_seqno"] == 0
+            st = c.kv("STATS")
+            assert st["tenant"] == "t1" and st["applied_seqno"] == 2
+            assert st["tenants"] == 2
+            with pytest.raises(ServeError) as ei:
+                c.tenant("ghost")
+            assert ei.value.code == "notfound"
+            # selection survives the refusal (still t1)
+            assert c.kv("STATS")["tenant"] == "t1"
+    finally:
+        d.shutdown()
+
+
+def test_unknown_tenant_and_manager_api(tmp_path):
+    g0, *_ = _graph(tmp_path, "g0", 5)
+    core = ServeCore.bootstrap(str(tmp_path / "dflt"), graph_path=g0,
+                               num_parts=3)
+    mgr = TenantManager(core)
+    assert mgr.names() == [DEFAULT_TENANT]
+    with pytest.raises(UnknownTenant):
+        mgr.get("nope")
+    assert mgr.core_of(DEFAULT_TENANT) is core
+    assert not mgr.get(DEFAULT_TENANT).evictable()
+    core.close()
+
+
+# ---------------------------------------------------------------------------
+# eviction + lazy restore
+# ---------------------------------------------------------------------------
+
+
+def test_evict_restore_bit_identical(tmp_path):
+    """The acceptance: a cold tenant evicts to its sealed snapshot and
+    the next touch restores it with an identical tree CRC and equal
+    ECV(down)."""
+    d, core, mgr = _two_tenant_daemon(tmp_path)
+    try:
+        h, p = d.address
+        with ServeClient(h, p) as c:
+            c.tenant("t1")
+            c.insert([(3, 9), (2, 7)])
+            parts_before = c.part(list(range(80)))
+            ecv_before = c.kv("ECV")
+            crc_before = mgr.get("t1").core.state_crc()
+            assert c.request("EVICT t1") == "OK tenant=t1 resident=0"
+            assert not mgr.get("t1").resident
+            assert c.request("EVICT t1") == "OK tenant=t1 resident=0"
+            # next touch lazily restores, bit-identical
+            assert c.part(list(range(80))) == parts_before
+            assert mgr.get("t1").resident
+            assert mgr.get("t1").restores == 1
+            assert mgr.get("t1").core.state_crc() == crc_before
+            assert c.kv("ECV") == ecv_before
+            # the default tenant never evicts
+            with pytest.raises(ServeError) as ei:
+                c.kv("EVICT default")
+            assert ei.value.code == "badreq"
+    finally:
+        d.shutdown()
+
+
+def test_pressure_evicts_coldest_tenant(tmp_path):
+    """SHEEP_SERVE_MAX_RESIDENT caps resident tenants: touching a third
+    tenant evicts the least-recently-touched named one (never the
+    default), and the governor pricing is monotone in state size."""
+    g0, *_ = _graph(tmp_path, "g0", 5)
+    g1, *_ = _graph(tmp_path, "g1", 9)
+    g2, *_ = _graph(tmp_path, "g2", 13)
+    core = ServeCore.bootstrap(str(tmp_path / "dflt"), graph_path=g0,
+                               num_parts=3)
+    mgr = TenantManager(
+        core,
+        [TenantSpec("t1", str(tmp_path / "t1"), g1, 3),
+         TenantSpec("t2", str(tmp_path / "t2"), g2, 3)],
+        max_resident=2)
+    d = ServeDaemon(core, ServeConfig(), tenants=mgr).start()
+    try:
+        h, p = d.address
+        with ServeClient(h, p) as c:
+            c.tenant("t1")
+            c.insert([(1, 5)])
+            c.tenant("t2")
+            c.insert([(2, 6)])  # 3 resident > cap: t1 (coldest) evicts
+            assert not mgr.get("t1").resident
+            assert mgr.get("t2").resident
+            assert mgr.get(DEFAULT_TENANT).resident
+    finally:
+        d.shutdown()
+    assert serve_tenant_nbytes(100, 200, 10) \
+        < serve_tenant_nbytes(1000, 2000, 10)
+
+
+def test_kill_at_every_boundary_across_evict_restore(tmp_path):
+    """Kill-at-every-boundary green across an eviction and a lazy
+    restore: for every WAL/apply/snap boundary of the cycle, the
+    killed state reopens bit-identical to the oracle (fresh rebuild
+    over the same inserts)."""
+    g1, tail, head = _graph(tmp_path, "g1", 9)
+    sd = str(tmp_path / "t1")
+
+    def run_cycle(kill_plan=None, io_plan=None):
+        """insert 2 batches -> evict(seal) -> restore -> 1 more insert,
+        with an optional fault plan armed; returns the surviving dir's
+        reopened core CRC."""
+        import shutil
+        shutil.rmtree(sd, ignore_errors=True)
+        faultfs.clear_plan()
+        serve_faults.clear_plan()
+        core = ServeCore.bootstrap(sd, graph_path=g1, num_parts=3)
+        if kill_plan:
+            serve_faults.install_plan(
+                serve_faults.parse_serve_fault_plan(kill_plan,
+                                                    kill_mode="raise"))
+        if io_plan:
+            faultfs.install_plan(faultfs.parse_io_fault_plan(io_plan))
+        try:
+            core.insert(np.array([[3, 9]], np.uint32))
+            core.insert(np.array([[2, 7]], np.uint32))
+            core.seal_snapshot()   # the evict boundary
+            core.close()
+            restored = ServeCore.open(sd)
+            restored.insert(np.array([[1, 8]], np.uint32))
+            restored.close()
+        except (serve_faults.ServeKilled, OSError):
+            pass
+        finally:
+            faultfs.clear_plan()
+            serve_faults.clear_plan()
+        re2 = ServeCore.open(sd)
+        crc = re2.state_crc()
+        applied = re2.applied_seqno
+        re2.close()
+        return crc, applied
+
+    # clean cycle: the oracle
+    clean_crc, clean_applied = run_cycle()
+    assert clean_applied == 3
+    # kill at each insert-lifecycle boundary and at the seal: every
+    # survivor reopens to a valid prefix of the oracle's history
+    prefixes = {}
+    for seqno in (1, 2, 3):
+        import shutil
+        shutil.rmtree(sd, ignore_errors=True)
+        c = ServeCore.bootstrap(sd, graph_path=g1, num_parts=3)
+        for rec in [[3, 9], [2, 7], [1, 8]][:seqno]:
+            c.insert(np.array([rec], np.uint32))
+        prefixes[seqno] = c.state_crc()
+        c.close()
+    for plan, io_plan in [("kill@wal:0", None), ("kill@apply:0", None),
+                          ("kill@wal:1", None), ("kill@apply:1", None),
+                          ("kill@wal:2", None), ("kill@apply:2", None),
+                          (None, "enospc@snap:0")]:
+        crc, applied = run_cycle(kill_plan=plan, io_plan=io_plan)
+        assert applied in prefixes, (plan, io_plan, applied)
+        assert crc == prefixes[applied], (plan, io_plan, applied)
+
+
+def test_evict_refused_with_replication_attached(tmp_path):
+    """A tenant with attached follower streams refuses eviction typed
+    (evicting it would strand the streams)."""
+    d, core, mgr = _two_tenant_daemon(tmp_path)
+    try:
+        t = mgr.get("t1")
+        mgr.core_of("t1")
+
+        class FakeHub:
+            core = None
+
+            def follower_count(self):
+                return 1
+
+        t.hub = FakeHub()
+        assert not t.evictable()
+        h, p = d.address
+        with ServeClient(h, p) as c:
+            with pytest.raises(ServeError) as ei:
+                c.kv("EVICT t1")
+            assert ei.value.code == "unavailable"
+        t.hub = None
+    finally:
+        d.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# per-tenant observability
+# ---------------------------------------------------------------------------
+
+
+def test_per_tenant_metric_labels(tmp_path):
+    d, core, mgr = _two_tenant_daemon(tmp_path)
+    try:
+        h, p = d.address
+        with ServeClient(h, p) as c:
+            c.part([0, 1])
+            c.tenant("t1")
+            c.part([0, 1])
+            c.insert([(1, 2)])
+            body = c.metrics()
+        assert ('sheep_serve_tenant_requests_total'
+                '{tenant="default",verb="PART"} 1') in body
+        assert ('sheep_serve_tenant_requests_total'
+                '{tenant="t1",verb="PART"} 1') in body
+        assert 'sheep_serve_tenant_resident{tenant="t1"} 1' in body
+        assert 'sheep_serve_tenant_applied_seqno{tenant="t1"} 1' in body
+        # the PR-10 unlabeled series is untouched by multi-tenancy
+        assert 'sheep_serve_requests_total{verb="PART"} 2' in body
+    finally:
+        d.shutdown()
+
+
+def test_state_crc_is_a_real_fingerprint(tmp_path):
+    g, *_ = _graph(tmp_path, "g", 3)
+    core = ServeCore.bootstrap(str(tmp_path / "s"), graph_path=g,
+                               num_parts=3)
+    c1 = core.state_crc()
+    assert c1 == core.state_crc()  # stable
+    core.insert(np.array([[5, 11]], np.uint32))
+    assert core.state_crc() != c1  # sensitive
+    assert isinstance(zlib.crc32(b""), int)
+    core.close()

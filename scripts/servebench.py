@@ -88,24 +88,12 @@ def _spawn(state_dir, *args, env_extra=None, module="sheep_tpu.cli.serve"):
 
 
 def _proc_capture(pid) -> dict:
-    """Per-process accounting from /proc/<pid>/status: who ran where,
-    with what memory — embedded per router/daemon/client so a future
-    multi-core record needs no archaeology to retire one-core caveats."""
-    rec = {"pid": pid}
-    try:
-        with open(f"/proc/{pid}/status") as f:
-            for line in f:
-                key = line.split(":", 1)[0]
-                if key in ("VmRSS", "VmHWM", "Threads",
-                           "Cpus_allowed_list"):
-                    rec[key.lower()] = line.split(":", 1)[1].strip()
-    except OSError as exc:
-        rec["error"] = str(exc)
-    try:
-        rec["affinity_cores"] = sorted(os.sched_getaffinity(pid))
-    except (AttributeError, OSError):
-        pass
-    return rec
+    """Per-process accounting — the shared ``obs.metrics.proc_status``
+    reader (ISSUE 12: the same fields now ride every METRICS payload as
+    ``sheep_process_*`` gauges; the bench keeps capturing OTHER pids so
+    a record still proves who ran where without scraping each)."""
+    from sheep_tpu.obs.metrics import proc_status
+    return proc_status(pid)
 
 
 def _addr(state_dir, timeout=60.0):

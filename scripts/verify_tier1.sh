@@ -136,7 +136,17 @@ def run(name, plan=None):
         faultfs.install_plan(faultfs.parse_io_fault_plan(plan))
     cfg = SupervisorConfig(workers=2, poll_s=0.01, backoff_base_s=0.0,
                            grammar=False)
-    m = run_supervised(graph, f"{s}/{name}", cfg, runner=InlineRunner(0.05))
+    try:
+        m = run_supervised(graph, f"{s}/{name}", cfg,
+                           runner=InlineRunner(0.05))
+    except DiskExhausted:
+        # with 2 inline workers the nth-sidecar fault index races: it
+        # may land on the SUPERVISOR's own manifest write, which is a
+        # typed resumable abort by the PR-5 contract — resume clean
+        # (test_iofaults sweeps every site deterministically)
+        faultfs.clear_plan()
+        m = run_supervised(graph, f"{s}/{name}", cfg,
+                           runner=InlineRunner(0.05))
     faultfs.clear_plan()
     with open(m.final_tree, "rb") as f:
         return f.read()
@@ -499,15 +509,19 @@ then
 fi
 # -------------------------------------------------------------------------
 
-# --- fleet smoke (multi-tenant serving + router, ISSUE 11) ---------------
-# A replicated cluster hosting 2 tenants behind a bin/route process:
-# route queries+inserts to BOTH tenants, kill -9 the backing leader,
-# assert failover-through-router with zero acked-insert loss, restore
-# write quorum via the rejoined ex-leader, and scrape per-tenant METRICS
-# labels through the router.  Seconds of work; a regression anywhere in
-# the tenant/router stack fails the gate before pytest even runs.
+# --- fleet smoke (multi-tenant serving + router + observatory, ISSUES 11/12)
+# A replicated cluster hosting 2 tenants behind a bin/route process, each
+# process flight-recorded (SHEEP_TRACE): route queries+inserts to BOTH
+# tenants, kill -9 the backing leader, assert failover-through-router
+# with zero acked-insert loss, restore write quorum via the rejoined
+# ex-leader — then assert the OBSERVATORY: `sheep trace --merge` stitches
+# ONE rid across router + dead leader + promoted follower, the router's
+# fleet scrape carries per-instance/cluster labels + derived gauges, and
+# `sheep top --json` renders the per-tenant table.  Seconds of work; a
+# regression anywhere in the tenant/router/observatory stack fails the
+# gate before pytest even runs.
 if ! python - <<'EOF'
-import os, signal, subprocess, sys, tempfile, time
+import json, os, signal, subprocess, sys, tempfile, time
 REPO = os.getcwd()
 sys.path.insert(0, REPO)
 from sheep_tpu.io.edges import write_dat
@@ -518,6 +532,8 @@ work = tempfile.mkdtemp()
 tail, head = rmat_edges(7, 4 << 7, seed=31)
 write_dat(work + "/g.dat", tail, head)
 lead_d, fol_d, route_d = work + "/lead", work + "/fol", work + "/route"
+tdir = work + "/tr"
+os.makedirs(tdir)
 env = dict(os.environ)
 env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
 env["SHEEP_SERVE_REPL_HB_S"] = "0.1"
@@ -533,21 +549,24 @@ def addr(d, name="serve.addr", timeout=60.0):
             time.sleep(0.05)
     raise SystemExit(f"{d}/{name} never appeared")
 
-def spawn(mod, d, *args):
+def spawn(mod, d, *args, trace=None):
+    e = dict(env)
+    if trace:
+        e["SHEEP_TRACE"] = f"{tdir}/{trace}.trace"
     return subprocess.Popen(
-        [sys.executable, "-m", mod, "-d", d, *args], env=env, cwd=REPO)
+        [sys.executable, "-m", mod, "-d", d, *args], env=e, cwd=REPO)
 
 lead = spawn("sheep_tpu.cli.serve", lead_d, "-g", work + "/g.dat",
              "-k", "3", "--role", "leader", "--node-id", "lead",
              "--peers", fol_d, "--tenant",
-             f"web={work}/lead-web:{work}/g.dat:3")
+             f"web={work}/lead-web:{work}/g.dat:3", trace="lead")
 addr(lead_d)
 fol = spawn("sheep_tpu.cli.serve", fol_d, "--role", "follower",
             "--node-id", "fol", "--peers", lead_d,
-            "--tenant", f"web={work}/fol-web")
+            "--tenant", f"web={work}/fol-web", trace="fol")
 addr(fol_d)
 router = spawn("sheep_tpu.cli.route", route_d,
-               "--cluster", f"{lead_d},{fol_d}")
+               "--cluster", f"{lead_d},{fol_d}", trace="router")
 rh, rp = addr(route_d, name="router.addr")
 c = connect_retry(rh, rp, timeout_s=60)
 # both tenants reachable and streaming before the kill
@@ -606,20 +625,66 @@ while time.monotonic() < deadline:
     time.sleep(0.1)
 c.insert([(int(tail[7]), int(head[2]))])
 assert c.kv("STATS")["applied_seqno"] == acked["web"] + 1
-# per-tenant labels in the METRICS scrape, fetched through the router
+
+# --- the observatory half (ISSUE 12) ---
+# (1) the router's METRICS is now the FLEET scrape: per-member series
+# carry instance/cluster labels, tenant labels ride through, the
+# derived fleet gauges and process self-accounting are present
+from sheep_tpu.obs.metrics import parse_prometheus
 body = c.metrics()
-assert 'sheep_serve_tenant_requests_total{tenant="web"' in body, body[:400]
-assert 'sheep_serve_tenant_resident{tenant="web"} 1' in body
-assert 'sheep_serve_requests_total{verb="PART"}' in body
+samples = parse_prometheus(body)
+def find(name, **want):
+    return [v for n, lb, v in samples if n == name
+            and all(lb.get(k) == w for k, w in want.items())]
+insts = {lb["instance"] for n, lb, v in samples
+         if n == "sheep_serve_epoch" and "instance" in lb}
+assert len(insts) >= 2, f"fleet scrape labeled {insts} instances"
+assert all(lb.get("cluster") == "c0" for n, lb, v in samples
+           if n == "sheep_serve_epoch" and "instance" in lb)
+assert find("sheep_serve_tenant_resident", tenant="web") != []
+assert find("sheep_fleet_members_reachable", cluster="c0")[0] >= 2
+assert find("sheep_fleet_tenant_resident_instances", tenant="web")
+assert find("sheep_process_vmrss_bytes") != []
+assert any(n == "sheep_serve_tenant_requests_total"
+           and lb.get("tenant") == "web" for n, lb, v in samples)
+# (2) sheep top --json renders the per-tenant table from that scrape
+top = subprocess.run(
+    [sys.executable, "-m", "sheep_tpu.cli.top", "-r", f"{rh}:{rp}",
+     "--json", "-i", "0"], env=env, cwd=REPO, capture_output=True)
+assert top.returncode == 0, top.stderr[:400]
+view = json.loads(top.stdout)
+assert "web" in view["tenants"], view["tenants"].keys()
+assert view["tenants"]["web"]["resident"] >= 1
 c.request("QUIT")
 c.close()
+# (3) the merged timeline: one rid spanning router + the DEAD leader +
+# the promoted follower (a pre-kill quorum-acked insert crossed all
+# three; the dead leader's trace has a legal torn tail)
+from sheep_tpu.obs.merge import (collect_trace_paths, estimate_offsets,
+                                 load_sources, merge_by_rid)
+sources = load_sources(collect_trace_paths([tdir]))
+assert len(sources) == 3, [s.path for s in sources]
+estimate_offsets(sources)
+rids = merge_by_rid(sources)
+spanning = {rid: {r["_src"] for r in recs} for rid, recs in rids.items()}
+full = [rid for rid, srcs in spanning.items()
+        if {"router", "lead", "fol"} <= srcs]
+assert full, f"no rid spans router+lead+fol: {spanning}"
+fol_names = {r["name"] for r in rids[full[0]] if r["_src"] == "fol"}
+assert "wal.fsync" in fol_names, fol_names  # the follower-side fsync
+# the CLI renders the same merge (exit 0, the rid in the output)
+mg = subprocess.run(
+    [sys.executable, "-m", "sheep_tpu.cli.trace", "--merge",
+     "--rid", full[0], tdir], env=env, cwd=REPO, capture_output=True)
+assert mg.returncode == 0, mg.stderr[:400]
+assert full[0] in mg.stdout.decode(), mg.stdout[:400]
 for p in (router, ex, fol):
     p.send_signal(signal.SIGTERM)
     p.wait(timeout=60)
 EOF
 then
-  echo "FLEET SMOKE FAILED: 2-tenant router failover lost acked inserts" \
-       "or per-tenant metrics" >&2
+  echo "FLEET SMOKE FAILED: 2-tenant router failover lost acked inserts," \
+       "per-tenant metrics, or the merged rid timeline" >&2
   exit 1
 fi
 # -------------------------------------------------------------------------

@@ -1,0 +1,273 @@
+"""sheep top: the fleet's live operator console (ISSUE 12).
+
+No reference counterpart — this is the read side of the router's fleet
+scrape (serve/router.py ``fleet_metrics``): one ``METRICS`` request to
+the router fans in every reachable cluster member, and this tool renders
+the result as a refreshing per-tenant table::
+
+    bin/top -d route-dir/              # router state dir (router.addr)
+    bin/top -r 127.0.0.1:7700          # explicit router (or daemon) addr
+    bin/top --json -i 0.5              # one machine-readable snapshot
+
+Columns (per tenant): the hosting cluster, current qps (counter delta
+between two scrapes), windowed p99 (the sliding-window gauge — CURRENT
+latency, not since-boot), max replication lag and epoch across the
+instances hosting the tenant, and how many instances hold it resident.
+An ``instances`` footer shows per-instance epoch/lag/RSS from the same
+scrape.
+
+``--json`` takes two scrapes ``-i`` seconds apart (default 1.0; 0 =
+single scrape, qps null) and prints one JSON object — what the tier-1
+smoke and scripts consume.  Interactive mode refreshes every ``-i``
+seconds (default 2) until ``-n`` iterations or Ctrl-C.
+
+Exit codes: 0 rendered, 1 unreachable/unparseable, 2 usage error.
+"""
+
+from __future__ import annotations
+
+import getopt
+import json
+import os
+import sys
+import time
+
+from ..obs.metrics import parse_prometheus
+from ..serve.protocol import ServeClient
+
+USAGE = ("USAGE: top [-r host:port | -d state-dir] [-i interval_s] "
+         "[-n iterations] [--json]")
+
+
+def resolve_addr(host_port: str | None,
+                 state_dir: str | None) -> tuple[str, int] | None:
+    if host_port:
+        host, _, port = host_port.rpartition(":")
+        try:
+            return (host or "127.0.0.1"), int(port)
+        except ValueError:
+            return None
+    if state_dir:
+        for name in ("router.addr", "serve.addr"):
+            try:
+                host, port = open(os.path.join(state_dir, name)) \
+                    .read().split()
+                return host, int(port)
+            except (OSError, ValueError):
+                continue
+    return None
+
+
+def fleet_view(samples) -> dict:
+    """Shape one scrape's samples into the per-tenant / per-instance
+    view the table renders.  Tenant residency series name the hosting
+    instances; lag/epoch roll up as max over those instances."""
+    tenants: dict[str, dict] = {}
+    instances: dict[str, dict] = {}
+
+    def tn(labels):
+        t = labels.get("tenant")
+        if t is None:
+            return None
+        return tenants.setdefault(
+            t, {"instances": [], "resident_on": [], "requests": 0.0,
+                "window_p99_ms": None, "applied_seqno": 0,
+                "cluster": None})
+
+    for name, labels, val in samples:
+        inst = labels.get("instance")
+        # fleet-DERIVED gauges keep their own cluster= label (that is
+        # the point of them), so they must not mint the instance row's
+        # cluster — the per-member/router series do
+        if inst and inst not in instances \
+                and not name.startswith("sheep_fleet_"):
+            instances[inst] = {"cluster": labels.get("cluster")}
+        if inst and inst not in instances:
+            continue
+        if name == "sheep_serve_epoch" and inst:
+            instances[inst]["epoch"] = int(val)
+        elif name == "sheep_serve_repl_lag_records" and inst \
+                and "node" not in labels:
+            instances[inst]["repl_lag"] = int(val)
+        elif name == "sheep_process_vmrss_bytes" and inst:
+            instances[inst]["vmrss_mb"] = round(val / (1 << 20), 1)
+        elif name == "sheep_serve_tenant_resident":
+            rec = tn(labels)
+            if rec is None:
+                continue
+            if inst and inst not in rec["instances"]:
+                rec["instances"].append(inst)
+                if rec["cluster"] is None:
+                    rec["cluster"] = labels.get("cluster")
+            if val >= 1 and inst:
+                rec["resident_on"].append(inst)
+        elif name == "sheep_serve_tenant_requests_total":
+            rec = tn(labels)
+            if rec is not None:
+                rec["requests"] += val
+        elif name == "sheep_serve_tenant_window_p99_seconds":
+            rec = tn(labels)
+            if rec is not None:
+                ms = round(val * 1000, 3)
+                if rec["window_p99_ms"] is None \
+                        or ms > rec["window_p99_ms"]:
+                    rec["window_p99_ms"] = ms
+        elif name == "sheep_serve_tenant_applied_seqno":
+            rec = tn(labels)
+            if rec is not None:
+                rec["applied_seqno"] = max(rec["applied_seqno"],
+                                           int(val))
+    for rec in tenants.values():
+        hosting = [instances.get(i, {}) for i in rec["instances"]]
+        rec["repl_lag"] = max((h.get("repl_lag", 0) for h in hosting),
+                              default=0)
+        rec["epoch"] = max((h.get("epoch", 0) for h in hosting),
+                           default=0)
+        rec["resident"] = len(rec["resident_on"])
+    fleet = {}
+    for name, labels, val in samples:
+        if name == "sheep_fleet_epoch_skew":
+            fleet.setdefault("epoch_skew", {})[
+                labels.get("cluster", "?")] = int(val)
+        elif name == "sheep_fleet_repl_lag_max_records":
+            fleet.setdefault("repl_lag_max", {})[
+                labels.get("cluster", "?")] = int(val)
+        elif name == "sheep_fleet_members_reachable":
+            fleet.setdefault("members_reachable", {})[
+                labels.get("cluster", "?")] = int(val)
+        elif name == "sheep_fleet_scrape_seconds":
+            fleet["scrape_s"] = val
+    return {"tenants": tenants, "instances": instances, "fleet": fleet}
+
+
+def qps_between(prev: dict, cur: dict, dt: float) -> None:
+    """Stamp per-tenant qps from two views' request-counter deltas."""
+    for t, rec in cur["tenants"].items():
+        before = prev["tenants"].get(t, {}).get("requests", 0.0)
+        rec["qps"] = round(max(0.0, rec["requests"] - before)
+                           / max(dt, 1e-9), 1)
+
+
+def render_table(view: dict, scrape_bytes: int) -> str:
+    head = (f"{'TENANT':<12} {'CLUSTER':<8} {'QPS':>8} {'P99w':>9} "
+            f"{'LAG':>5} {'EPOCH':>5} {'RES':>4} {'APPLIED':>9}")
+    lines = [head, "-" * len(head)]
+    for t, rec in sorted(view["tenants"].items()):
+        p99 = rec.get("window_p99_ms")
+        lines.append(
+            f"{t:<12} {rec.get('cluster') or '?':<8} "
+            f"{rec.get('qps', '-'):>8} "
+            f"{(f'{p99:.2f}ms' if p99 is not None else '-'):>9} "
+            f"{rec.get('repl_lag', 0):>5} {rec.get('epoch', 0):>5} "
+            f"{rec.get('resident', 0):>4} "
+            f"{rec.get('applied_seqno', 0):>9}")
+    lines.append("")
+    ihead = (f"{'INSTANCE':<22} {'CLUSTER':<8} {'EPOCH':>5} "
+             f"{'LAG':>5} {'RSS':>9}")
+    lines += [ihead, "-" * len(ihead)]
+    for inst, rec in sorted(view["instances"].items()):
+        rss = rec.get("vmrss_mb")
+        lines.append(
+            f"{inst:<22} {rec.get('cluster') or '?':<8} "
+            f"{rec.get('epoch', '-'):>5} {rec.get('repl_lag', '-'):>5} "
+            f"{(f'{rss}M' if rss is not None else '-'):>9}")
+    fleet = view["fleet"]
+    foot = [f"scrape: {scrape_bytes} bytes"]
+    if "scrape_s" in fleet:
+        foot.append(f"fan-in {fleet['scrape_s'] * 1000:.1f}ms")
+    if fleet.get("epoch_skew"):
+        skews = ", ".join(f"{c}={v}" for c, v in
+                          sorted(fleet["epoch_skew"].items()))
+        foot.append(f"epoch skew {skews}")
+    lines += ["", "  ".join(foot)]
+    return "\n".join(lines) + "\n"
+
+
+def snapshot(addr) -> tuple[dict, int]:
+    with ServeClient(addr[0], addr[1], timeout_s=30.0) as c:
+        body = c.metrics()
+    return fleet_view(parse_prometheus(body)), len(body)
+
+
+def main(argv: list[str] | None = None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    try:
+        opts, args = getopt.gnu_getopt(argv, "r:d:i:n:", ["json"])
+    except getopt.GetoptError as exc:
+        print(f"Unknown option character '{(exc.opt or '?')[:1]}'.")
+        return 2
+    host_port = state_dir = None
+    interval = None
+    iters = 0  # 0 = forever (interactive); --json always one shot
+    as_json = False
+    for o, a in opts:
+        if o == "-r":
+            host_port = a
+        elif o == "-d":
+            state_dir = a
+        elif o == "-i":
+            interval = float(a)
+        elif o == "-n":
+            iters = int(a)
+        elif o == "--json":
+            as_json = True
+    if args and host_port is None and state_dir is None:
+        host_port = args[0]
+        args = args[1:]
+    if args:
+        print(USAGE)
+        return 2
+    addr = resolve_addr(host_port, state_dir)
+    if addr is None:
+        print("top: no router address (-r host:port or -d state-dir "
+              "with a router.addr/serve.addr)", file=sys.stderr)
+        return 1
+
+    if as_json:
+        dt = 1.0 if interval is None else interval
+        try:
+            view, nbytes = snapshot(addr)
+            if dt > 0:
+                time.sleep(dt)
+                view2, nbytes = snapshot(addr)
+                qps_between(view, view2, dt)
+                view = view2
+        except (OSError, ConnectionError) as exc:
+            print(f"top: {addr[0]}:{addr[1]} unreachable ({exc})",
+                  file=sys.stderr)
+            return 1
+        view["scrape_bytes"] = nbytes
+        json.dump(view, sys.stdout, indent=2, sort_keys=True)
+        sys.stdout.write("\n")
+        return 0
+
+    dt = 2.0 if interval is None else max(0.1, interval)
+    prev = None
+    n = 0
+    try:
+        while True:
+            try:
+                view, nbytes = snapshot(addr)
+            except (OSError, ConnectionError) as exc:
+                print(f"top: {addr[0]}:{addr[1]} unreachable ({exc})",
+                      file=sys.stderr)
+                return 1
+            if prev is not None:
+                qps_between(prev, view, dt)
+            prev = view
+            sys.stdout.write("\x1b[2J\x1b[H" if n else "")
+            sys.stdout.write(
+                f"sheep top — {addr[0]}:{addr[1]}  "
+                f"{time.strftime('%H:%M:%S')}  (refresh {dt:g}s)\n\n")
+            sys.stdout.write(render_table(view, nbytes))
+            sys.stdout.flush()
+            n += 1
+            if iters and n >= iters:
+                return 0
+            time.sleep(dt)
+    except KeyboardInterrupt:
+        return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -19,6 +19,15 @@ Sections:
   timeline   top spans in time order, indented by nesting, with a text
              duration bar (the poor terminal's flame graph)
 
+``--merge`` (ISSUE 12) switches to the FLEET view: the arguments become
+dirs / globs / files naming many processes' traces, and the output is
+one timeline per rid stitched across them — router retry, the dead
+leader's final spans, the promoted leader's first fsync, one rid.
+Clock alignment is per-file (obs/merge.py): rid-paired containment when
+files share rids (offset reported with an honest ±bound), wall-clock
+meta otherwise (bound reported unknown).  ``--rid <hex>`` picks one
+request; ``-n`` caps how many rids render.
+
 Default read policy is ``repair``: a kill -9 mid-run leaves a torn
 trailing line by design (obs/trace.py), and the whole point of a flight
 recorder is reading the wreckage; ``-m strict`` refuses the tear for
@@ -36,7 +45,10 @@ from ..integrity.errors import IntegrityError
 from ..integrity.sidecar import POLICIES
 from ..obs.trace import read_trace, rollup
 
-USAGE = "USAGE: trace [-m strict|repair|trust] [--json] [-n N] file.trace"
+USAGE = ("USAGE: trace [-m strict|repair|trust] [--json] [-n N] "
+         "file.trace\n"
+         "       trace --merge [--rid RID] [--json] [-n N] "
+         "<dir|glob|file>...")
 
 #: timeline rows beyond this are elided (traces can carry one span per
 #: chunk round; the timeline is for orientation, the rollup for totals)
@@ -212,16 +224,54 @@ def summary_json(records: list[dict], torn: bool, path: str) -> dict:
     }
 
 
+def merge_main(args: list[str], mode: str, as_json: bool,
+               only_rid: str | None, max_rids: int) -> int:
+    """The ``--merge`` mode: stitch many processes' traces by rid."""
+    from ..obs.merge import (collect_trace_paths, estimate_offsets,
+                             load_sources, merge_by_rid, merged_json,
+                             render_merged)
+    paths = collect_trace_paths(args)
+    if not paths:
+        print(f"trace: no .trace files under {' '.join(args)!r}",
+              file=sys.stderr)
+        return 1
+    import warnings
+    try:
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")  # tears show in the render
+            sources = load_sources(paths, mode)
+    except (IntegrityError, OSError) as exc:
+        print(f"trace: {exc}", file=sys.stderr)
+        return 1
+    estimate_offsets(sources)
+    rids = merge_by_rid(sources)
+    if only_rid is not None and only_rid not in rids:
+        print(f"trace: rid {only_rid!r} appears in none of "
+              f"{len(paths)} file(s)", file=sys.stderr)
+        return 1
+    if as_json:
+        json.dump(merged_json(sources, rids, only_rid), sys.stdout,
+                  indent=2, sort_keys=True)
+        sys.stdout.write("\n")
+    else:
+        sys.stdout.write(render_merged(sources, rids, only_rid,
+                                       max_rids))
+    return 0
+
+
 def main(argv: list[str] | None = None) -> int:
     argv = sys.argv[1:] if argv is None else argv
     try:
-        opts, args = getopt.gnu_getopt(argv, "m:n:", ["json"])
+        opts, args = getopt.gnu_getopt(argv, "m:n:",
+                                       ["json", "merge", "rid="])
     except getopt.GetoptError as exc:
         print(f"Unknown option character '{(exc.opt or '?')[:1]}'.")
         return 2
     mode = "repair"  # a killed run's torn tail is the expected customer
     as_json = False
     max_rows = DEFAULT_ROWS
+    merge = False
+    only_rid = None
     for o, a in opts:
         if o == "-m":
             if a not in POLICIES:
@@ -231,8 +281,18 @@ def main(argv: list[str] | None = None) -> int:
             mode = a
         elif o == "--json":
             as_json = True
+        elif o == "--merge":
+            merge = True
+        elif o == "--rid":
+            only_rid = a
         elif o == "-n":
             max_rows = int(a)
+    if merge:
+        if not args:
+            print(USAGE)
+            return 2
+        return merge_main(args, mode, as_json, only_rid,
+                          max_rows if max_rows != DEFAULT_ROWS else 20)
     if len(args) != 1:
         print(USAGE)
         return 2

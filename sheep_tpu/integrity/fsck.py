@@ -174,9 +174,18 @@ def _fsck_trace(path: str, mode: str) -> str:
     as a JSON trace record; a torn trailing line — the kill -9 shape —
     is refused strict / reported truncatable in repair (same contract as
     the WAL); an unparseable line with intact records after it is
-    mid-file rot, refused in every mode."""
-    from ..obs.trace import read_trace
+    mid-file rot, refused in every mode.
 
+    Rotation chains (ISSUE 12): a ROTATED segment (``x.0001.trace``) had
+    its tail sealed at rotation, so a torn tail there is mid-chain
+    damage, not a kill — rotated segments are read strictly even under
+    repair (trust still trusts).  Only the newest (active) file of a
+    chain may legally be torn."""
+    from ..obs.trace import is_rotated_segment, read_trace
+
+    rotated = is_rotated_segment(path)
+    if rotated and mode != "trust":
+        mode = "strict"
     import warnings
     with warnings.catch_warnings():
         warnings.simplefilter("ignore")  # the tear shows in the detail
@@ -186,6 +195,8 @@ def _fsck_trace(path: str, mode: str) -> str:
     segments = sum(1 for r in records if r.get("k") == "meta")
     detail = (f"records={len(records)} spans={spans} events={events} "
               f"segments={segments}")
+    if rotated:
+        detail += " segment=rotated"
     if torn:
         detail += " torn_tail=truncatable"
     return detail

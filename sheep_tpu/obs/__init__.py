@@ -25,12 +25,17 @@ the ladder-rung decision explanation (governor price vs measured), and a
 text timeline — the precursor of the planner's ``plan --explain``.
 """
 
-from .metrics import Counter, Gauge, Histogram, Registry
-from .trace import (enabled, event, overlap_stats, read_trace, repair_trace,
-                    rollup, span, timed, trace_summary)
+from .metrics import (Counter, Gauge, Histogram, Registry,
+                      parse_prometheus, proc_status, relabel,
+                      set_process_gauges)
+from .trace import (current_rid, enabled, event, new_rid, overlap_stats,
+                    read_trace, read_trace_chain, repair_trace, rid_scope,
+                    rollup, span, timed, trace_segments, trace_summary)
 
 __all__ = [
     "Counter", "Gauge", "Histogram", "Registry",
-    "enabled", "event", "overlap_stats", "read_trace", "repair_trace",
-    "rollup", "span", "timed", "trace_summary",
+    "parse_prometheus", "proc_status", "relabel", "set_process_gauges",
+    "current_rid", "enabled", "event", "new_rid", "overlap_stats",
+    "read_trace", "read_trace_chain", "repair_trace", "rid_scope",
+    "rollup", "span", "timed", "trace_segments", "trace_summary",
 ]

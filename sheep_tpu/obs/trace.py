@@ -41,8 +41,10 @@ from __future__ import annotations
 
 import contextlib
 import io
+import itertools
 import json
 import os
+import re
 import threading
 import time
 import warnings
@@ -50,6 +52,105 @@ import warnings
 ENV = "SHEEP_TRACE"
 TRACE_SUFFIX = ".trace"
 TRACE_VERSION = 1
+
+#: rotation cap for long-lived daemons (ISSUE 12): when the active JSONL
+#: grows past this many megabytes it is renamed to a numbered segment
+#: (``x.trace`` -> ``x.0001.trace``) whose ``.sum`` is sealed on rotation,
+#: and a fresh active file continues the SAME clock (t keeps counting
+#: from the recorder's open, the new meta line repeats the original wall
+#: ``t0``) — readers concatenate the chain.  Unset/0 = never rotate.
+MAX_MB_ENV = "SHEEP_TRACE_MAX_MB"
+
+_SEG_RE = re.compile(r"\.(\d{4})\.trace$")
+
+
+def _segment_name(path: str, n: int) -> str:
+    base = path[:-len(TRACE_SUFFIX)] if path.endswith(TRACE_SUFFIX) \
+        else path
+    return f"{base}.{n:04d}{TRACE_SUFFIX}"
+
+
+def is_rotated_segment(path: str) -> bool:
+    """True for a rotation-sealed segment (``x.0001.trace``): its tail
+    was sealed at rotation, so a tear there is mid-chain damage — torn
+    tails are legal ONLY on the newest (active) file of a chain."""
+    return _SEG_RE.search(path) is not None
+
+
+def trace_segments(path: str) -> list[str]:
+    """The segment chain for an active trace path: rotated segments in
+    rotation order, then the active file itself (when it exists)."""
+    import glob as _glob
+    base = path[:-len(TRACE_SUFFIX)] if path.endswith(TRACE_SUFFIX) \
+        else path
+    segs = []
+    for p in _glob.glob(base + ".[0-9][0-9][0-9][0-9]" + TRACE_SUFFIX):
+        m = _SEG_RE.search(p)
+        if m:
+            segs.append((int(m.group(1)), p))
+    out = [p for _, p in sorted(segs)]
+    if os.path.exists(path):
+        out.append(path)
+    return out
+
+
+# -- request-id propagation (ISSUE 12) --------------------------------------
+#
+# A fleet request crosses processes (router -> leader -> follower fsync);
+# the rid is the join key that lets ``sheep trace --merge`` stitch their
+# trace files back into one timeline.  The rid rides a thread-local scope
+# so every span/event recorded inside it carries a top-level ``rid``
+# field — including spans the SAMPLER skipped around (the scope is set
+# whether or not the wrapping span recorded), and downstream spans the
+# request opens (WAL fsync, repartition kicks on the request thread).
+
+_rid_tl = threading.local()
+_RID_SEED = os.urandom(4).hex()
+_rid_counter = itertools.count(1)
+
+
+def new_rid() -> str:
+    """A compact process-unique request id: 8 random hex chars (the
+    process) + an 8-hex counter — cheaper than urandom per request and
+    unique across routers with overwhelming probability."""
+    return f"{_RID_SEED}{next(_rid_counter):08x}"
+
+
+def current_rid() -> str | None:
+    return getattr(_rid_tl, "rid", None)
+
+
+class _RidScope:
+    """Class-based (not generator-based) context manager: this sits on
+    the per-request hot path of router AND daemon, and the generator
+    protocol's ~1.5us/call was most of the wire-token overhead budget
+    (PERF_NOTES r10)."""
+
+    __slots__ = ("rid", "prev")
+
+    def __init__(self, rid: str | None):
+        self.rid = rid
+
+    def __enter__(self) -> "_RidScope":
+        if self.rid:
+            self.prev = getattr(_rid_tl, "rid", None)
+            _rid_tl.rid = self.rid
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        if self.rid:
+            _rid_tl.rid = self.prev
+        return False
+
+
+_NOOP_RID_SCOPE = _RidScope(None)
+
+
+def rid_scope(rid: str | None) -> "_RidScope":
+    """Attach ``rid`` to every span/event recorded by this thread inside
+    the scope (None = the shared no-op).  Nesting restores the outer rid
+    on exit."""
+    return _RidScope(rid) if rid else _NOOP_RID_SCOPE
 
 
 class _NoopSpan:
@@ -93,7 +194,7 @@ def _json_safe(v):
 class _Span:
     """One live span (enabled mode).  Created by TraceRecorder.span."""
 
-    __slots__ = ("rec", "name", "attrs", "id", "par", "t0")
+    __slots__ = ("rec", "name", "attrs", "id", "par", "t0", "rid")
 
     def __init__(self, rec: "TraceRecorder", name: str, attrs: dict):
         self.rec = rec
@@ -111,6 +212,7 @@ class _Span:
             stack = tl.stack = []
         self.par = stack[-1].id if stack else None
         self.id = rec._next_id()
+        self.rid = current_rid()
         stack.append(self)
         self.t0 = time.perf_counter()
         return self
@@ -151,11 +253,31 @@ class TraceRecorder:
         self._tl = threading.local()
         self._id = 0
         self._t0 = time.perf_counter()
+        self._wall0 = time.time()
         self._phases: dict[str, list] = {}  # name -> [count, total_s]
         self._events: dict[str, int] = {}   # name -> count
+        # rotation state (SHEEP_TRACE_MAX_MB): byte budget for the
+        # active file, current size, and the next segment number
+        # (continuing past any segments an earlier recorder left)
+        mb = os.environ.get(MAX_MB_ENV, "")
+        try:
+            self._max_bytes = int(float(mb) * (1 << 20)) if mb else 0
+        except ValueError:
+            warnings.warn(f"{MAX_MB_ENV}={mb!r} is not a number; "
+                          f"trace rotation disabled")
+            self._max_bytes = 0
+        try:
+            self._nbytes = os.path.getsize(path)
+        except OSError:
+            self._nbytes = 0
+        self._seg = 0
+        for p in trace_segments(path):
+            m = _SEG_RE.search(p)
+            if m:
+                self._seg = max(self._seg, int(m.group(1)))
         import sys
         self._emit({"k": "meta", "v": TRACE_VERSION, "pid": os.getpid(),
-                    "t0": time.time(),
+                    "t0": self._wall0,
                     "argv": [str(a) for a in sys.argv[:6]]})
 
     def _next_id(self) -> int:
@@ -166,6 +288,7 @@ class TraceRecorder:
     def _emit(self, rec: dict) -> None:
         line = json.dumps(rec, separators=(",", ":"),
                           default=_json_safe) + "\n"
+        seal = None
         with self._lock:
             f = self._f
             if f is None:
@@ -173,8 +296,59 @@ class TraceRecorder:
             try:
                 f.write(line)
                 f.flush()
+                self._nbytes += len(line)
             except (OSError, ValueError):
                 pass  # tracing must never break the traced build
+            if self._max_bytes and self._nbytes >= self._max_bytes:
+                seal = self._rotate_locked()
+        if seal is not None:
+            # the rotated segment's sidecar seals OUTSIDE the emit lock:
+            # the atomic writer's fault hooks may emit a trace event and
+            # re-enter _emit (the new active file absorbs it)
+            try:
+                from ..integrity.sidecar import write_sidecar
+                write_sidecar(seal)
+            except Exception:
+                pass  # an unsealed segment reads as an unsealed partial
+
+    def _rotate_locked(self) -> str | None:
+        """Rename the full active file to the next numbered segment and
+        reopen a fresh one continuing the SAME clock (t stays relative
+        to the recorder's open; the new meta repeats the original wall
+        t0 so readers align the chain as one timeline).  Returns the
+        rotated segment path for the caller to seal, or None."""
+        f = self._f
+        try:
+            f.flush()
+            os.fsync(f.fileno())
+        except (OSError, ValueError):
+            pass
+        with contextlib.suppress(Exception):
+            f.close()
+        self._f = None
+        self._seg += 1
+        seg = _segment_name(self.path, self._seg)
+        try:
+            os.replace(self.path, seg)
+        except OSError:
+            seg = None
+        try:
+            self._f = open(self.path, "a", encoding="ascii",
+                           errors="replace")
+        except OSError:
+            return seg  # rotation stands; further lines are dropped
+        self._nbytes = 0
+        line = json.dumps({"k": "meta", "v": TRACE_VERSION,
+                           "pid": os.getpid(), "t0": self._wall0,
+                           "seg": self._seg},
+                          separators=(",", ":")) + "\n"
+        try:
+            self._f.write(line)
+            self._f.flush()
+            self._nbytes += len(line)
+        except (OSError, ValueError):
+            pass
+        return seg
 
     def span(self, name: str, attrs: dict) -> _Span:
         return _Span(self, name, attrs)
@@ -183,23 +357,30 @@ class TraceRecorder:
         stack = getattr(self._tl, "stack", None)
         with self._lock:
             self._events[name] = self._events.get(name, 0) + 1
-        self._emit({"k": "ev", "name": name,
-                    "par": stack[-1].id if stack else None,
-                    "tid": threading.get_ident() & 0xFFFF,
-                    "t": round(time.perf_counter() - self._t0, 6),
-                    "a": {k: _json_safe(v) for k, v in attrs.items()}})
+        rec = {"k": "ev", "name": name,
+               "par": stack[-1].id if stack else None,
+               "tid": threading.get_ident() & 0xFFFF,
+               "t": round(time.perf_counter() - self._t0, 6),
+               "a": {k: _json_safe(v) for k, v in attrs.items()}}
+        rid = current_rid()
+        if rid is not None:
+            rec["rid"] = rid
+        self._emit(rec)
 
     def _write_span(self, sp: _Span, t0: float, dur: float) -> None:
         with self._lock:
             acc = self._phases.setdefault(sp.name, [0, 0.0])
             acc[0] += 1
             acc[1] += dur
-        self._emit({"k": "span", "name": sp.name, "id": sp.id,
-                    "par": sp.par,
-                    "tid": threading.get_ident() & 0xFFFF,
-                    "t": round(t0 - self._t0, 6),
-                    "dur": round(dur, 6),
-                    "a": {k: _json_safe(v) for k, v in sp.attrs.items()}})
+        rec = {"k": "span", "name": sp.name, "id": sp.id,
+               "par": sp.par,
+               "tid": threading.get_ident() & 0xFFFF,
+               "t": round(t0 - self._t0, 6),
+               "dur": round(dur, 6),
+               "a": {k: _json_safe(v) for k, v in sp.attrs.items()}}
+        if sp.rid is not None:
+            rec["rid"] = sp.rid
+        self._emit(rec)
 
     def summary(self) -> dict:
         """In-memory per-phase rollup: {name: {count, total_s}} plus
@@ -511,6 +692,22 @@ def repair_trace(path: str) -> int:
         f.flush()
         os.fsync(f.fileno())
     return size - clean_end
+
+
+def read_trace_chain(path: str, mode: str | None = None) -> list[dict]:
+    """Read a rotated segment chain as ONE record stream: every rotated
+    segment strictly (their tails were sealed at rotation — a tear there
+    is damage, not a kill), then the active file under ``mode`` (where a
+    torn tail is the legal kill -9 shape)."""
+    records: list[dict] = []
+    chain = trace_segments(path)
+    if not chain:
+        raise OSError(f"no trace file or segments at {path}")
+    for p in chain:
+        seg_mode = "strict" if p != path else mode
+        recs, _, _ = read_trace(p, seg_mode)
+        records.extend(recs)
+    return records
 
 
 def rollup(records: list[dict]) -> dict:

@@ -222,6 +222,12 @@ class ServeDaemon:
         self._m_tenant_requests = self.metrics.counter(
             "sheep_serve_tenant_requests_total",
             "requests by tenant and verb")
+        # per-tenant latency (ISSUE 12): `sheep top` renders a current
+        # per-tenant p99 from this histogram's sliding window; the
+        # per-verb series above stays the lifetime view scrapers built on
+        self._m_tenant_latency = self.metrics.histogram(
+            "sheep_serve_tenant_request_seconds",
+            "request latency by tenant")
         self.hub = ReplicationHub(core, send=self._send_async,
                                   close=self._abort_async,
                                   hb_s=self.cluster.hb_s,
@@ -901,36 +907,44 @@ class ServeDaemon:
         saw), ERR counter by code, and the per-tenant series.  A
         sampled ``serve.req`` span (SHEEP_TRACE_SAMPLE, obs/trace.py)
         wraps the whole thing so traces exist under load inside the
-        <2% overhead budget."""
+        <2% overhead budget; the request's RID= token (ISSUE 12) scopes
+        the whole handling, so the span AND every downstream span it
+        opens (WAL fsync, snapshot seal) carry the rid — including when
+        the sampler skips the serve.req span itself."""
         t0 = time.monotonic()
         tname = conn.tenant if conn is not None else DEFAULT_TENANT
-        with trace.sampled_span("serve.req") as sp:
-            resp, close = self._handle_one(text, conn)
-            toks = text.split(None, 2)
-            verb = toks[0].upper() if toks else "?"
-            if verb.startswith("DEADLINE=") and len(toks) > 1:
-                verb = toks[1].upper()
-            if resp.startswith("ERR badreq"):
-                verb = "BAD"  # unparseable lines don't mint verb series
-            sp.annotate(verb=verb, tenant=tname, ok=resp[:2] == "OK")
+        try:
+            req = parse_request(text)
+        except BadRequest as exc:
+            self.counters["requests"] += 1
+            self.counters["errors"] += 1
+            resp, close = err_line("badreq", str(exc)), False
+            verb = "BAD"  # unparseable lines don't mint verb series
+        else:
+            verb = req.verb
+            with trace.rid_scope(req.rid):
+                with trace.sampled_span("serve.req") as sp:
+                    resp, close = self._handle_one(req, conn, t0)
+                    if resp.startswith("ERR badreq"):
+                        verb = "BAD"  # bad requests don't mint series
+                    sp.annotate(verb=verb, tenant=tname,
+                                ok=resp[:2] == "OK")
         self._m_requests.labels(verb=verb).inc()
-        self._m_latency.labels(verb=verb).observe(time.monotonic() - t0)
+        dur = time.monotonic() - t0
+        self._m_latency.labels(verb=verb).observe(dur)
         self._m_tenant_requests.labels(tenant=tname, verb=verb).inc()
+        self._m_tenant_latency.labels(tenant=tname).observe(dur)
         if resp.startswith("ERR "):
             code = resp.split(None, 2)[1]
             self._m_errors.labels(code=code).inc()
         return resp, close
 
-    def _handle_one(self, text: str,
-                    conn: _Conn | None = None) -> tuple[str, bool]:
-        """One request -> (response line, close-connection?)."""
+    def _handle_one(self, req, conn: _Conn | None = None,
+                    t0: float | None = None) -> tuple[str, bool]:
+        """One parsed request -> (response line, close-connection?)."""
         self.counters["requests"] += 1
-        t0 = time.monotonic()
-        try:
-            req = parse_request(text)
-        except BadRequest as exc:
-            self.counters["errors"] += 1
-            return err_line("badreq", str(exc)), False
+        if t0 is None:
+            t0 = time.monotonic()
         budget = req.deadline_s if req.deadline_s is not None \
             else self.config.deadline_s
         deadline = t0 + budget
@@ -982,7 +996,8 @@ class ServeDaemon:
             raise
         except Exception as exc:  # the one place "internal" is honest
             self.counters["errors"] += 1
-            print(f"serve: internal error on {text!r}: "
+            print(f"serve: internal error on {req.verb} "
+                  f"{' '.join(req.args[:8])!r}: "
                   f"{type(exc).__name__}: {exc}", file=sys.stderr,
                   flush=True)
             return err_line("internal", f"{type(exc).__name__}: {exc}"), \
@@ -1084,7 +1099,8 @@ class ServeDaemon:
             pairs = [(vids[i], vids[i + 1])
                      for i in range(0, len(vids), 2)]
             import numpy as np
-            seqno = core.insert(np.asarray(pairs, dtype=np.uint32))
+            seqno = core.insert(np.asarray(pairs, dtype=np.uint32),
+                                rid=req.rid)
             if self.cluster.clustered and self.cluster.repl_acks > 0:
                 # the replication quorum: the OK means this insert is
                 # durable on repl_acks followers too, so failover to the
@@ -1188,6 +1204,35 @@ class ServeDaemon:
                 app.labels(tenant=name).set(t.core.applied_seqno)
             evg.labels(tenant=name).set(t.evictions)
             rsg.labels(tenant=name).set(t.restores)
+        # sliding-window latency gauges (ISSUE 12): what `sheep top`
+        # renders as CURRENT p50/p99 — the lifetime histogram series
+        # above are untouched for scrapers that integrate them
+        w50 = m.gauge("sheep_serve_window_p50_seconds",
+                      "sliding-window (~30s) p50 request latency by verb")
+        w99 = m.gauge("sheep_serve_window_p99_seconds",
+                      "sliding-window (~30s) p99 request latency by verb")
+        for key, child in sorted(self._m_latency.children().items()):
+            if not child.window_count():
+                continue
+            verb = dict(key).get("verb", "?")
+            w50.labels(verb=verb).set(
+                round(child.window_quantile(0.5), 6))
+            w99.labels(verb=verb).set(
+                round(child.window_quantile(0.99), 6))
+        tw99 = m.gauge("sheep_serve_tenant_window_p99_seconds",
+                       "sliding-window (~30s) p99 request latency by "
+                       "tenant")
+        for key, child in sorted(self._m_tenant_latency
+                                 .children().items()):
+            if not child.window_count():
+                continue
+            tw99.labels(tenant=dict(key).get("tenant", "?")).set(
+                round(child.window_quantile(0.99), 6))
+        # standard process self-accounting, refreshed on scrape (ISSUE
+        # 12 satellite: the accounting servebench used to capture from
+        # outside now rides every METRICS payload)
+        from ..obs.metrics import set_process_gauges
+        set_process_gauges(m, self.started_at)
         return m.render()
 
     def _metrics_response(self) -> str:
@@ -1243,6 +1288,14 @@ class ServeDaemon:
             verb = dict(key).get("verb", "?").lower()
             rec[f"p50_{verb}_ms"] = round(child.quantile(0.5) * 1000, 3)
             rec[f"p99_{verb}_ms"] = round(child.quantile(0.99) * 1000, 3)
+            # the sliding-window view (ISSUE 12): current latency for
+            # `sheep top`; the lifetime p50_/p99_ keys above are
+            # unchanged for existing scrapers
+            if child.window_count():
+                rec[f"w50_{verb}_ms"] = round(
+                    child.window_quantile(0.5) * 1000, 3)
+                rec[f"w99_{verb}_ms"] = round(
+                    child.window_quantile(0.99) * 1000, 3)
         return ok_kv(**rec)
 
     # -- status file (the dead-daemon face of STATS) -----------------------

@@ -4,7 +4,15 @@ Line-oriented text over a stream socket — trivially speakable from any
 language, ``nc``, or a shell heredoc, and every response is a SINGLE line
 so a reader never blocks mid-response.  Requests::
 
-    [DEADLINE=<seconds>] VERB [args...]
+    [DEADLINE=<seconds>] [RID=<hex>] VERB [args...]
+
+Prefix tokens (ISSUE 12) may appear in any order before the verb:
+``DEADLINE=`` is the per-request deadline (below), ``RID=`` is the
+trace-context id the router stamps so every process a request crosses
+records joinable spans (obs/merge.py), and UNKNOWN ``KEY=`` prefix
+tokens are skipped silently — a newer router may stamp tokens this
+daemon has never heard of and the request must still parse.  Requests
+carrying no prefix tokens are byte-identical to the PR-6 grammar.
 
     PART v [v...]        -> OK p [p...]          (-1 = vertex has no part)
     PARENT v [v...]      -> OK t [t...]   (t = <vid> | root | absent;
@@ -113,8 +121,6 @@ ADMIN_VERBS = ("SNAPSHOT", "REPARTITION", "EVICT", "QUIT")
 #: shedding it would turn an overload into a lag spiral
 REPL_VERBS = ("REPL",)
 
-_DEADLINE_PREFIX = "DEADLINE="
-
 #: protocol line-length cap: a request that does not fit is a bad request,
 #: not an invitation to buffer without bound
 MAX_LINE = 1 << 20
@@ -133,32 +139,69 @@ class Request:
     verb: str
     args: list[str] = field(default_factory=list)
     deadline_s: float | None = None  # None: the daemon default applies
+    rid: str | None = None           # trace-context id (RID= prefix token)
 
     @property
     def kind(self) -> str:
         return "insert" if self.verb in INSERT_VERBS else "query"
 
 
+#: rid charset: hex (what routers mint) plus ``-`` so foreign tracing
+#: systems can forward their ids; anything else is a typed badreq
+#: (compiled: per-request validation must price like a token)
+import re as _re
+_RID_RE = _re.compile(r"[0-9a-fA-F-]{1,64}\Z")
+MAX_RID_LEN = 64
+
+
+def split_prefix_tokens(toks: list[str]):
+    """The optional-prefix grammar (ISSUE 12): leading ``KEY=value``
+    tokens (KEY alphabetic) precede the verb.  ``DEADLINE=`` and
+    ``RID=`` are understood; UNKNOWN keys are skipped silently — a
+    newer router may stamp tokens this daemon has never heard of, and
+    the request must still parse (the grammar is byte-identical for
+    requests carrying no prefix tokens).  Returns ``(deadline, rid,
+    verb_index)``; raises BadRequest for malformed known tokens."""
+    deadline = None
+    rid = None
+    i = 0
+    for i, tok in enumerate(toks):
+        eq = tok.find("=")
+        if eq <= 0:
+            return deadline, rid, i
+        key = tok[:eq]
+        if not (key.isascii() and key.isalpha()):
+            return deadline, rid, i
+        val = tok[eq + 1:]
+        key = key.upper()
+        if key == "DEADLINE":
+            try:
+                deadline = float(val)
+            except ValueError:
+                raise BadRequest(f"unparseable deadline {val!r}")
+            if deadline < 0:
+                raise BadRequest(f"negative deadline {val!r}")
+        elif key == "RID":
+            if _RID_RE.match(val) is None:
+                raise BadRequest(f"unparseable request id {val!r}")
+            rid = val
+        # any other KEY= prefix token: ignored (forward compatibility)
+    return deadline, rid, len(toks)
+
+
 def parse_request(line: str) -> Request:
     toks = line.split()
     if not toks:
         raise BadRequest("empty request")
-    deadline = None
-    if toks[0].upper().startswith(_DEADLINE_PREFIX):
-        raw = toks[0][len(_DEADLINE_PREFIX):]
-        try:
-            deadline = float(raw)
-        except ValueError:
-            raise BadRequest(f"unparseable deadline {raw!r}")
-        if deadline < 0:
-            raise BadRequest(f"negative deadline {raw!r}")
-        toks = toks[1:]
-        if not toks:
-            raise BadRequest("deadline with no request")
+    deadline, rid, i = split_prefix_tokens(toks)
+    toks = toks[i:]
+    if not toks:
+        raise BadRequest("prefix token(s) with no request")
     verb = toks[0].upper()
     if verb not in QUERY_VERBS + INSERT_VERBS + ADMIN_VERBS + REPL_VERBS:
         raise BadRequest(f"unknown verb {toks[0]!r}")
-    return Request(verb=verb, args=toks[1:], deadline_s=deadline)
+    return Request(verb=verb, args=toks[1:], deadline_s=deadline,
+                   rid=rid)
 
 
 def parse_kv_args(args: list[str]) -> dict:

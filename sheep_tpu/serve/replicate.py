@@ -43,6 +43,7 @@ import time
 import zlib
 
 from ..integrity.errors import IntegrityError
+from ..obs import trace
 from . import netfaults
 from .protocol import BadRequest, parse_kv_args
 from .state import ReplicationGap, ServeCore, load_serve_snapshot
@@ -65,11 +66,19 @@ def payload_crc(payload: bytes) -> int:
     return zlib.crc32(payload) & 0xFFFFFFFF
 
 
-def encode_append(epoch: int, seqno: int, payload: bytes) -> str:
-    """One WAL record -> one APPEND frame line (no trailing newline)."""
+def encode_append(epoch: int, seqno: int, payload: bytes,
+                  rid: str | None = None) -> str:
+    """One WAL record -> one APPEND frame line (no trailing newline).
+    ``rid`` (ISSUE 12) forwards the originating request's trace-context
+    id so the follower's WAL fsync is attributable to it; the token is
+    omitted when absent, and old daemons ignore it either way (kv-token
+    grammar — unknown keys pass through parse_kv_args untouched)."""
     data = base64.b64encode(payload).decode("ascii")
-    return (f"REPL APPEND epoch={epoch} seqno={seqno} "
-            f"crc={payload_crc(payload)} data={data}")
+    head = f"REPL APPEND epoch={epoch} seqno={seqno} " \
+           f"crc={payload_crc(payload)}"
+    if rid is not None:
+        head += f" rid={rid}"
+    return f"{head} data={data}"
 
 
 def encode_ping(epoch: int, seqno: int) -> str:
@@ -208,6 +217,7 @@ class ReplApplier:
         self.bursts = 0  # sealed APPEND bursts (one fsync + one ACK each)
         self._unsynced = False  # applied-but-unsynced records in the WAL
         self._ack_due = False   # an APPEND landed since the last ACK
+        self._burst_rid: str | None = None  # newest rid in the open burst
 
     @property
     def lag(self) -> int:
@@ -250,11 +260,17 @@ class ReplApplier:
         """fsync the burst's deferred WAL tail, then send ONE cumulative
         ACK.  No-op when nothing is pending.  A failed fsync propagates
         with nothing acked — the stream dies and the reconnect re-syncs
-        from the durable position."""
+        from the durable position.  The seal's ``wal.fsync`` span
+        carries the burst's NEWEST rid (a one-record burst — the common
+        quorum-acked insert — is exactly attributed; multi-rid bursts
+        attribute the seal to their last request, with every per-record
+        rid still on the records' own apply spans)."""
         if self._unsynced:
-            self.core.wal_sync()  # may raise: nothing gets acked
+            with trace.rid_scope(self._burst_rid):
+                self.core.wal_sync()  # may raise: nothing gets acked
             self._unsynced = False
             self.bursts += 1
+        self._burst_rid = None
         if self._ack_due:
             self._ack_due = False
             self._send(encode_ack(self.core.applied_seqno))
@@ -289,9 +305,14 @@ class ReplApplier:
             self._on_epoch(epoch)
         self.leader_seqno = max(self.leader_seqno, frame.seqno())
         if frame.kind == "APPEND":
+            rid = frame.kv.get("rid")
             try:
-                out = self.core.apply_replicated(frame.seqno(),
-                                                 frame.payload, sync=False)
+                # rid scope (ISSUE 12): the apply's WAL append — and, on
+                # the sync=True path, its fsync — record under the
+                # originating request's id
+                with trace.rid_scope(rid):
+                    out = self.core.apply_replicated(
+                        frame.seqno(), frame.payload, sync=False, rid=rid)
             except ReplicationGap as gap:
                 self._seal_burst()
                 self.gaps += 1
@@ -302,6 +323,8 @@ class ReplApplier:
             else:
                 self.applied += 1
                 self._unsynced = True
+                if rid is not None:
+                    self._burst_rid = rid
             self._ack_due = True
             if not defer_ack:
                 self._seal_burst()
@@ -468,7 +491,8 @@ class ReplicationHub:
             for seqno, payload in recs:
                 if not fs.alive or self._stopped:
                     return
-                line = encode_append(self.core.epoch, seqno, payload)
+                line = encode_append(self.core.epoch, seqno, payload,
+                                     rid=self.core.rid_for(seqno))
                 if not self._transmit(fs, line, "repl"):
                     self.detach(fs.conn)
                     return

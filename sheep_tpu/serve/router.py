@@ -24,8 +24,17 @@ existing client (and ``nc``) works through the router unchanged:
                so a stale follower re-routes instead of lying).
   writes       INSERT / REPARTITION / SNAPSHOT / EVICT go to the
                cluster's current leader.
-  STATS/METRICS  pinned to the leader (the authoritative view).
+  STATS        pinned to the leader (the authoritative view).
+  METRICS      answered by the router itself (ISSUE 12): the FLEET
+               scrape — fan-in from every reachable cluster member
+               with instance/cluster labels + derived fleet gauges
+               (:meth:`Router.fleet_metrics`).
   ROUTER       answered by the router itself: per-router counters.
+
+**Trace context** (ISSUE 12): forwarded requests carry a ``RID=<hex>``
+prefix token (adaptive — see :data:`RID_ENV`) so every process the
+request crosses records joinable spans; ``sheep trace --merge``
+stitches them.
 
 **Failover contract** (the epoch-safe retry rule): a request that died
 with a TYPED refusal was not applied — ``notleader`` re-resolves and
@@ -46,22 +55,33 @@ import socket
 import threading
 import time
 
+from ..obs import trace
+from ..obs.metrics import (Registry, parse_prometheus, relabel,
+                           set_process_gauges)
 from .cluster import find_leader, resolve_peer
-from .protocol import ServeClient, ServeError, err_line, ok_kv
+from .protocol import (BadRequest, ServeClient, ServeError, err_line,
+                       ok_kv, split_prefix_tokens)
 from .tenants import DEFAULT_TENANT
 
 CLUSTERS_ENV = "SHEEP_ROUTE_CLUSTERS"
 VNODES_ENV = "SHEEP_ROUTE_VNODES"
+#: trace-context stamping (ISSUE 12).  Unset (the default) is ADAPTIVE:
+#: write verbs always carry a minted ``RID=`` token (the follower-fsync
+#: attribution chain is the point, and a WAL fsync dwarfs the token),
+#: while reads are stamped only when this router's own trace recorder
+#: is live — a read's rid is only readable through the router's span,
+#: so stamping it blind is pure wire+parse cost (PERF_NOTES r10).
+#: "1" forces stamping on every request; "0" disables minting entirely
+#: (client-sent RID= tokens always forward regardless).
+RID_ENV = "SHEEP_ROUTE_RID"
 
 ADDR_FILE = "router.addr"
 
 #: reads that spread across every cluster member
 SPREAD_VERBS = ("PART", "PARENT", "SUBTREE", "ECV", "PING")
-#: verbs pinned to the tenant's cluster leader
-LEADER_VERBS = ("INSERT", "REPARTITION", "SNAPSHOT", "EVICT", "STATS",
-                "METRICS")
-
-_DEADLINE_PREFIX = "DEADLINE="
+#: verbs pinned to the tenant's cluster leader (METRICS is NOT here
+#: anymore: the router answers it itself with the fleet scrape)
+LEADER_VERBS = ("INSERT", "REPARTITION", "SNAPSHOT", "EVICT", "STATS")
 
 
 class HashRing:
@@ -214,13 +234,22 @@ class Router:
         self.port = port
         self.state_dir = state_dir
         self.retries = retries
+        self.poll_timeout_s = poll_timeout_s
+        _rid_env = os.environ.get(RID_ENV, "")
+        self.rid_enabled = _rid_env != "0"
+        self.rid_always = _rid_env == "1"
         self._listener: socket.socket | None = None
         self._accept_thread: threading.Thread | None = None
         self._stop = threading.Event()
         self._lock = threading.Lock()
+        self.started_at = time.monotonic()
         self.counters = {"conns": 0, "requests": 0, "reads": 0,
                          "writes": 0, "retries": 0, "reroutes": 0,
-                         "errors": 0, "insert_unknown": 0}
+                         "errors": 0, "insert_unknown": 0,
+                         "scrapes": 0, "scrape_errors": 0}
+        # the router's own registry (ISSUE 12): its counters + process
+        # self-accounting ride the fleet scrape like any member's
+        self.metrics = Registry()
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -294,23 +323,60 @@ class Router:
                 if not text:
                     continue
                 self.counters["requests"] += 1
-                toks = text.split(None, 2)
-                verb = toks[0].upper()
-                if verb.startswith(_DEADLINE_PREFIX) and len(toks) > 1:
-                    verb = toks[1].upper()
+                # prefix-aware verb peek: DEADLINE=/RID=/unknown tokens
+                # may precede the verb (protocol.split_prefix_tokens);
+                # a malformed known token forwards as-is and gets the
+                # upstream's typed badreq
+                toks = text.split(None, 8)
+                rid = None
+                try:
+                    _, rid, vi = split_prefix_tokens(toks)
+                    verb = toks[vi].upper() if vi < len(toks) else ""
+                except BadRequest:
+                    verb, vi = toks[0].upper(), 0
                 if verb == "QUIT":
                     sock.sendall(b"OK bye\n")
                     return
                 if verb == "TENANT":
-                    tenant, resp = self._handle_tenant(toks, tenant)
+                    args = toks[vi + 1:] if vi + 1 <= len(toks) else []
+                    tenant, resp = self._handle_tenant(
+                        [verb] + args, tenant)
                     sock.sendall((resp + "\n").encode("ascii"))
                     continue
                 if verb == "ROUTER":
                     sock.sendall((self._router_stats(tenant) + "\n")
                                  .encode("ascii"))
                     continue
-                resp, payload = self._forward(text, verb, tenant,
-                                              upstreams)
+                if verb == "METRICS":
+                    # the fleet scrape (ISSUE 12): fan-in from every
+                    # reachable member, answered by the router itself
+                    try:
+                        body = self.fleet_metrics()
+                    except Exception as exc:
+                        sock.sendall((err_line(
+                            "internal", f"fleet scrape failed: {exc}")
+                            + "\n").encode("ascii"))
+                        continue
+                    sock.sendall(f"OK bytes={len(body)}\n"
+                                 .encode("ascii") + body)
+                    continue
+                # stamp the trace context (ISSUE 12): a client-sent RID
+                # wins; otherwise mint one so the whole fleet's spans
+                # for this request share a join key.  Reads are gated on
+                # the router's own recorder being live (RID_ENV note):
+                # a read rid nobody can record is wire+parse for nothing
+                fwd = text
+                if rid is None and self.rid_enabled and verb and \
+                        (verb not in SPREAD_VERBS or self.rid_always
+                         or trace.enabled()):
+                    rid = trace.new_rid()
+                    fwd = f"RID={rid} {text}"
+                with trace.rid_scope(rid):
+                    with trace.sampled_span("route.req") as sp:
+                        resp, payload = self._forward(fwd, verb, tenant,
+                                                      upstreams)
+                        sp.annotate(verb=verb, tenant=tenant,
+                                    ok=resp[:2] == "OK")
                 sock.sendall((resp + "\n").encode(
                     "ascii", errors="replace") + payload)
         except (OSError, ConnectionError):
@@ -343,6 +409,103 @@ class Router:
         rec["tenant"] = tenant
         rec["cluster"] = self.ring.lookup(tenant)
         return ok_kv(**rec)
+
+    # -- the fleet scrape (ISSUE 12) ---------------------------------------
+
+    def fleet_metrics(self) -> bytes:
+        """Fan-in ``METRICS`` from every reachable cluster member,
+        stamp each sample with ``instance``/``cluster`` labels (tenant
+        labels already ride the member series), derive the fleet gauges
+        a dashboard wants (max repl lag and epoch skew per cluster,
+        tenant residency counts, reachability), and prepend the
+        router's own counters + process self-accounting.  One scrape of
+        the router IS a scrape of the fleet."""
+        t0 = time.monotonic()
+        self.counters["scrapes"] += 1
+        members: list[tuple[str, tuple[str, int]]] = []
+        for cid, cluster in sorted(self.clusters.items()):
+            for addr in cluster.nodes():
+                members.append((cid, addr))
+        bodies: dict[tuple, str | None] = {}
+        lock = threading.Lock()
+
+        def scrape(cid, addr):
+            body = None
+            try:
+                with ServeClient(addr[0], addr[1],
+                                 timeout_s=self.poll_timeout_s) as c:
+                    body = c.metrics()
+            except Exception:
+                pass
+            with lock:
+                bodies[(cid, addr)] = body
+
+        threads = [threading.Thread(target=scrape, args=m, daemon=True)
+                   for m in members]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=self.poll_timeout_s * 2 + 5)
+
+        per_cluster = {cid: {"ok": 0, "bad": 0, "lags": [], "epochs": []}
+                       for cid in self.clusters}
+        tenant_res: dict[str, int] = {}
+        seen_headers: set = set()
+        member_parts: list[str] = []
+        for (cid, addr), body in sorted(bodies.items()):
+            acc = per_cluster[cid]
+            if body is None:
+                acc["bad"] += 1
+                self.counters["scrape_errors"] += 1
+                continue
+            acc["ok"] += 1
+            for name, labels, val in parse_prometheus(body):
+                if name == "sheep_serve_repl_lag_records" \
+                        and not labels:
+                    acc["lags"].append(val)
+                elif name == "sheep_serve_epoch":
+                    acc["epochs"].append(val)
+                elif name == "sheep_serve_tenant_resident" and val >= 1:
+                    tn = labels.get("tenant", "?")
+                    tenant_res[tn] = tenant_res.get(tn, 0) + 1
+            member_parts.append(relabel(
+                body, {"cluster": cid, "instance":
+                       f"{addr[0]}:{addr[1]}"}, seen_headers))
+
+        m = self.metrics
+        g = m.gauge
+        for k, v in sorted(self.counters.items()):
+            g(f"sheep_route_{k}", f"router {k} counter").set(v)
+        g("sheep_route_clusters",
+          "clusters behind this router").set(len(self.clusters))
+        reach = g("sheep_fleet_members_reachable",
+                  "members that answered this scrape, per cluster")
+        unreach = g("sheep_fleet_members_unreachable",
+                    "members that did not answer, per cluster")
+        lagg = g("sheep_fleet_repl_lag_max_records",
+                 "max replication lag across a cluster's members")
+        skew = g("sheep_fleet_epoch_skew",
+                 "max-min epoch across a cluster's members (nonzero = "
+                 "a fenced straggler is still rejoining)")
+        for cid, acc in sorted(per_cluster.items()):
+            reach.labels(cluster=cid).set(acc["ok"])
+            unreach.labels(cluster=cid).set(acc["bad"])
+            lagg.labels(cluster=cid).set(max(acc["lags"], default=0))
+            ep = acc["epochs"]
+            skew.labels(cluster=cid).set(max(ep) - min(ep) if ep else 0)
+        tres = g("sheep_fleet_tenant_resident_instances",
+                 "instances holding the tenant resident in memory")
+        for tn, n in sorted(tenant_res.items()):
+            tres.labels(tenant=tn).set(n)
+        set_process_gauges(m, self.started_at)
+        g("sheep_fleet_scrape_seconds",
+          "wall cost of this fan-in scrape").set(
+            round(time.monotonic() - t0, 6))
+        h, p = self.address
+        own = relabel(m.render(),
+                      {"cluster": "router", "instance": f"{h}:{p}"},
+                      seen_headers)
+        return "".join([own] + member_parts).encode("ascii")
 
     # -- forwarding --------------------------------------------------------
 
@@ -399,12 +562,8 @@ class Router:
                         cluster.forget_leader()
                     continue
                 try:
-                    if verb == "METRICS":
-                        # re-frame: header line + the full n-byte body
-                        body = client.metrics().encode("ascii")
-                        return f"OK bytes={len(body)}", body
                     resp = client.request(text)
-                except ServeError as exc:  # METRICS refused typed
+                except ServeError as exc:
                     last_err = f"{exc.code}: {exc.detail}"
                     self._drop(upstreams, addr)
                     continue

@@ -401,6 +401,11 @@ class ServeCore:
         # record; anything at or below it needs a snapshot bootstrap).
         self._wal_tail: list[tuple[int, bytes]] = []
         self.repl_floor = snap.applied_seqno
+        # trace-context forwarding (ISSUE 12): seqno -> rid for records
+        # still in the replication window, so the hub can stamp APPEND
+        # frames with the originating request's id (trimmed with the
+        # window — a bootstrapping follower has no rids to forward)
+        self._rid_tail: dict[int, str] = {}
 
         self.edges_tail = None
         self.edges_head = None
@@ -776,12 +781,14 @@ class ServeCore:
 
     # -- inserts -----------------------------------------------------------
 
-    def insert(self, pairs: np.ndarray) -> int:
+    def insert(self, pairs: np.ndarray, rid: str | None = None) -> int:
         """Accept one batch of edges: WAL first (fsync'd), then apply,
         then return the batch's seqno for the acknowledgement.  The
         ``wal`` / ``apply`` fault sites bracket the apply (serve/faults);
         a DiskExhausted/WriteFault from the append propagates with
-        NOTHING applied or logged — the caller refuses the insert."""
+        NOTHING applied or logged — the caller refuses the insert.
+        ``rid`` (the request's trace-context id, ISSUE 12) is retained
+        alongside the replication window so APPEND frames forward it."""
         pairs = np.ascontiguousarray(pairs, dtype=np.uint32)
         if pairs.ndim != 2 or pairs.shape[1] != 2:
             raise ValueError(f"insert batch must be (k, 2), got "
@@ -792,7 +799,7 @@ class ServeCore:
             self._fire("wal")
             self._apply_pairs(pairs)
             self.applied_seqno = seqno
-            self._tail_push(seqno, payload)
+            self._tail_push(seqno, payload, rid)
             if self.on_append is not None:
                 self.on_append()  # wake the replication senders
             self._fire("apply")
@@ -805,15 +812,28 @@ class ServeCore:
         if self.fire_faults:
             serve_faults.fire(site)
 
-    def _tail_push(self, seqno: int, payload: bytes) -> None:
+    def _tail_push(self, seqno: int, payload: bytes,
+                   rid: str | None = None) -> None:
         self._wal_tail.append((seqno, payload))
+        if rid is not None:
+            self._rid_tail[seqno] = rid
         if len(self._wal_tail) > REPL_TAIL_KEEP:
             drop = len(self._wal_tail) - REPL_TAIL_KEEP
             del self._wal_tail[:drop]
             self.repl_floor = self._wal_tail[0][0] - 1
+            if self._rid_tail:
+                floor = self.repl_floor
+                for s in [s for s in self._rid_tail if s <= floor]:
+                    del self._rid_tail[s]
+
+    def rid_for(self, seqno: int) -> str | None:
+        """The trace-context id of a retained record (None when the
+        insert carried none or the window moved past it)."""
+        return self._rid_tail.get(seqno)
 
     def apply_replicated(self, seqno: int, payload: bytes,
-                         sync: bool = True) -> str:
+                         sync: bool = True,
+                         rid: str | None = None) -> str:
         """Fold one record shipped by the leader into a FOLLOWER's state
         (serve/replicate.py).  The record lands in the local WAL under
         the leader's seqno (same durability order as :meth:`insert`:
@@ -843,7 +863,7 @@ class ServeCore:
             self._fire("wal")
             self._apply_pairs(pairs)
             self.applied_seqno = seqno
-            self._tail_push(seqno, payload)
+            self._tail_push(seqno, payload, rid)
             if self.on_append is not None:
                 self.on_append()  # chained replication / status hooks
             self._fire("apply")

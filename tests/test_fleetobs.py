@@ -1,0 +1,587 @@
+"""Fleet-observatory tests (ISSUE 12): the RID= prefix grammar and its
+forward-compatibility rule, rid propagation through spans / the sampler
+/ replication APPEND frames / real multi-process sockets, trace rotation
++ the fsck segment-chain rule, the sliding-window latency view, the
+router's fan-in fleet scrape, and `sheep top --json`."""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from sheep_tpu.integrity.errors import MalformedArtifact
+from sheep_tpu.obs import metrics as obs_metrics
+from sheep_tpu.obs import trace as obs_trace
+from sheep_tpu.obs.merge import (collect_trace_paths, estimate_offsets,
+                                 load_sources, merge_by_rid, merged_json)
+from sheep_tpu.serve.protocol import (BadRequest, ServeClient,
+                                      connect_retry, parse_request)
+from sheep_tpu.utils.synth import rmat_edges
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean_trace_env():
+    prev = os.environ.pop(obs_trace.ENV, None)
+    prev_mb = os.environ.pop(obs_trace.MAX_MB_ENV, None)
+    obs_trace.close_recorder()
+    obs_trace.sample_every()  # resync the cached sampler rate NOW
+    yield
+    obs_trace.close_recorder()
+    for k, v in ((obs_trace.ENV, prev), (obs_trace.MAX_MB_ENV, prev_mb)):
+        if v is None:
+            os.environ.pop(k, None)
+        else:
+            os.environ[k] = v
+    obs_trace.sample_every()
+
+
+def _enable(tmp_path, name="run.trace"):
+    path = str(tmp_path / name)
+    os.environ[obs_trace.ENV] = path
+    return path
+
+
+def _finish():
+    obs_trace.close_recorder()
+    os.environ.pop(obs_trace.ENV, None)
+
+
+# ---------------------------------------------------------------------------
+# the RID= prefix grammar
+# ---------------------------------------------------------------------------
+
+
+def test_rid_prefix_token_grammar():
+    r = parse_request("RID=ab12cd34 PART 1 2")
+    assert (r.verb, r.rid, r.deadline_s) == ("PART", "ab12cd34", None)
+    # order-independent with DEADLINE=, either way around
+    r = parse_request("DEADLINE=2 RID=ff01 INSERT 1 2")
+    assert (r.verb, r.rid, r.deadline_s) == ("INSERT", "ff01", 2.0)
+    r = parse_request("RID=ff01 DEADLINE=2 INSERT 1 2")
+    assert (r.verb, r.rid, r.deadline_s) == ("INSERT", "ff01", 2.0)
+    # no prefix: byte-identical to the old grammar
+    r = parse_request("PART 7")
+    assert (r.verb, r.rid, r.deadline_s) == ("PART", None, None)
+    for bad in ("RID= PART 1",            # empty rid
+                "RID=zz!! PART 1",        # non-hex
+                "RID=" + "a" * 65 + " PART 1",  # oversized
+                "RID=ab12"):              # prefix with no request
+        with pytest.raises(BadRequest):
+            parse_request(bad)
+
+
+def test_unknown_prefix_tokens_ignored_forward_compat():
+    """An old daemon must ignore tokens a newer router stamps — the
+    backward-compatibility half of the optional-prefix grammar."""
+    r = parse_request("XFUTURE=whatever RID=ab PART 3")
+    assert (r.verb, r.rid, r.args) == ("PART", "ab", ["3"])
+    r = parse_request("SPANCTX=a-b-c PING")
+    assert (r.verb, r.rid) == ("PING", None)
+    # a token whose key is not alphabetic is the verb boundary, not a
+    # prefix — still the old unknown-verb refusal
+    with pytest.raises(BadRequest):
+        parse_request("X2=1 PART 1")
+
+
+# ---------------------------------------------------------------------------
+# rid propagation through spans and the sampler
+# ---------------------------------------------------------------------------
+
+
+def test_rid_scope_stamps_spans_and_events(tmp_path):
+    path = _enable(tmp_path, "rid.trace")
+    with obs_trace.rid_scope("aa11"):
+        with obs_trace.span("outer"):
+            with obs_trace.span("inner"):
+                obs_trace.event("boom")
+        assert obs_trace.current_rid() == "aa11"
+    with obs_trace.span("unscoped"):
+        pass
+    _finish()
+    recs, _, _ = obs_trace.read_trace(path, "strict")
+    by_name = {r.get("name"): r for r in recs if r.get("k") != "meta"}
+    assert by_name["outer"]["rid"] == "aa11"
+    assert by_name["inner"]["rid"] == "aa11"
+    assert by_name["boom"]["rid"] == "aa11"
+    assert "rid" not in by_name["unscoped"]
+
+
+def test_sampled_out_span_still_forwards_rid(tmp_path, monkeypatch):
+    """The sampler may skip the serve.req span itself, but the rid
+    scope is set regardless — downstream spans still carry the rid, so
+    a sampled-out request remains joinable across processes."""
+    monkeypatch.setenv(obs_trace.SAMPLE_ENV, "1/1000000")
+    path = _enable(tmp_path, "sampled.trace")
+    obs_trace.sample_every()
+    with obs_trace.rid_scope("bb22"):
+        with obs_trace.sampled_span("serve.req"):  # recorded (call 0)
+            pass
+        with obs_trace.sampled_span("serve.req"):  # sampled OUT
+            with obs_trace.span("wal.fsync"):      # downstream: recorded
+                pass
+    _finish()
+    monkeypatch.delenv(obs_trace.SAMPLE_ENV, raising=False)
+    recs, _, _ = obs_trace.read_trace(path, "strict")
+    spans = {r["name"]: r for r in recs if r.get("k") == "span"}
+    assert sum(1 for r in recs if r.get("k") == "span"
+               and r["name"] == "serve.req") == 1
+    assert spans["wal.fsync"]["rid"] == "bb22"
+
+
+def test_append_frame_forwards_rid_to_follower_fsync(tmp_path):
+    """The wire half: a leader insert's rid rides the APPEND frame
+    (old daemons ignore the extra kv token) and the follower applier's
+    WAL append + burst fsync record under it."""
+    from sheep_tpu.io.edges import write_dat
+    from sheep_tpu.serve.replicate import (ReplApplier, encode_append,
+                                           parse_frame)
+    from sheep_tpu.serve.state import ServeCore
+    tail, head = rmat_edges(6, 4 << 6, seed=7)
+    g = str(tmp_path / "g.dat")
+    write_dat(g, tail, head)
+    leader = ServeCore.bootstrap(str(tmp_path / "lead"), graph_path=g,
+                                 num_parts=3)
+    seqno = leader.insert(np.array([[1, 4]], np.uint32), rid="cc33")
+    assert leader.rid_for(seqno) == "cc33"
+    line = encode_append(leader.epoch, seqno, leader._wal_tail[-1][1],
+                         rid=leader.rid_for(seqno))
+    assert " rid=cc33 " in line
+    frame = parse_frame(line)
+    assert frame.kv["rid"] == "cc33"
+
+    fol = ServeCore.bootstrap(str(tmp_path / "fol"), graph_path=g,
+                              num_parts=3)
+    path = _enable(tmp_path, "fol.trace")
+    acks = []
+    applier = ReplApplier(fol, acks.append)
+    applier.feed((line + "\n").encode("ascii"))
+    _finish()
+    assert fol.applied_seqno == seqno
+    assert fol.rid_for(seqno) == "cc33"  # forwarded for chained streams
+    recs, _, _ = obs_trace.read_trace(path, "repair")
+    fsyncs = [r for r in recs if r.get("k") == "span"
+              and r["name"] == "wal.fsync"]
+    assert fsyncs and all(r.get("rid") == "cc33" for r in fsyncs)
+    leader.close()
+    fol.close()
+
+
+# ---------------------------------------------------------------------------
+# trace rotation + the fsck segment-chain rule
+# ---------------------------------------------------------------------------
+
+
+def test_trace_rotation_seals_segments(tmp_path):
+    from sheep_tpu.integrity.sidecar import read_sidecar
+    os.environ[obs_trace.MAX_MB_ENV] = "0.001"  # ~1 KB per segment
+    path = _enable(tmp_path, "rot.trace")
+    for i in range(60):
+        with obs_trace.span("tick", i=i, pad="x" * 40):
+            pass
+    _finish()
+    chain = obs_trace.trace_segments(path)
+    assert len(chain) >= 3 and chain[-1] == path
+    for seg in chain[:-1]:
+        assert obs_trace.is_rotated_segment(seg)
+        assert read_sidecar(seg) is not None  # sealed ON rotation
+        recs, _, torn = obs_trace.read_trace(seg, "strict")
+        assert not torn
+        assert recs[0]["k"] == "meta"
+    # the chain reads as ONE stream with every span present, and every
+    # segment's meta repeats the SAME wall t0 (one clock, one timeline)
+    records = obs_trace.read_trace_chain(path, "repair")
+    names = [r for r in records if r.get("k") == "span"]
+    assert len(names) == 60
+    t0s = {r["t0"] for r in records if r.get("k") == "meta"}
+    assert len(t0s) == 1
+    # t stays monotonic across the segment boundary
+    ts = [r["t"] for r in records if r.get("k") == "span"]
+    assert ts == sorted(ts)
+
+
+def test_fsck_segment_chain_torn_tail_rule(tmp_path):
+    """Torn tail legal ONLY on the newest segment: fsck refuses a torn
+    rotated segment in repair mode too, while the active file keeps the
+    kill -9 truncatable contract."""
+    from sheep_tpu.integrity.fsck import fsck_file
+    os.environ[obs_trace.MAX_MB_ENV] = "0.001"
+    path = _enable(tmp_path, "chain.trace")
+    for i in range(60):
+        with obs_trace.span("tick", i=i, pad="y" * 40):
+            pass
+    _finish()
+    chain = obs_trace.trace_segments(path)
+    seg = chain[0]
+    assert "segment=rotated" in fsck_file(seg, "repair")
+    # tear the ACTIVE tail: legal (truncatable) in repair
+    with open(path, "r+b") as f:
+        f.seek(-5, os.SEEK_END)
+        f.truncate()
+    assert "torn_tail=truncatable" in fsck_file(path, "repair")
+    # tear a ROTATED segment's tail: refused even in repair
+    with open(seg, "r+b") as f:
+        f.seek(-5, os.SEEK_END)
+        f.truncate()
+    os.unlink(seg + ".sum") if os.path.exists(seg + ".sum") else None
+    with pytest.raises(MalformedArtifact):
+        fsck_file(seg, "repair")
+
+
+# ---------------------------------------------------------------------------
+# the sliding-window latency view
+# ---------------------------------------------------------------------------
+
+
+def test_window_histogram_shows_current_not_lifetime():
+    clock = [1000.0]
+    h = obs_metrics.Histogram("lat", clock=lambda: clock[0])
+    for _ in range(100):
+        h.observe(0.5)  # slow era
+    assert h.quantile(0.99) == 0.5
+    assert h.window_quantile(0.99) == 0.5
+    # the slow era ages out of the window; lifetime remembers it
+    clock[0] += obs_metrics.WINDOW_SLOTS * obs_metrics.WINDOW_SLOT_S + 1
+    for _ in range(10):
+        h.observe(0.001)  # fast now
+    assert h.window_quantile(0.99) == 0.001
+    assert h.window_quantile(0.5) == 0.001
+    assert h.quantile(0.5) == 0.5  # lifetime series unchanged
+    assert h.window_count() == 10 and h.count == 110
+    # empty window reports 0.0, not a stale bound
+    clock[0] += obs_metrics.WINDOW_SLOTS * obs_metrics.WINDOW_SLOT_S + 1
+    assert h.window_quantile(0.99) == 0.0
+
+
+def test_stats_window_keys_and_scrape_gauges(tmp_path):
+    from sheep_tpu.io.edges import write_dat
+    from sheep_tpu.serve import ServeConfig, ServeCore, ServeDaemon
+    tail, head = rmat_edges(6, 4 << 6, seed=9)
+    write_dat(str(tmp_path / "g.dat"), tail, head)
+    core = ServeCore.bootstrap(str(tmp_path / "s"),
+                               graph_path=str(tmp_path / "g.dat"),
+                               num_parts=3)
+    d = ServeDaemon(core, ServeConfig()).start()
+    try:
+        h, p = d.address
+        with ServeClient(h, p) as c:
+            for _ in range(5):
+                c.part([0, 1, 2])
+            st = c.kv("STATS")
+            # lifetime keys unchanged, window keys alongside
+            assert float(st["p99_part_ms"]) > 0
+            assert float(st["w99_part_ms"]) > 0
+            assert float(st["w50_part_ms"]) <= float(st["w99_part_ms"])
+            body = c.metrics()
+            assert 'sheep_serve_window_p99_seconds{verb="PART"}' in body
+            assert ('sheep_serve_tenant_window_p99_seconds'
+                    '{tenant="default"}') in body
+            # standard process self-accounting rides the payload
+            samples = dict(
+                ((n, tuple(sorted(lb.items()))), v) for n, lb, v
+                in obs_metrics.parse_prometheus(body))
+            assert samples[("sheep_process_vmrss_bytes", ())] > 0
+            assert samples[("sheep_process_threads", ())] >= 1
+            assert samples[("sheep_process_pid", ())] == os.getpid()
+            assert samples[("sheep_process_uptime_seconds", ())] >= 0
+            assert ("sheep_process_open_fds", ()) in samples
+    finally:
+        d.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# scrape plumbing: parse + relabel
+# ---------------------------------------------------------------------------
+
+
+def test_parse_prometheus_and_relabel_roundtrip():
+    reg = obs_metrics.Registry()
+    reg.counter("x_total", "x").labels(verb="PART").inc(3)
+    reg.gauge("g", "g").set(1.5)
+    hist = reg.histogram("h", "h")
+    hist.observe(0.003)
+    body = reg.render()
+    samples = obs_metrics.parse_prometheus(body)
+    d = {(n, tuple(sorted(lb.items()))): v for n, lb, v in samples}
+    assert d[("x_total", (("verb", "PART"),))] == 3
+    assert d[("g", ())] == 1.5
+    assert d[("h_count", ())] == 1
+    seen: set = set()
+    out = obs_metrics.relabel(body, {"instance": "a:1", "cluster": "c0"},
+                              seen)
+    out2 = obs_metrics.relabel(body, {"instance": "b:2",
+                                      "cluster": "c0"}, seen)
+    assert 'x_total{cluster="c0",instance="a:1",verb="PART"} 3' in out
+    assert "# TYPE x_total counter" in out
+    assert "# TYPE" not in out2  # headers deduped across members
+    # histogram le labels survive relabeling and values are unchanged
+    re_samples = obs_metrics.parse_prometheus(out)
+    for n, lb, v in re_samples:
+        if n == "h_bucket" and lb.get("le") == "0.005":
+            assert v == 1 and lb["instance"] == "a:1"
+            break
+    else:
+        raise AssertionError("relabeled bucket series lost")
+
+
+# ---------------------------------------------------------------------------
+# the router's fleet scrape + sheep top
+# ---------------------------------------------------------------------------
+
+
+def _mini_fleet(tmp_path):
+    """Two single-node clusters behind a router; four named tenants
+    placed on their ring-assigned clusters (the router routes by the
+    ring, so a tenant must live where the ring says it does)."""
+    from sheep_tpu.io.edges import write_dat
+    from sheep_tpu.serve import ServeConfig, ServeCore, ServeDaemon
+    from sheep_tpu.serve.router import HashRing, Router
+    from sheep_tpu.serve.tenants import TenantManager, TenantSpec
+    tail, head = rmat_edges(6, 4 << 6, seed=11)
+    g = str(tmp_path / "g.dat")
+    write_dat(g, tail, head)
+    tenants = [f"web{i}" for i in range(4)]
+    ring = HashRing(["c0", "c1"])
+    daemons = {}
+    for cid in ("c0", "c1"):
+        core = ServeCore.bootstrap(str(tmp_path / f"{cid}-dflt"),
+                                   graph_path=g, num_parts=3)
+        specs = [TenantSpec(t, str(tmp_path / f"{cid}-{t}"), g, 3)
+                 for t in tenants if ring.lookup(t) == cid]
+        daemons[cid] = ServeDaemon(
+            core, ServeConfig(),
+            tenants=TenantManager(core, specs)).start()
+    router = Router({cid: [d.core.state_dir]
+                     for cid, d in daemons.items()},
+                    poll_timeout_s=5.0).start()
+    return daemons, router, ring, tenants
+
+
+def test_fleet_scrape_labels_and_derived_gauges(tmp_path):
+    daemons, router, ring, tenants = _mini_fleet(tmp_path)
+    try:
+        rh, rp = router.address
+        with ServeClient(rh, rp) as c:
+            c.part([0, 1])
+            body = c.metrics()  # the fleet scrape via the router
+        # per-member series carry instance + cluster labels; tenant
+        # labels ride through from the member bodies
+        assert 'cluster="c0"' in body and 'cluster="c1"' in body
+        samples = obs_metrics.parse_prometheus(body)
+        insts = {lb["instance"] for n, lb, v in samples
+                 if n == "sheep_serve_epoch" and "instance" in lb}
+        assert len(insts) == 2
+        tenant_series = [(lb.get("tenant"), lb.get("cluster")) for
+                         n, lb, v in samples
+                         if n == "sheep_serve_tenant_resident"]
+        for t in tenants:
+            assert (t, ring.lookup(t)) in tenant_series
+        def find(name, **want):
+            return [v for n, lb, v in samples if n == name
+                    and all(lb.get(k) == w for k, w in want.items())]
+
+        for cid in ("c0", "c1"):
+            assert find("sheep_fleet_members_reachable",
+                        cluster=cid) == [1]
+            assert find("sheep_fleet_epoch_skew", cluster=cid) == [0]
+            assert find("sheep_fleet_repl_lag_max_records",
+                        cluster=cid) == [0]
+        # the router's own counters + process gauges ride the scrape
+        assert find("sheep_route_requests")
+        assert find("sheep_process_pid",
+                    cluster="router") == [float(os.getpid())]
+        assert find("sheep_fleet_scrape_seconds", cluster="router",
+                    instance=f"{rh}:{rp}")[0] >= 0
+    finally:
+        router.shutdown()
+        for dmn in daemons.values():
+            dmn.shutdown()
+
+
+def test_top_json_one_shot(tmp_path, capsys):
+    from sheep_tpu.cli import top as top_cli
+    daemons, router, ring, tenants = _mini_fleet(tmp_path)
+    try:
+        rh, rp = router.address
+        with ServeClient(rh, rp) as c:
+            c.tenant(tenants[0])
+            c.part([0, 1, 2])
+        rc = top_cli.main(["-r", f"{rh}:{rp}", "--json", "-i", "0"])
+        assert rc == 0
+        view = json.loads(capsys.readouterr().out)
+        assert set(tenants) | {"default"} <= set(view["tenants"])
+        web = view["tenants"][tenants[0]]
+        assert web["cluster"] == ring.lookup(tenants[0])
+        assert web["resident"] == 1
+        assert web["requests"] >= 1  # the PART above
+        assert len(view["instances"]) >= 2
+        assert view["scrape_bytes"] > 0
+    finally:
+        router.shutdown()
+        for dmn in daemons.values():
+            dmn.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# the merge: offsets, ordering, and the real multi-process round trip
+# ---------------------------------------------------------------------------
+
+
+def _write_trace(path, t0, recs):
+    with open(path, "w") as f:
+        f.write(json.dumps({"k": "meta", "v": 1, "pid": 1,
+                            "t0": t0}) + "\n")
+        for r in recs:
+            f.write(json.dumps(r) + "\n")
+
+
+def test_merge_offset_estimate_and_ordering_property(tmp_path):
+    """Two synthetic files whose wall clocks disagree wildly: rid
+    containment recovers the offset (with an honest bound) and the
+    merged ordering preserves each process's own ordering."""
+    a = str(tmp_path / "router.trace")
+    b = str(tmp_path / "daemon.trace")
+    # router: three requests, each span containing the daemon's work
+    _write_trace(a, 1000.0, [
+        {"k": "span", "name": "route.req", "id": i, "par": None,
+         "t": float(i), "dur": 0.9, "rid": f"r{i}"}
+        for i in range(3)])
+    # daemon clock is 500s off wall-wise; its spans nest inside, with
+    # an extra event per rid to check intra-file ordering
+    brecs = []
+    for i in range(3):
+        brecs.append({"k": "span", "name": "serve.req", "id": 10 + i,
+                      "par": None, "t": 700.0 + i + 0.2, "dur": 0.5,
+                      "rid": f"r{i}"})
+        brecs.append({"k": "ev", "name": "wal.append", "par": 10 + i,
+                      "t": 700.0 + i + 0.3, "rid": f"r{i}"})
+    _write_trace(b, 1800.0, brecs)  # wall lies by ~1500s
+
+    sources = load_sources(collect_trace_paths([str(tmp_path)]))
+    assert len(sources) == 2
+    estimate_offsets(sources)
+    by_label = {s.label: s for s in sources}
+    ref = by_label["router"]
+    dmn = by_label["daemon"]
+    assert ref.method == "reference"
+    assert dmn.method.startswith("rid(")
+    # true correction: router abs = 1000+i, daemon abs = 2500+i+0.2 ->
+    # offset ~ -1500.2 bounded by the containment slack
+    assert dmn.bound is not None
+    assert abs(dmn.offset + 1500.2) <= dmn.bound + 0.21
+    rids = merge_by_rid(sources)
+    assert set(rids) == {"r0", "r1", "r2"}
+    for rid, recs in rids.items():
+        # per-process ordering respected in the merged order
+        dmn_names = [r["name"] for r in recs if r["_src"] == "daemon"]
+        assert dmn_names == ["serve.req", "wal.append"]
+        # and the daemon's work lands INSIDE the router's span window
+        route = [r for r in recs if r["_src"] == "router"][0]
+        for r in recs:
+            if r["_src"] == "daemon":
+                assert route["_t"] - 1e-6 <= r["_t"] \
+                    <= route["_t"] + route["dur"] + 1e-6
+    out = merged_json(sources, rids)
+    assert out["files"][0]["method"] in ("reference", "rid(3)")
+
+
+def test_merge_without_shared_rids_reports_unknown_bound(tmp_path):
+    a = str(tmp_path / "p1.trace")
+    b = str(tmp_path / "p2.trace")
+    _write_trace(a, 100.0, [{"k": "span", "name": "x", "id": 1,
+                             "par": None, "t": 0.0, "dur": 1.0,
+                             "rid": "aa"}])
+    _write_trace(b, 200.0, [{"k": "span", "name": "y", "id": 1,
+                             "par": None, "t": 0.0, "dur": 1.0,
+                             "rid": "bb"}])
+    sources = load_sources([a, b])
+    estimate_offsets(sources)
+    other = [s for s in sources if s.method != "reference"]
+    assert len(other) == 1
+    assert other[0].method == "wall" and other[0].bound is None
+
+
+def test_rid_round_trip_over_real_sockets_multiprocess(tmp_path):
+    """The flagship chain on REAL processes: router (this process) ->
+    leader (subprocess) -> follower (subprocess) — one routed INSERT's
+    rid appears in all three trace files, the follower's record is its
+    WAL fsync, and `--merge` stitches them into one rid tree."""
+    from sheep_tpu.io.edges import write_dat
+    from sheep_tpu.serve.router import Router
+    tail, head = rmat_edges(6, 4 << 6, seed=13)
+    g = str(tmp_path / "g.dat")
+    write_dat(g, tail, head)
+    lead_d, fol_d = str(tmp_path / "lead"), str(tmp_path / "fol")
+    tdir = tmp_path / "tr"
+    tdir.mkdir()
+
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["SHEEP_SERVE_REPL_HB_S"] = "0.1"
+
+    def spawn(d, trace_name, *args):
+        e = dict(env)
+        e[obs_trace.ENV] = str(tdir / trace_name)
+        return subprocess.Popen(
+            [sys.executable, "-m", "sheep_tpu.cli.serve", "-d", d,
+             *args], env=e, cwd=REPO, stdout=subprocess.DEVNULL,
+            stderr=subprocess.STDOUT)
+
+    procs = [spawn(lead_d, "lead.trace", "-g", g, "-k", "3", "--role",
+                   "leader", "--node-id", "lead", "--peers", fol_d)]
+    try:
+        deadline = time.monotonic() + 60
+        while not os.path.exists(os.path.join(lead_d, "serve.addr")):
+            assert time.monotonic() < deadline
+            time.sleep(0.05)
+        procs.append(spawn(fol_d, "fol.trace", "--role", "follower",
+                           "--node-id", "fol", "--peers", lead_d))
+        os.environ[obs_trace.ENV] = str(tdir / "router.trace")
+        router = Router({"c0": [lead_d, fol_d]},
+                        poll_timeout_s=2.0).start()
+        try:
+            rh, rp = router.address
+            c = connect_retry(rh, rp, timeout_s=60)
+            deadline = time.monotonic() + 60
+            while c.kv("STATS").get("followers", 0) < 1:
+                assert time.monotonic() < deadline, "no follower"
+                time.sleep(0.1)
+            # the OK means leader fsync + follower ack: the rid has
+            # crossed all three processes by the time this returns
+            c.insert([(1, 5)])
+            c.request("QUIT")
+            c.close()
+        finally:
+            router.shutdown()
+            _finish()
+    finally:
+        for p in procs:
+            p.send_signal(signal.SIGTERM)
+        for p in procs:
+            p.wait(timeout=60)
+
+    sources = load_sources(collect_trace_paths([str(tdir)]))
+    assert len(sources) == 3
+    estimate_offsets(sources)
+    rids = merge_by_rid(sources)
+    spanning = {rid: {r["_src"] for r in recs}
+                for rid, recs in rids.items()}
+    full = [rid for rid, srcs in spanning.items()
+            if {"router", "lead", "fol"} <= srcs]
+    assert full, f"no rid crossed all three processes: {spanning}"
+    rid = full[0]
+    names_by_src = {}
+    for r in rids[rid]:
+        names_by_src.setdefault(r["_src"], []).append(r["name"])
+    assert "route.req" in names_by_src["router"]
+    assert "wal.fsync" in names_by_src["fol"], names_by_src
+    # the leader side carries the insert's own spans (serve.req when
+    # sampled in — always, with no sampler set — plus its WAL fsync)
+    assert "wal.fsync" in names_by_src["lead"] \
+        or "serve.req" in names_by_src["lead"]

@@ -1,0 +1,270 @@
+#!/usr/bin/env python3
+"""DISTEXTBENCH: the distributed out-of-core acceptance run (ISSUE 13).
+
+Builds a graph whose ``.dat`` edge list is >= ``--factor`` x the PER-LEG
+``SHEEP_MEM_BUDGET`` through N supervised ext legs (ops/distext) and
+records, per the bench-honesty rules (env_capture embedded, serialized
+runs, every leg in its OWN subprocess so its VmHWM is that leg's true
+lifetime peak):
+
+  distext  the supervised job: hist legs -> histogram Allreduce ->
+           distmap legs (each under its own SHEEP_MEM_BUDGET, streaming
+           its record slice through its own prefetcher) -> tournament
+           merge.  Per-leg self-reports (cli/distext --perf-out) embed
+           each leg subprocess's proc_status (VmHWM, affinity — the
+           shared obs.metrics reader) and overlap_frac, so a multi-core
+           host can re-judge leg overlap from the record alone.
+  ext      the single-host out-of-core build (PR 9) under the same
+           budget: the bar the distributed job's wall clock is judged
+           against (on one core the legs time-share, so distext ~
+           ext + supervision; real parallelism is the multi-core
+           re-judge the record's per-leg affinity data enables).
+  oracle   the in-RAM native fused build: ground-truth CRCs.
+
+Acceptance asserted into the record: file >= factor x per-leg budget;
+>= 2 legs; every leg's measured VmHWM inside its budget; distext CRCs ==
+single-host ext CRCs == oracle CRCs (oracle-bit-identical).
+
+Usage:
+  python scripts/distextbench.py --budget 64M --legs 2 --factor 4 \
+      --out DISTEXTBENCH_r01.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from extbench import _crcs, generate, vmhwm_bytes  # noqa: E402
+
+
+def child_ext(path: str) -> dict:
+    from sheep_tpu.ops.extmem import build_forest_extmem, dat_num_records
+    records = dat_num_records(path)
+    perf: dict = {}
+    t0 = time.perf_counter()
+    seq, forest = build_forest_extmem(path, perf=perf)
+    wall = time.perf_counter() - t0
+    assert "jax" not in sys.modules, "ext arm imported jax"
+    out = {"arm": "ext", "records": records, "wall_s": round(wall, 3),
+           "edges_per_s": round(records / wall, 1),
+           "vmhwm_bytes": vmhwm_bytes(), "n": int(len(seq)), "perf": perf}
+    out.update(_crcs(forest))
+    return out
+
+
+def child_oracle(path: str) -> dict:
+    from sheep_tpu.core import build_forest, degree_sequence
+    from sheep_tpu.io.edges import load_edges
+    t0 = time.perf_counter()
+    edges = load_edges(path)
+    seq = degree_sequence(edges.tail, edges.head)
+    forest = build_forest(edges.tail, edges.head, seq)
+    wall = time.perf_counter() - t0
+    out = {"arm": "oracle", "records": edges.num_edges,
+           "wall_s": round(wall, 3),
+           "edges_per_s": round(edges.num_edges / wall, 1),
+           "vmhwm_bytes": vmhwm_bytes(), "n": int(len(seq))}
+    out.update(_crcs(forest))
+    return out
+
+
+def run_child(arm: str, path: str, budget: str | None) -> dict:
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    if budget:
+        env["SHEEP_MEM_BUDGET"] = budget
+    else:
+        env.pop("SHEEP_MEM_BUDGET", None)
+    t0 = time.perf_counter()
+    proc = subprocess.run(
+        [sys.executable, os.path.abspath(__file__), "--child", arm,
+         "--data", path],
+        env=env, cwd=REPO, capture_output=True, text=True)
+    if proc.returncode != 0:
+        return {"arm": arm, "error": proc.stderr[-2000:],
+                "wall_s": round(time.perf_counter() - t0, 3)}
+    return json.loads(proc.stdout.strip().splitlines()[-1])
+
+
+def run_distext_arm(path: str, state_dir: str, budget: str,
+                    legs: int) -> dict:
+    """The supervised job, run from THIS process (the supervisor parent
+    holds no O(n) state); every leg is a real CLI subprocess carrying
+    the per-leg budget in its environment."""
+    from sheep_tpu.io.trefile import read_tree
+    from sheep_tpu.ops.distext import (dat_num_records, leg_perf_path,
+                                       run_distext)
+    from sheep_tpu.supervisor import SubprocessRunner, SupervisorConfig
+
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["SHEEP_MEM_BUDGET"] = budget
+    cfg = SupervisorConfig.from_env(grammar=False)
+    t0 = time.perf_counter()
+    manifest = run_distext(path, state_dir, cfg,
+                           runner=SubprocessRunner(env=env), legs=legs)
+    wall = time.perf_counter() - t0
+    records = dat_num_records(path)
+    out = {"arm": "distext", "records": records,
+           "wall_s": round(wall, 3),
+           "edges_per_s": round(records / wall, 1),
+           "legs": len(manifest.shards),
+           "shards": manifest.shards,
+           "dispatches": sum(leg.dispatches for leg in manifest.legs),
+           "per_leg": {}}
+    for leg in manifest.legs:
+        if leg.kind != "distmap":
+            continue
+        try:
+            with open(leg_perf_path(state_dir, leg.key)) as f:
+                rep = json.load(f)
+        except OSError:
+            rep = {"error": "no self-report"}
+        out["per_leg"][leg.key] = {
+            "range": rep.get("range"),
+            "vmhwm_bytes": _kb(rep.get("proc_status", {}).get("vmhwm")),
+            "affinity_cores": rep.get("proc_status", {})
+                                 .get("affinity_cores"),
+            "overlap_frac": rep.get("perf", {}).get("overlap_frac"),
+            "read_s": rep.get("perf", {}).get("read_s"),
+            "fold_s": rep.get("perf", {}).get("fold_s"),
+            "ext_blocks": rep.get("perf", {}).get("ext_blocks"),
+            "block_edges": rep.get("perf", {}).get("block_edges"),
+            "strategies": rep.get("perf", {}).get("strategies"),
+            "proc_status": rep.get("proc_status"),
+        }
+    parent, pst = read_tree(manifest.final_tree)
+
+    class _F:  # the shape _crcs expects
+        pass
+
+    f = _F()
+    f.parent, f.pst_weight = parent, pst
+    out.update(_crcs(f))
+    return out
+
+
+def _kb(s) -> int | None:
+    try:
+        return int(str(s).split()[0]) * 1024
+    except (ValueError, IndexError, AttributeError):
+        return None
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--budget", default="64M",
+                    help="PER-LEG SHEEP_MEM_BUDGET")
+    ap.add_argument("--legs", type=int, default=2)
+    ap.add_argument("--factor", type=float, default=4.0,
+                    help="edge-list bytes as a multiple of the per-leg "
+                         "budget")
+    ap.add_argument("--log-n", type=int, default=20)
+    ap.add_argument("--data", default=None)
+    ap.add_argument("--keep-file", action="store_true")
+    ap.add_argument("--out", default="DISTEXTBENCH_r01.json")
+    ap.add_argument("--child", choices=("ext", "oracle"),
+                    help=argparse.SUPPRESS)
+    args = ap.parse_args()
+
+    if args.child:
+        out = {"ext": child_ext, "oracle": child_oracle}[args.child](
+            args.data)
+        print(json.dumps(out))
+        return 0
+
+    import shutil
+    import tempfile
+
+    from sheep_tpu.resources.governor import parse_size
+    from sheep_tpu.utils.envinfo import env_capture
+    budget_bytes = parse_size(args.budget)
+    path = args.data
+    generated = False
+    if path is None:
+        records = -(-int(args.factor * budget_bytes) // 12)
+        path = os.path.join(os.environ.get("TMPDIR", "/tmp"),
+                            f"distextbench-{records}.dat")
+        if not (os.path.exists(path)
+                and os.path.getsize(path) == 12 * records):
+            generate(path, records, args.log_n)
+        generated = True
+    file_bytes = os.path.getsize(path)
+
+    record: dict = {
+        "bench": "DISTEXTBENCH",
+        "round": "r01",
+        "budget_per_leg": args.budget,
+        "budget_per_leg_bytes": budget_bytes,
+        "legs": args.legs,
+        "factor": args.factor,
+        "file_bytes": file_bytes,
+        "file_over_budget": round(file_bytes / budget_bytes, 2),
+        "log_n": args.log_n,
+        "env_capture": env_capture(),
+        "arms": {},
+        "_note": ("serialized runs; the distext arm's legs are real CLI "
+                  "subprocesses each under its own SHEEP_MEM_BUDGET, "
+                  "self-reporting VmHWM/affinity/overlap via "
+                  "obs.metrics.proc_status — on this 1-core host the "
+                  "legs time-share, so per-leg overlap_frac and any "
+                  "read scale-out must be re-judged on real cores from "
+                  "the per_leg affinity data in this record"),
+    }
+    state_dir = tempfile.mkdtemp(prefix="distextbench-state.")
+    try:
+        print("running distext arm...", file=sys.stderr)
+        record["arms"]["distext"] = run_distext_arm(
+            path, state_dir, args.budget, args.legs)
+        print(json.dumps({k: v for k, v in
+                          record["arms"]["distext"].items()
+                          if k != "per_leg"}), file=sys.stderr)
+        for arm in ("ext", "oracle"):
+            print(f"running {arm} arm...", file=sys.stderr)
+            record["arms"][arm] = run_child(
+                arm, path, args.budget if arm == "ext" else None)
+            print(json.dumps(record["arms"][arm]), file=sys.stderr)
+        dist = record["arms"]["distext"]
+        ext = record["arms"]["ext"]
+        oracle = record["arms"]["oracle"]
+        leg_hwms = [leg.get("vmhwm_bytes") or (1 << 62)
+                    for leg in dist.get("per_leg", {}).values()]
+        record["acceptance"] = {
+            "file_ge_factor_x_leg_budget":
+                file_bytes >= args.factor * budget_bytes,
+            "n_legs_ge_2": dist.get("legs", 0) >= 2,
+            "every_leg_rss_inside_budget":
+                bool(leg_hwms) and max(leg_hwms) <= budget_bytes,
+            "distext_oracle_exact":
+                dist.get("parent_crc32") == oracle.get("parent_crc32")
+                and dist.get("pst_crc32") == oracle.get("pst_crc32"),
+            "distext_matches_single_host_ext":
+                dist.get("parent_crc32") == ext.get("parent_crc32")
+                and dist.get("pst_crc32") == ext.get("pst_crc32"),
+        }
+        record["passed"] = all(record["acceptance"].values())
+    finally:
+        shutil.rmtree(state_dir, ignore_errors=True)
+        if generated and not args.keep_file:
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+    with open(os.path.join(REPO, args.out), "w") as f:
+        json.dump(record, f, indent=2)
+        f.write("\n")
+    print(json.dumps(record["acceptance"], indent=2))
+    return 0 if record.get("passed") else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

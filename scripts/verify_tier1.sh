@@ -304,6 +304,76 @@ then
 fi
 # -------------------------------------------------------------------------
 
+# --- distext smoke (distributed out-of-core build, ISSUE 13) -------------
+# A 2-leg supervised build of a synthetic .dat >= 4x over each leg's
+# SHEEP_MEM_BUDGET: per-range histograms Allreduce into the shared
+# sequence, per-leg ext folds tournament-merge — oracle-bit-identical
+# tree CRC vs BOTH the single-host ext arm and the in-RAM oracle; then a
+# kill of one leg mid-range whose recovery re-dispatches ONLY that leg
+# (resuming its own block checkpoint); the state dir (.hist artifacts +
+# shard-map chain) must fsck clean.  Seconds of work (in-process legs);
+# a regression anywhere in the distext composition fails the gate before
+# pytest even runs.
+DISTEXT_DIR=$(mktemp -d)
+if env JAX_PLATFORMS=cpu SHEEP_MEM_BUDGET=768K python - "$DISTEXT_DIR" <<'EOF'
+import os, sys, zlib
+import numpy as np
+from sheep_tpu.core import build_forest, degree_sequence
+from sheep_tpu.io.edges import write_dat
+from sheep_tpu.io.trefile import read_tree
+from sheep_tpu.ops.distext import run_distext
+from sheep_tpu.ops.extmem import build_forest_extmem
+from sheep_tpu.runtime import FaultPlan, clear_plan, install_plan, reset_counters
+from sheep_tpu.supervisor import InlineRunner, SupervisorConfig
+from sheep_tpu.utils.synth import rmat_edges
+
+d = sys.argv[1]
+tail, head = rmat_edges(14, 1 << 18, seed=61)
+p = d + "/g.dat"
+write_dat(p, tail, head)
+budget = 768 << 10
+assert os.path.getsize(p) >= 4 * budget, "file must be >= 4x the leg budget"
+want = build_forest(tail, head, degree_sequence(tail, head))
+crc = lambda f: (zlib.crc32(np.asarray(f[0]).tobytes()),
+                 zlib.crc32(np.asarray(f[1]).tobytes()))
+oracle_crc = crc((want.parent, want.pst_weight))
+_, ext_f = build_forest_extmem(p)   # the single-host ext arm
+assert crc((ext_f.parent, ext_f.pst_weight)) == oracle_crc
+
+def run(name, **kw):
+    cfg = SupervisorConfig(poll_s=0.01, backoff_base_s=0.0, grammar=False, **kw)
+    m = run_distext(p, f"{d}/{name}", cfg, runner=InlineRunner(0.05), legs=2)
+    return crc(read_tree(m.final_tree)), m
+
+base_crc, _ = run("base")
+assert base_crc == oracle_crc, "distext diverged from the oracle/ext CRC"
+
+# kill one leg mid-range at a block boundary: the re-dispatch resumes the
+# leg's own checkpoint and ONLY that leg runs twice
+reset_counters()
+install_plan(FaultPlan(site="ext-boundary", at=1, kind="kill"))
+hurt_crc, m = run("legkill", cores=1)
+clear_plan()
+assert hurt_crc == oracle_crc, "killed-leg recovery diverged"
+counts = {leg.key: leg.dispatches for leg in m.legs}
+assert counts["r0.00"] == 2, counts
+assert all(n == 1 for k, n in counts.items() if k != "r0.00"), counts
+EOF
+then
+  if ! env JAX_PLATFORMS=cpu bin/fsck -q "$DISTEXT_DIR/base" > /dev/null
+  then
+    echo "DISTEXT SMOKE FAILED: the state dir (.hist artifacts or the" \
+         "shard-map chain) did not fsck clean" >&2
+    rm -rf "$DISTEXT_DIR"; exit 1
+  fi
+  rm -rf "$DISTEXT_DIR"
+else
+  echo "DISTEXT SMOKE FAILED: 2-leg distributed build diverged from the" \
+       "oracle or re-dispatched more than the killed leg" >&2
+  rm -rf "$DISTEXT_DIR"; exit 1
+fi
+# -------------------------------------------------------------------------
+
 # --- flight-recorder smoke (observability, ISSUE 10) ---------------------
 # One traced build (SHEEP_TRACE on): the tree must stay oracle-exact, the
 # trace file must fsck clean (sealed sidecar + parseable JSONL), and

@@ -82,10 +82,11 @@ def main(argv: list[str] | None = None) -> int:
         # instead of burning more single-letter flags.
         opts, args = getopt.gnu_getopt(
             argv, "irl:p:s:o:vkejm:w:xfdtc",
-            ["checkpoint-dir=", "resume", "max-retries=", "ext"])
+            ["checkpoint-dir=", "resume", "max-retries=", "ext",
+             "distext"])
     except getopt.GetoptError as exc:
         if (exc.opt or "").startswith(("checkpoint-dir", "max-retries",
-                                       "resume", "ext")):
+                                       "resume", "ext", "distext")):
             print(f"Option --{exc.opt}: {exc.msg}.")
             return 1
         o = (exc.opt or "?")[:1]
@@ -109,6 +110,7 @@ def main(argv: list[str] | None = None) -> int:
     find_max_width = False
     do_faqs = do_print = do_validate = False
     use_ext = False
+    use_distext = False
 
     for o, a in opts:
         if o == "-i":
@@ -146,6 +148,8 @@ def main(argv: list[str] | None = None) -> int:
             do_validate = not do_validate
         elif o == "--ext":
             use_ext = True
+        elif o == "--distext":
+            use_distext = True
 
     if not args:
         print(USAGE)
@@ -184,7 +188,22 @@ def main(argv: list[str] | None = None) -> int:
     # needs the records — say so and fall back instead of surprising.
     if not use_mesh and not jxn_mode and not num_parts \
             and graph_filename.endswith(".dat"):
-        if not use_ext:
+        # Distributed routing first (ISSUE 13): --distext forces;
+        # SHEEP_DISTEXT_LEGS is the env twin; auto when even the ext
+        # rung's single-leg stream cannot meet the budget.  The job
+        # needs -o (the supervisor exports the final tree there) and no
+        # partition request (same limitation as --ext, plus the records
+        # live across N legs) — say so and fall back, never surprise.
+        if not use_distext:
+            from ..ops.distext import should_use_distext
+            use_distext = should_use_distext(graph_filename)
+        if use_distext and (partitions or not output_filename):
+            print("warning: the distributed out-of-core build needs -o "
+                  "and cannot partition (the edge records never load in "
+                  "one process); falling back to the single-process "
+                  "path", file=sys.stderr)
+            use_distext = False
+        if not use_ext and not use_distext:
             from ..ops.extmem import should_use_extmem
             use_ext = should_use_extmem(graph_filename)
         if use_ext and partitions and output_filename:
@@ -194,6 +213,31 @@ def main(argv: list[str] | None = None) -> int:
             use_ext = False
     else:
         use_ext = False
+        use_distext = False
+
+    if use_distext:
+        # The supervised distributed job (ops/distext.run_distext): the
+        # supervisor owns the whole hist -> Allreduce -> map -> merge
+        # lifecycle and prints the reference phase grammar itself.  The
+        # state dir doubles as the checkpoint surface (--checkpoint-dir
+        # redirects it), so a rerun resumes off the fsck'd survivors.
+        from ..integrity.errors import IntegrityError
+        from ..ops.distext import run_distext
+        from ..supervisor import (SupervisionFailed, SupervisorKilled,
+                                  SupervisorConfig)
+        state_dir = (rt_cfg.checkpoint_dir if rt_cfg is not None else
+                     None) or output_filename + ".distext"
+        try:
+            run_distext(graph_filename, state_dir,
+                        SupervisorConfig.from_env(),
+                        out_file=output_filename)
+        except (SupervisionFailed, SupervisorKilled, IntegrityError,
+                OSError) as exc:
+            print(f"graph2tree: distext: {exc}", file=sys.stderr)
+            return 1
+        if verbose:
+            print_phase("Finished", clock.total_seconds())
+        return 0
 
     if verbose:
         print(f"Loading {graph_filename}...")

@@ -23,7 +23,9 @@ Options:
   -t SEC     heartbeat deadline (default SHEEP_DEADLINE_S or 30)
   -v         echo the event trace as it happens
   --status   read-only operator report of the state dir (leg states,
-             dispatch counts, heartbeat ages, disk/mem budget headroom —
+             dispatch counts, heartbeat ages, disk/mem budget headroom,
+             and — for distext jobs (ISSUE 13) — per-leg ext progress
+             as blocks done/total from each leg's own checkpoint —
              supervisor/status.py) instead of running anything
   --json     with --status: emit the report as one JSON object so the
              serve daemon's liveness probe and outside monitors consume

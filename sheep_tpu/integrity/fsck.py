@@ -21,9 +21,10 @@ from .sidecar import read_sidecar, resolve_policy, verify_file
 
 #: suffixes fsck knows how to verify (``.npz`` = runtime snapshots,
 #: ``.wal``/``.snap`` = the serve daemon's log + serving snapshots,
-#: ``.trace`` = flight-recorder span logs, ISSUE 10)
+#: ``.trace`` = flight-recorder span logs, ISSUE 10; ``.hist`` = the
+#: distext legs' per-range degree histograms, ISSUE 13)
 ARTIFACT_SUFFIXES = (".tre", ".seq", ".dat", ".net", ".npz",
-                     ".wal", ".snap", ".trace")
+                     ".wal", ".snap", ".trace", ".hist")
 
 
 def _fsck_tre(path: str, mode: str) -> str:
@@ -202,6 +203,70 @@ def _fsck_trace(path: str, mode: str) -> str:
     return detail
 
 
+def _fsck_hist(path: str, mode: str) -> str:
+    """Verify a distext per-range histogram (ISSUE 13): sidecar
+    checksum, magic/length/int64 dtype, nonnegativity, and the range
+    invariants (records == slice length, degree total == 2 x records,
+    the max vid really appears).  The cross-artifact half — a histogram
+    whose range disagrees with the distext manifest's shard map — is
+    checked by :func:`fsck_distext_manifest` when fsck walks a state
+    dir."""
+    from ..ops.distext import read_histogram
+
+    h = read_histogram(path, integrity=mode)
+    return (f"n={len(h['deg'])} records={h['records']} "
+            f"range=[{h['start']}:{h['end']}) max_vid={h['max_vid']}")
+
+
+def fsck_distext_manifest(state_dir: str,
+                          mode: str | None = None) -> str | None:
+    """Verify a distext state dir's shard-map chain (ISSUE 13): the
+    manifest loads + verifies, its shards are a contiguous edge-disjoint
+    cover of the graph's record count, and every published ``.hist``
+    artifact's recorded range matches its leg's shard — a histogram
+    whose coverage disagrees with the manifest is REFUSED here, because
+    summing it would produce a plausible-looking but wrong sequence.
+
+    Returns the summary line, or None when the directory's manifest is
+    a plain (non-distext) tournament; raises on any corruption."""
+    from ..ops.distext import read_histogram
+    from ..supervisor.manifest import load_manifest
+
+    mode = resolve_policy(mode)
+    manifest = load_manifest(state_dir, mode)
+    if manifest.shards is None:
+        return None
+    shards = [(int(a), int(b)) for a, b in manifest.shards]
+    at = 0
+    for i, (a, b) in enumerate(shards):
+        if a != at or b < a:
+            raise MalformedArtifact(
+                f"{state_dir}: shard map is not a contiguous cover — "
+                f"shard {i} is [{a}:{b}) but the previous one ends at "
+                f"{at}")
+        at = b
+    if manifest.graph.endswith(".dat") and manifest.graph_bytes >= 0 \
+            and at != manifest.graph_bytes // 12:
+        raise MalformedArtifact(
+            f"{state_dir}: shard map covers {at} records but the "
+            f"manifest's graph has {manifest.graph_bytes // 12}")
+    checked = 0
+    for leg in manifest.legs:
+        if leg.kind != "hist" or not os.path.exists(leg.output):
+            continue
+        h = read_histogram(leg.output, integrity=mode)
+        a, b = shards[leg.index]
+        if (h["start"], h["end"]) != (a, b):
+            raise MalformedArtifact(
+                f"{leg.output}: histogram covers "
+                f"[{h['start']}:{h['end']}) but the manifest's shard "
+                f"map assigns leg {leg.index} [{a}:{b}) — refusing a "
+                f"histogram that disagrees with the manifest")
+        checked += 1
+    return (f"distext legs={len(shards)} records={at} "
+            f"hists={checked}/{len(shards)} shard-map-ok")
+
+
 _CHECKERS = {
     ".tre": _fsck_tre,
     ".seq": _fsck_seq,
@@ -211,6 +276,7 @@ _CHECKERS = {
     ".wal": _fsck_wal,
     ".snap": _fsck_snap,
     ".trace": _fsck_trace,
+    ".hist": _fsck_hist,
 }
 
 
@@ -298,7 +364,8 @@ def fsck_paths(paths, mode: str | None = None):
     results = []
     for root in paths:
         targets = collect_artifacts(root)
-        if not targets:
+        chain = _manifest_chain_result(root, mode)
+        if not targets and chain is None:
             results.append((root, False, "no artifacts found"))
             continue
         for path in targets:
@@ -307,5 +374,24 @@ def fsck_paths(paths, mode: str | None = None):
                 results.append((path, True, detail))
             except (IntegrityError, OSError) as exc:
                 results.append((path, False, str(exc)))
+        if chain is not None:
+            results.append(chain)
     failures = [r for r in results if not r[1]]
     return results, failures
+
+
+def _manifest_chain_result(root: str, mode: str):
+    """The distext shard-map chain line for a state-dir root (ISSUE 13),
+    or None when the root is a file / has no manifest / holds a plain
+    tournament."""
+    if not os.path.isdir(root) \
+            or not os.path.exists(os.path.join(root, "manifest.json")):
+        return None
+    mpath = os.path.join(root, "manifest.json")
+    try:
+        detail = fsck_distext_manifest(root, mode)
+    except (IntegrityError, OSError) as exc:
+        return (mpath, False, str(exc))
+    if detail is None:
+        return None
+    return (mpath, True, detail)

@@ -243,7 +243,8 @@ def load_edges(path: str, part: int = 0, num_parts: int = 0,
 
 
 def iter_dat_blocks(path: str, block_edges: int, part: int = 0,
-                    num_parts: int = 0, start_edge: int = 0):
+                    num_parts: int = 0, start_edge: int = 0,
+                    end_edge: int | None = None):
     """Stream a ``.dat`` file as (tail, head) uint32 blocks — the
     out-of-core path: nothing but the current block is materialized.
     Honors partial-load ranges like :func:`read_dat`.
@@ -259,6 +260,15 @@ def iter_dat_blocks(path: str, block_edges: int, part: int = 0,
     range before the first block — the resume path of the external-memory
     build (ops/extmem.py): a checkpoint at block boundary k restarts the
     stream at ``k * block_edges`` instead of re-reading the prefix.
+
+    ``end_edge`` is ``start_edge``'s twin (ISSUE 13): the stream stops
+    after that many records of the range, so ``[start_edge, end_edge)``
+    is a contiguous record slice — the per-leg shard of the distributed
+    out-of-core build (ops/distext.py).  Both offsets count from the
+    range start, so a leg that resumes at block k passes
+    ``start_edge=shard_start + k * block_edges, end_edge=shard_end`` and
+    reads exactly the unfolded remainder of its shard.  An empty slice
+    (``end_edge <= start_edge``) yields no blocks.
 
     Raw records only: SHEEP_DDUP_GRAPH is NOT applied here (block-local
     dedup would differ from load-level dedup); a warning is emitted so the
@@ -293,9 +303,13 @@ def iter_dat_blocks(path: str, block_edges: int, part: int = 0,
     start, stop = partial_range(num_records, part, num_parts) if num_parts \
         else (0, num_records)
     sc = read_sidecar(path) if mode != "trust" else None
-    whole = (start, stop) == (0, num_records) and start_edge == 0
+    whole = (start, stop) == (0, num_records) and start_edge == 0 \
+        and end_edge is None
+    base = start
+    if end_edge is not None:
+        stop = min(stop, base + max(0, end_edge))
     if start_edge:
-        start = min(stop, start + start_edge)
+        start = min(stop, base + start_edge)
     if sc is not None and sc["size"] != nbytes:
         msg = (f"{path}: checksum mismatch (size {nbytes} != recorded "
                f"{sc['size']})")

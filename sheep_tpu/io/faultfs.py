@@ -13,7 +13,7 @@ Grammar — the I/O sibling of the supervisor's ``SHEEP_FAULT_PLAN``
     entry               = kind @ site : nth
     kind                = enospc | eio | short | slow
     site                = tre | seq | dat | net | sidecar | ckpt |
-                          wal | snap | manifest | other | *
+                          wal | snap | hist | manifest | other | *
     nth                 = 0-based index of the write at that site
 
 e.g. ``SHEEP_IO_FAULT_PLAN=enospc@ckpt:1,short@tre:0``.  Sites are
@@ -63,7 +63,7 @@ KINDS = ("enospc", "eio", "short", "slow")
 #: injectable with the same grammar as every offline site.
 _SITE_SUFFIXES = ((".sum", "sidecar"), (".tre", "tre"), (".seq", "seq"),
                   (".dat", "dat"), (".net", "net"), (".npz", "ckpt"),
-                  (".wal", "wal"), (".snap", "snap"))
+                  (".wal", "wal"), (".snap", "snap"), (".hist", "hist"))
 
 _ATTEMPT_RE = re.compile(r"\.a\d+$")
 

@@ -39,7 +39,11 @@ _LAZY = {
     # .extmem (jax-free)
     "build_forest_extmem": "extmem",
     "streaming_degree_sequence": "extmem",
+    "range_degree_histogram": "extmem",
     "should_use_extmem": "extmem",
+    # .distext (jax-free, ISSUE 13)
+    "run_distext": "distext",
+    "should_use_distext": "distext",
 }
 
 __all__ = sorted(_LAZY)

@@ -112,16 +112,21 @@ def should_use_extmem(path: str, governor: ResourceGovernor | None = None
     return (nbytes // _REC_BYTES) * 24 > head
 
 
-def streaming_degree_sequence(path: str, block_edges: int | None = None,
-                              max_retries: int = 3,
-                              backoff_base_s: float = 0.05,
-                              perf: dict | None = None):
-    """Out-of-core degree sequence: one prefetched pass over the ``.dat``
-    blocks accumulating the undirected-doubled histogram (native
-    ``sheep_degree_histogram_acc``; numpy bincount twin), then the host
-    counting sort.  Returns ``(seq uint32, max_vid, num_records)`` —
-    bit-identical to ``degree_sequence`` over the loaded file, at O(V)
-    resident.
+def range_degree_histogram(path: str, block_edges: int | None = None,
+                           start_edge: int = 0,
+                           end_edge: int | None = None,
+                           max_retries: int = 3,
+                           backoff_base_s: float = 0.05,
+                           perf: dict | None = None):
+    """Pass 1 over one contiguous record slice ``[start_edge, end_edge)``
+    of the ``.dat`` stream: per-block native histogram accumulation
+    (``sheep_degree_histogram_acc``; numpy bincount twin) through this
+    range's OWN prefetcher.  Returns ``(deg int64, max_vid, records)``.
+
+    Integer adds commute, so summing the per-range histograms of a
+    disjoint cover of the file IS the whole-file histogram bit for bit —
+    the Allreduce-shaped merge the distributed out-of-core build
+    (ops/distext.py) runs between its two passes.
 
     A typed reader fault (EIO/ENOSPC mid-stream — the ``dat`` I/O fault
     site) retries from the last consumed block: the histogram is exact
@@ -134,15 +139,17 @@ def streaming_degree_sequence(path: str, block_edges: int | None = None,
     records = 0
     max_vid = 0
     done = 0
-    t0 = time.perf_counter()
     read_s = 0.0
     policy = RetryPolicy(max_retries=max_retries,
                          backoff_base_s=backoff_base_s)
     attempt = 0
-    with obs.span("ext.seq", block_edges=block) as sp:
+    with obs.span("ext.hist", block_edges=block, start_edge=start_edge,
+                  end_edge=end_edge) as sp:
         while True:
             pf = BlockPrefetcher(
-                iter_dat_blocks(path, block, start_edge=done * block),
+                iter_dat_blocks(path, block,
+                                start_edge=start_edge + done * block,
+                                end_edge=end_edge),
                 depth=EXT_PREFETCH, trace_name="ext.seq.read")
             try:
                 with pf:
@@ -171,11 +178,33 @@ def streaming_degree_sequence(path: str, block_edges: int | None = None,
                 policy.sleep(policy.backoff(attempt))
                 attempt += 1
         sp.annotate(records=records, retries=attempt)
+    if perf is not None:
+        perf["hist_read_s"] = round(read_s, 4)
+        perf["hist_retries"] = attempt
+    return deg, max_vid, records
+
+
+def streaming_degree_sequence(path: str, block_edges: int | None = None,
+                              max_retries: int = 3,
+                              backoff_base_s: float = 0.05,
+                              perf: dict | None = None):
+    """Out-of-core degree sequence: one prefetched pass over the ``.dat``
+    blocks accumulating the undirected-doubled histogram
+    (:func:`range_degree_histogram` over the whole file), then the host
+    counting sort.  Returns ``(seq uint32, max_vid, num_records)`` —
+    bit-identical to ``degree_sequence`` over the loaded file, at O(V)
+    resident."""
+    t0 = time.perf_counter()
+    hist_perf: dict = {}
+    with obs.span("ext.seq"):
+        deg, max_vid, records = range_degree_histogram(
+            path, block_edges, max_retries=max_retries,
+            backoff_base_s=backoff_base_s, perf=hist_perf)
         seq = degree_sequence_from_degrees(deg)
     if perf is not None:
         perf["seq_s"] = round(time.perf_counter() - t0, 4)
-        perf["seq_read_s"] = round(read_s, 4)
-        perf["seq_retries"] = attempt
+        perf["seq_read_s"] = hist_perf["hist_read_s"]
+        perf["seq_retries"] = hist_perf["hist_retries"]
     return seq, max_vid, records
 
 
@@ -264,7 +293,9 @@ def build_forest_extmem(path: str, block_edges: int | None = None,
                         governor: ResourceGovernor | None = None,
                         integrity: str | None = None,
                         events: list | None = None,
-                        perf: dict | None = None):
+                        perf: dict | None = None,
+                        start_edge: int = 0,
+                        end_edge: int | None = None):
     """The external-memory build: ``(seq uint32 [m], Forest over m)``,
     bit-identical to ``build_forest`` over the loaded file, with peak
     resident memory O(n + block) beyond the interpreter — the edge list
@@ -283,6 +314,13 @@ def build_forest_extmem(path: str, block_edges: int | None = None,
     ``perf`` gains blocks/read_s/fold_s/overlap_s/overlap_frac (realized
     read/fold overlap, same accounting as the windowed handoff) and the
     per-strategy pick counts.
+    ``start_edge``/``end_edge`` — fold only the contiguous record slice
+    ``[start_edge, end_edge)`` of the stream (ISSUE 13): one leg of the
+    distributed out-of-core build.  The partial forests of a disjoint
+    cover merge associatively to the whole-file forest (the property the
+    tournament already carries); the slice is folded into the checkpoint
+    identity so a leg's checkpoint can never resume under a different
+    shard map.
     """
     t_start = time.perf_counter()
     events = events if events is not None else []
@@ -291,6 +329,14 @@ def build_forest_extmem(path: str, block_edges: int | None = None,
     # arg or SHEEP_EXT_BLOCK pins it — it is part of the resume identity)
     block = block_edges or gov.ext_fitted_block()
     if seq is None:
+        if (start_edge, end_edge) != (0, None):
+            # a RANGE build always takes the shared whole-input sequence
+            # (ops/distext.py's histogram merge): a sequence derived from
+            # one shard's records would make the partial forests
+            # unmergeable (different position spaces)
+            raise ValueError(
+                "a range build (start_edge/end_edge) needs an explicit "
+                "seq — pass the shared whole-input sequence")
         seq, _, _ = streaming_degree_sequence(
             path, block, max_retries=max_retries,
             backoff_base_s=backoff_base_s, perf=perf)
@@ -299,8 +345,12 @@ def build_forest_extmem(path: str, block_edges: int | None = None,
     if n == 0:
         return seq, Forest(np.empty(0, np.uint32), np.empty(0, np.uint32))
     # block size is part of the resume identity: boundary k means
-    # "k * block_edges records folded", which only holds at this block
+    # "k * block_edges records folded", which only holds at this block.
+    # A record slice is too: the same boundary in a different shard map
+    # names different records, so the range folds into the signature.
     sig = input_signature(n, seq) + f"|ext:b{block}"
+    if (start_edge, end_edge) != (0, None):
+        sig += f"|range:{start_edge}:{end_edge}"
     ckpt = Checkpointer(checkpoint_dir, checkpoint_every, governor=gov) \
         if checkpoint_dir else None
     fold = _ExtFold(n, sequence_positions(seq))
@@ -341,7 +391,7 @@ def build_forest_extmem(path: str, block_edges: int | None = None,
     while True:
         try:
             _stream_fold(path, block, seq, sig, fold, progress, ckpt,
-                         events, stats)
+                         events, stats, start_edge, end_edge)
             break
         except OSError as exc:
             # a typed environmental reader fault (EIO/ENOSPC mid-stream):
@@ -380,14 +430,17 @@ def build_forest_extmem(path: str, block_edges: int | None = None,
 def _stream_fold(path: str, block: int, seq: np.ndarray, sig: str,
                  fold: _ExtFold, progress: dict,
                  ckpt: Checkpointer | None,
-                 events: list, stats: dict) -> None:
+                 events: list, stats: dict,
+                 start_edge: int = 0, end_edge: int | None = None) -> None:
     """One streaming attempt from block ``progress["done"]`` on, bumping
     it per folded block (in place, so a mid-stream fault keeps the
     completed prefix).  Reader faults (OSError) propagate to the
     caller's retry loop with the fold state intact — the prefetcher's
     producer thread re-raises them typed at the consumption point."""
     t0 = time.perf_counter()
-    it = iter_dat_blocks(path, block, start_edge=progress["done"] * block)
+    it = iter_dat_blocks(path, block,
+                         start_edge=start_edge + progress["done"] * block,
+                         end_edge=end_edge)
     with obs.span("ext.stream", start_block=progress["done"]), \
             BlockPrefetcher(it, depth=EXT_PREFETCH,
                             trace_name="ext.read") as pf:

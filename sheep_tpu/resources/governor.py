@@ -43,6 +43,8 @@ MEM_BUDGET_ENV = "SHEEP_MEM_BUDGET"
 DISK_BUDGET_ENV = "SHEEP_DISK_BUDGET"
 SCRATCH_DIR_ENV = "SHEEP_SCRATCH_DIR"
 EXT_BLOCK_ENV = "SHEEP_EXT_BLOCK"
+DISTEXT_LEGS_ENV = "SHEEP_DISTEXT_LEGS"
+LEG_CORES_ENV = "SHEEP_LEG_CORES"
 
 #: free space a preflighted write must leave behind (the filesystem needs
 #: breathing room for directory blocks, the sidecar, and the journal; a
@@ -279,6 +281,58 @@ def ext_strategy_costs(n: int, carry_links: int, block_records: int) -> dict:
     }
 
 
+#: the ext rung's block floor (ext_fitted_block): below this the
+#: per-block O(n) merge swamps the stream, so a budget that cannot hold
+#: even this block has no single-process out-of-core path left
+EXT_BLOCK_FLOOR = 1 << 14
+
+
+def distext_forced_legs() -> int:
+    """The operator-pinned leg count of the distributed out-of-core
+    build (``SHEEP_DISTEXT_LEGS``); 0 = unset (the planner picks)."""
+    spec = os.environ.get(DISTEXT_LEGS_ENV, "")
+    if not spec:
+        return 0
+    legs = int(spec)
+    if legs < 0:
+        raise ValueError(f"{DISTEXT_LEGS_ENV}={legs} must be >= 0")
+    return legs
+
+
+def distext_leg_plan(n: int = 0, governor: "ResourceGovernor | None" = None
+                     ) -> dict:
+    """The distext planner (ISSUE 13): how many supervised ext legs to
+    shard a ``.dat`` across, and what one leg's priced peak is.
+
+    ``SHEEP_DISTEXT_LEGS`` pins N (the operator's word).  Otherwise N
+    starts at the host's concurrency budget — ``host_cores //
+    SHEEP_LEG_CORES`` (the same arithmetic the supervisor throttles
+    attempts with), floor 2 so a distext request always shards — and is
+    then cut while the AGGREGATE of per-leg peaks (the ext formula at
+    the leg's fitted block; each leg is its own process under its own
+    ``SHEEP_MEM_BUDGET``, but they run concurrently on one host) cannot
+    fit the configured budget.  Returns
+    ``{"legs", "per_leg_peak_bytes", "block_edges", "forced"}``."""
+    gov = governor if governor is not None else ResourceGovernor.from_env()
+    block = gov.ext_fitted_block(n)
+    per_leg = rung_peak_nbytes("ext", n, 0, ext_block=block)
+    forced = distext_forced_legs()
+    if forced:
+        return {"legs": forced, "per_leg_peak_bytes": per_leg,
+                "block_edges": block, "forced": True}
+    leg_cores = int(os.environ.get(LEG_CORES_ENV, "0") or 0)
+    try:
+        host = len(os.sched_getaffinity(0))
+    except (AttributeError, OSError):
+        host = os.cpu_count() or 1
+    legs = max(2, host // max(1, leg_cores))
+    budget = gov.mem_budget
+    while legs > 2 and budget is not None and legs * per_leg > budget:
+        legs -= 1
+    return {"legs": legs, "per_leg_peak_bytes": per_leg,
+            "block_edges": block, "forced": False}
+
+
 @dataclass
 class ResourceGovernor:
     """One process's budget state.  ``None`` budget = unlimited (every
@@ -344,7 +398,7 @@ class ResourceGovernor:
         head = self.mem_headroom()
         if head is None:
             return block
-        while block > (1 << 14) \
+        while block > EXT_BLOCK_FLOOR \
                 and 32 * n + EXT_RECORD_BYTES * block > head:
             block //= 2
         return block

@@ -26,7 +26,8 @@ from .chaos import (ChaosFault, ChaosPlan, SupervisorKilled, parse_fault_plan,
                     plan_from_env)
 from .heartbeat import HeartbeatWriter, beat, is_stale, last_beat_s
 from .manifest import (Leg, Manifest, load_manifest, manifest_path,
-                       plan_tournament, save_manifest, tournament_rounds)
+                       plan_distext, plan_tournament, save_manifest,
+                       tournament_rounds)
 from .status import render_status, status_rows
 from .supervise import (InlineRunner, SubprocessRunner, SupervisionFailed,
                         SupervisorConfig, TournamentSupervisor, reconcile,
@@ -51,6 +52,7 @@ __all__ = [
     "manifest_path",
     "parse_fault_plan",
     "plan_from_env",
+    "plan_distext",
     "plan_tournament",
     "reconcile",
     "render_status",

@@ -39,6 +39,11 @@ MANIFEST_VERSION = 1
 PENDING = "pending"
 DONE = "done"
 
+#: the round number of the distext histogram legs (ISSUE 13): they run
+#: BEFORE the sort round (-1), which for a distext job is the
+#: supervisor-serviced histogram merge
+HIST_ROUND = -2
+
 
 @dataclass
 class Leg:
@@ -66,6 +71,13 @@ class Manifest:
     graph_bytes: int       # guards resume against a swapped input file
     version: int = MANIFEST_VERSION
     sig: str | None = None  # input signature shared by every .tre artifact
+    #: the distext shard map (ISSUE 13): one [start_edge, end_edge)
+    #: record slice per leg, in leg-index order — None for a plain
+    #: tournament.  Durable because it IS the resume identity: a leg's
+    #: checkpoint folds its slice into its input_sig, so a manifest
+    #: resumed under a different shard map could never publish anyway;
+    #: persisting the map makes the refusal explicit and up-front.
+    shards: list | None = None
     legs: list[Leg] = field(default_factory=list)
 
     def leg(self, key: str) -> Leg:
@@ -121,15 +133,70 @@ def plan_tournament(graph: str, prefix: str, final_tree: str, workers: int,
         seq_file = f"{prefix}.seq"
         legs.append(Leg(key="sort", kind="sort", round=-1, index=0,
                         inputs=[], output=seq_file))
+    legs += _bracket_legs(prefix, final_tree, workers, reduction,
+                          seq_file, "map")
+    return Manifest(graph=graph, workers=workers, reduction=reduction,
+                    seq_file=seq_file, final_tree=final_tree,
+                    graph_bytes=_graph_bytes(graph), legs=legs)
+
+
+def plan_distext(graph: str, prefix: str, final_tree: str,
+                 shards: list, reduction: int) -> Manifest:
+    """Plan the distributed out-of-core job (ISSUE 13): one ``hist`` leg
+    per record shard (pass 1: the per-range degree histogram, a sealed
+    ``.hist`` artifact), the supervisor-serviced ``histsum`` merge (the
+    Allreduce: integer adds commute, so the summed histogram — and the
+    counting-sorted sequence it publishes — is bit-identical to the
+    single-host pass), one ``distmap`` leg per shard (pass 2: the ext
+    pipeline over the range under the leg's own budget), then the
+    SAME merge tournament every other tree takes.
+
+    ``shards`` is the [start_edge, end_edge) record cover, one slice per
+    leg; it persists in the manifest because it is the resume identity
+    (Manifest.shards)."""
+    if not shards:
+        raise ValueError("distext needs at least one shard")
+    legs: list[Leg] = []
+    for i, (a, b) in enumerate(shards):
+        legs.append(Leg(key=f"h.{i:02d}", kind="hist", round=HIST_ROUND,
+                        index=i, inputs=[],
+                        output=f"{prefix}{i:02d}.hist"))
+    seq_file = f"{prefix}.seq"
+    legs.append(Leg(key="sort", kind="histsum", round=-1, index=0,
+                    inputs=[leg.output for leg in legs], output=seq_file))
+    legs += _bracket_legs(prefix, final_tree, len(shards), reduction,
+                          seq_file, "distmap")
+    return Manifest(graph=graph, workers=len(shards), reduction=reduction,
+                    seq_file=seq_file, final_tree=final_tree,
+                    graph_bytes=_graph_bytes(graph),
+                    shards=[[int(a), int(b)] for a, b in shards],
+                    legs=legs)
+
+
+def _graph_bytes(graph: str) -> int:
+    try:
+        return os.path.getsize(graph)
+    except OSError:
+        return -1
+
+
+def _bracket_legs(prefix: str, final_tree: str, workers: int,
+                  reduction: int, seq_file: str,
+                  map_kind: str) -> list[Leg]:
+    """The map + merge-tournament legs shared by the plain tournament
+    (``map`` legs: partial in-RAM loads) and the distext job (``distmap``
+    legs: streamed record slices) — identical bracket arithmetic, so the
+    merge tournament cannot fork between them."""
 
     def tre(idx: int, rnd: int) -> str:
         return f"{prefix}{idx:02d}r{rnd}.tre"
 
+    legs: list[Leg] = []
     rounds = tournament_rounds(workers, reduction) if workers > 1 else []
     for i in range(workers):
         # a 1-worker "tournament" maps straight into the final tree
         out = tre(i, 0) if rounds else final_tree
-        legs.append(Leg(key=f"r0.{i:02d}", kind="map", round=0, index=i,
+        legs.append(Leg(key=f"r0.{i:02d}", kind=map_kind, round=0, index=i,
                         inputs=[seq_file], output=out))
     for s, slots in enumerate(rounds):
         last = s == len(rounds) - 1
@@ -140,13 +207,7 @@ def plan_tournament(graph: str, prefix: str, final_tree: str, workers: int,
                 kind="merge" if len(src) > 1 else "copy",
                 round=s + 1, index=i,
                 inputs=[tre(j, s) for j in src], output=out))
-    try:
-        graph_bytes = os.path.getsize(graph)
-    except OSError:
-        graph_bytes = -1
-    return Manifest(graph=graph, workers=workers, reduction=reduction,
-                    seq_file=seq_file, final_tree=final_tree,
-                    graph_bytes=graph_bytes, legs=legs)
+    return legs
 
 
 def manifest_path(state_dir: str) -> str:

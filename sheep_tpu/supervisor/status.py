@@ -24,6 +24,7 @@ from __future__ import annotations
 
 import glob
 import os
+import re
 import time
 
 from ..resources.governor import (ResourceGovernor, dir_usage, disk_free,
@@ -118,9 +119,45 @@ def _trace_lines(state_dir: str) -> list[str]:
     return lines
 
 
-def status_rows(manifest: Manifest, now: float | None = None) -> list[dict]:
+#: the block size a leg's ext checkpoint was written at, recoverable
+#: from its input_sig (ops/extmem: ``...|ext:b{block}|range:{a}:{b}``)
+_SIG_BLOCK_RE = re.compile(r"\|ext:b(\d+)\b")
+
+
+def _ext_progress(manifest: Manifest, leg, state_dir: str | None):
+    """(blocks_done, blocks_total) of a distmap leg, read from the leg's
+    own block-boundary checkpoint (ISSUE 13) — None when the leg never
+    checkpointed (not yet dispatched, or already finished and cleared)
+    or the dir is unknown.  Read in trust mode: a status view reports, a
+    resume verifies."""
+    if leg.kind != "distmap" or state_dir is None \
+            or manifest.shards is None:
+        return None
+    from ..ops.distext import leg_checkpoint_dir
+    from ..runtime.snapshot import SNAPSHOT_NAME, load_snapshot
+    path = os.path.join(leg_checkpoint_dir(state_dir, leg.key),
+                        SNAPSHOT_NAME)
+    try:
+        snap = load_snapshot(path, integrity="trust")
+    except Exception:
+        return None
+    m = _SIG_BLOCK_RE.search(snap.input_sig)
+    if m is None:
+        return None
+    block = int(m.group(1))
+    a, b = manifest.shards[leg.index]
+    total = -(-max(0, int(b) - int(a)) // block) if block else 0
+    return snap.rounds, total
+
+
+def status_rows(manifest: Manifest, now: float | None = None,
+                state_dir: str | None = None) -> list[dict]:
     """One dict per leg: key/kind/round/state/dispatches/artifact bytes
-    (None = absent)/heartbeat age seconds (None = never beat)."""
+    (None = absent)/heartbeat age seconds (None = never beat).  distmap
+    legs (the distributed out-of-core build, ISSUE 13) additionally
+    report ``ext_blocks_done``/``ext_blocks_total`` from their own
+    block-boundary checkpoint when ``state_dir`` is given — mid-leg
+    progress an operator can read next to the heartbeat age."""
     now = time.time() if now is None else now
     rows = []
     for leg in manifest.legs:
@@ -128,10 +165,14 @@ def status_rows(manifest: Manifest, now: float | None = None) -> list[dict]:
             size = os.path.getsize(leg.output)
         except OSError:
             size = None
-        rows.append(dict(
+        row = dict(
             key=leg.key, kind=leg.kind, round=leg.round, state=leg.state,
             dispatches=leg.dispatches, artifact_bytes=size,
-            heartbeat_age_s=_newest_heartbeat_age(leg.output, now)))
+            heartbeat_age_s=_newest_heartbeat_age(leg.output, now))
+        prog = _ext_progress(manifest, leg, state_dir)
+        if prog is not None:
+            row["ext_blocks_done"], row["ext_blocks_total"] = prog
+        rows.append(row)
     return rows
 
 
@@ -146,7 +187,7 @@ def status_json(state_dir: str, integrity: str | None = None,
     manifest = load_manifest(state_dir, integrity)
     gov = governor if governor is not None else ResourceGovernor.from_env()
     now = time.time() if now is None else now
-    rows = status_rows(manifest, now)
+    rows = status_rows(manifest, now, state_dir)
     usage = dir_usage(state_dir)
     rss = rss_bytes()
     out = {
@@ -189,12 +230,12 @@ def render_status(state_dir: str, integrity: str | None = None,
     manifest = load_manifest(state_dir, integrity)
     gov = governor if governor is not None else ResourceGovernor.from_env()
     now = time.time() if now is None else now
-    rows = status_rows(manifest, now)
+    rows = status_rows(manifest, now, state_dir)
     done = sum(1 for r in rows if r["state"] == DONE)
     dispatches = sum(r["dispatches"] for r in rows)
 
-    head = f"{'LEG':<8} {'KIND':<6} {'STATE':<8} {'DISP':>4} " \
-           f"{'ARTIFACT':>9} {'HEARTBEAT':>9}"
+    head = f"{'LEG':<8} {'KIND':<7} {'STATE':<8} {'DISP':>4} " \
+           f"{'ARTIFACT':>9} {'HEARTBEAT':>9} {'PROGRESS':>9}"
     lines = [
         f"supervised tournament: {manifest.graph}",
         f"state dir: {state_dir}",
@@ -205,11 +246,16 @@ def render_status(state_dir: str, integrity: str | None = None,
         "-" * len(head),
     ]
     for r in rows:
+        # distmap legs show blocks-done/total from their own checkpoint
+        # (ISSUE 13): mid-leg progress next to the liveness signal
+        prog = "-"
+        if "ext_blocks_done" in r:
+            prog = f"{r['ext_blocks_done']}/{r['ext_blocks_total']}blk"
         lines.append(
-            f"{r['key']:<8} {r['kind']:<6} {r['state']:<8} "
+            f"{r['key']:<8} {r['kind']:<7} {r['state']:<8} "
             f"{r['dispatches']:>4} "
             f"{_fmt_bytes(r['artifact_bytes']):>9} "
-            f"{_fmt_age(r['heartbeat_age_s']):>9}")
+            f"{_fmt_age(r['heartbeat_age_s']):>9} {prog:>9}")
 
     usage = dir_usage(state_dir)
     free = disk_free(state_dir)

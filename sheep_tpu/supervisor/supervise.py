@@ -491,6 +491,25 @@ class TournamentSupervisor:
             return ["graph2tree", m.graph,
                     "-l", f"{leg.index + 1}/{m.workers}",
                     "-s", m.seq_file, "-o", "@OUT@"]
+        if leg.kind == "hist":
+            # distext pass 1 (ISSUE 13): this shard's degree histogram
+            a, b = m.shards[leg.index]
+            return ["distext", "hist", m.graph, "-r", f"{a}:{b}",
+                    "-o", "@OUT@"]
+        if leg.kind == "distmap":
+            # distext pass 2: the ext carry fold over this shard, under
+            # the leg's own budget, checkpointing at block boundaries in
+            # a per-leg dir (the slice is folded into the checkpoint
+            # identity, so a re-dispatch resumes — and a foreign shard
+            # map is refused); the leg self-reports perf + proc_status
+            from ..ops.distext import leg_checkpoint_dir, leg_perf_path
+            a, b = m.shards[leg.index]
+            return ["distext", "map", m.graph, "-r", f"{a}:{b}",
+                    "-s", m.seq_file, "-o", "@OUT@",
+                    "--checkpoint-dir",
+                    leg_checkpoint_dir(self.state_dir, leg.key),
+                    "--resume",
+                    "--perf-out", leg_perf_path(self.state_dir, leg.key)]
         if leg.kind == "merge":
             argv = ["merge_trees"] + list(leg.inputs) + ["-o", "@OUT@"]
             if m.sig:
@@ -506,6 +525,34 @@ class TournamentSupervisor:
             if os.path.exists(src + ".sum"):
                 shutil.copyfile(src + ".sum", tmp + ".sum")
             shutil.copyfile(src, tmp)
+            return 0
+
+        return _ThreadHandle(target)
+
+    def _start_histsum(self, leg: Leg, tmp: str, hb_path: str):
+        """The distext Allreduce (ISSUE 13), serviced by the supervisor
+        itself like a copy leg: sum the published per-range histograms
+        (integer adds commute — the result is the whole-file histogram
+        bit for bit), counting-sort it, and publish the shared sequence
+        every pass-2 leg builds over.  A stale histogram from a foreign
+        shard map is a failed attempt here (merge_histograms checks each
+        input against the manifest's shard map), never a wrong sequence.
+        """
+        inputs = list(leg.inputs)
+        shards = self.manifest.shards
+        integrity = self.config.integrity
+
+        def target() -> int:
+            from ..obs import trace as obs
+            beat(hb_path)
+            with obs.span("distext.hist_merge", legs=len(inputs)):
+                from ..core.sequence import degree_sequence_from_degrees
+                from ..io.seqfile import write_sequence
+                from ..ops.distext import merge_histograms, read_histogram
+                hists = [read_histogram(p, integrity=integrity)
+                         for p in inputs]
+                deg = merge_histograms(hists, expect_shards=shards)
+                write_sequence(degree_sequence_from_degrees(deg), tmp)
             return 0
 
         return _ThreadHandle(target)
@@ -532,6 +579,8 @@ class TournamentSupervisor:
             handle = _HangHandle()
         elif leg.kind == "copy":
             handle = self._start_copy(leg, tmp, hb)
+        elif leg.kind == "histsum":
+            handle = self._start_histsum(leg, tmp, hb)
         else:
             argv = [a.replace("@OUT@", tmp) for a in self._leg_argv(leg)]
             handle = self.runner.start(argv, hb, log)
@@ -540,6 +589,9 @@ class TournamentSupervisor:
         self._running.setdefault(leg.key, []).append(att)
         self.events.append(("dispatch", leg.key, n)
                            if not speculative else ("speculate", leg.key, n))
+        from ..obs import trace as obs
+        obs.event("supervise.dispatch", key=leg.key, kind=leg.kind,
+                  round=leg.round, attempt=n, speculative=speculative)
         save_manifest(self.manifest, self.state_dir)
 
     # -- completion --------------------------------------------------------
@@ -552,6 +604,9 @@ class TournamentSupervisor:
         _discard(att.hb)
         leg.state = DONE
         self.events.append(("publish", leg.key))
+        from ..obs import trace as obs
+        obs.event("supervise.publish", key=leg.key, kind=leg.kind,
+                  round=leg.round)
         save_manifest(self.manifest, self.state_dir)
         self._maybe_gc()
         # siblings (speculative twins) lost the race: cancel + discard

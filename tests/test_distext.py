@@ -1,0 +1,550 @@
+"""Distributed out-of-core build (ISSUE 13): supervised ext legs over
+contiguous ``.dat`` record slices, the Allreduce-shaped histogram merge,
+and the tournament forest merge.  Covered here: the ``end_edge`` range
+reader (exact boundary records, empty ranges, range + ``start_edge``
+resume interaction), per-range histogram parity (summed per-leg
+histograms ARE the whole-file histogram), the sealed ``.hist`` artifact
++ its fsck checks and the manifest shard-map chain, per-leg range builds
+through the ext carry fold (parity, block-boundary checkpoint/resume,
+foreign-shard-map refusal), the supervised job end to end
+(oracle-bit-identical trees, exact dispatch counts), the chaos sweep at
+every round (kill/corrupt/hang per leg, supervisor stop + resume with
+only dirty legs re-dispatched), the ``dat``-site EIO sweep, the
+governor's leg planner + CLI routing, and ``--status`` per-leg ext
+progress."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from sheep_tpu.core import build_forest, degree_sequence
+from sheep_tpu.io import faultfs
+from sheep_tpu.io.edges import iter_dat_blocks, write_dat
+from sheep_tpu.ops.distext import (merge_histograms, plan_shards,
+                                   read_histogram, run_distext,
+                                   should_use_distext, write_histogram)
+from sheep_tpu.ops.extmem import build_forest_extmem, range_degree_histogram
+from sheep_tpu.supervisor import (InlineRunner, SupervisionFailed,
+                                  SupervisorConfig, SupervisorKilled,
+                                  parse_fault_plan)
+
+pytestmark = pytest.mark.filterwarnings("ignore::UserWarning")
+
+
+@pytest.fixture
+def dist_env(monkeypatch):
+    for k in ("SHEEP_EXT_BLOCK", "SHEEP_EXT_STRATEGY", "SHEEP_MEM_BUDGET",
+              "SHEEP_DISK_BUDGET", "SHEEP_IO_FAULT_PLAN",
+              "SHEEP_FAULT_INJECT", "SHEEP_FAULT_PLAN",
+              "SHEEP_DISTEXT_LEGS", "SHEEP_LEG_CORES", "SHEEP_WORKERS"):
+        monkeypatch.delenv(k, raising=False)
+    faultfs.clear_plan()
+    from sheep_tpu.runtime import clear_plan, reset_counters
+    clear_plan()
+    reset_counters()
+    yield monkeypatch
+    faultfs.clear_plan()
+    clear_plan()
+
+
+def _graph_file(tmp_path, log_n=9, seed=41):
+    from sheep_tpu.utils.synth import rmat_edges
+    n = 1 << log_n
+    tail, head = rmat_edges(log_n, 4 * n, seed=seed)
+    path = str(tmp_path / "g.dat")
+    write_dat(path, tail, head)
+    seq = degree_sequence(tail, head)
+    want = build_forest(tail, head, seq)
+    return path, tail, head, seq, want
+
+
+def _config(**kw):
+    kw.setdefault("workers", 2)
+    kw.setdefault("poll_s", 0.01)
+    kw.setdefault("backoff_base_s", 0.0)
+    kw.setdefault("grammar", False)
+    return SupervisorConfig(**kw)
+
+
+def _run(path, state_dir, legs=2, **kw):
+    cfg = _config(**kw)
+    m = run_distext(path, str(state_dir), cfg, runner=InlineRunner(0.05),
+                    legs=legs)
+    with open(m.final_tree, "rb") as f:
+        return f.read(), m
+
+
+# ---------------------------------------------------------------------------
+# iter_dat_blocks(end_edge=...): the range reader legs stream through
+# ---------------------------------------------------------------------------
+
+
+def _collect(path, block, **kw):
+    pairs = list(iter_dat_blocks(path, block, **kw))
+    if not pairs:
+        return np.empty(0, np.uint32), np.empty(0, np.uint32)
+    return (np.concatenate([t for t, _ in pairs]),
+            np.concatenate([h for _, h in pairs]))
+
+
+def test_end_edge_exact_boundary_records(tmp_path, dist_env):
+    """[start_edge, end_edge) delivers exactly that record slice — the
+    boundary records land on the correct side for every cut, including
+    cuts that do not align with the block size."""
+    path, tail, head, _, _ = _graph_file(tmp_path)
+    E = len(tail)
+    for a, b in ((0, E), (0, 1), (1, 2), (100, 612), (E - 1, E),
+                 (0, E // 2), (E // 2, E), (7, 7 + 333)):
+        t, h = _collect(path, 100, start_edge=a, end_edge=b)
+        np.testing.assert_array_equal(t, tail[a:b])
+        np.testing.assert_array_equal(h, head[a:b])
+
+
+def test_end_edge_empty_and_overlong_ranges(tmp_path, dist_env):
+    path, tail, head, _, _ = _graph_file(tmp_path)
+    E = len(tail)
+    for a, b in ((5, 5), (10, 3), (E, E), (E, E + 50)):
+        t, _ = _collect(path, 64, start_edge=a, end_edge=b)
+        assert len(t) == 0, (a, b)
+    # end_edge past the file clamps to the file
+    t, h = _collect(path, 64, start_edge=E - 3, end_edge=E + 99)
+    np.testing.assert_array_equal(t, tail[E - 3:])
+    np.testing.assert_array_equal(h, head[E - 3:])
+
+
+def test_end_edge_with_start_edge_resume(tmp_path, dist_env):
+    """The leg-resume shape: a shard [A, B) interrupted after k blocks
+    re-opens at start_edge=A + k*block with the SAME end_edge and reads
+    exactly the unfolded remainder."""
+    path, tail, head, _, _ = _graph_file(tmp_path)
+    A, B, block = 300, 1700, 128
+    whole_t, _ = _collect(path, block, start_edge=A, end_edge=B)
+    np.testing.assert_array_equal(whole_t, tail[A:B])
+    for k in (1, 3, 7):
+        t, h = _collect(path, block, start_edge=A + k * block, end_edge=B)
+        np.testing.assert_array_equal(t, tail[A + k * block: B])
+        np.testing.assert_array_equal(h, head[A + k * block: B])
+
+
+def test_end_edge_composes_with_partial_load(tmp_path, dist_env):
+    """end_edge counts from the PARTIAL range start, like start_edge."""
+    from sheep_tpu.io.edges import partial_range
+    path, tail, head, _, _ = _graph_file(tmp_path)
+    a, b = partial_range(len(tail), 2, 3)
+    t, _ = _collect(path, 50, part=2, num_parts=3, start_edge=10,
+                    end_edge=200)
+    np.testing.assert_array_equal(t, tail[a + 10: a + 200])
+
+
+# ---------------------------------------------------------------------------
+# shard plan + per-range histograms: the Allreduce is exact
+# ---------------------------------------------------------------------------
+
+
+def test_plan_shards_cover_and_disjoint(dist_env):
+    for records in (0, 1, 7, 1000, 2048):
+        for legs in (1, 2, 3, 7):
+            shards = plan_shards(records, legs)
+            assert len(shards) == legs
+            assert shards[0][0] == 0 and shards[-1][1] == records
+            for (_, b0), (a1, _) in zip(shards, shards[1:]):
+                assert b0 == a1  # contiguous, edge-disjoint
+    with pytest.raises(ValueError):
+        plan_shards(100, 0)
+
+
+def test_range_histograms_sum_to_whole_file(tmp_path, dist_env):
+    """Integer adds commute: the summed per-range histograms equal the
+    whole-file histogram bit for bit, for every shard count."""
+    path, tail, head, seq0, _ = _graph_file(tmp_path, seed=43)
+    from sheep_tpu.core.sequence import degree_sequence_from_degrees
+    whole, max_vid, records = range_degree_histogram(path, 300)
+    assert records == len(tail)
+    for legs in (2, 3, 5):
+        hists = []
+        for a, b in plan_shards(len(tail), legs):
+            deg, mv, rec = range_degree_histogram(
+                path, 300, start_edge=a, end_edge=b)
+            assert rec == b - a
+            hists.append({"deg": deg[: mv + 1 if rec else 0],
+                          "records": rec, "max_vid": mv,
+                          "start": a, "end": b})
+        summed = merge_histograms(hists)
+        np.testing.assert_array_equal(summed[: max_vid + 1],
+                                      whole[: max_vid + 1])
+        np.testing.assert_array_equal(
+            degree_sequence_from_degrees(summed), seq0)
+
+
+# ---------------------------------------------------------------------------
+# the sealed .hist artifact + fsck
+# ---------------------------------------------------------------------------
+
+
+def test_hist_artifact_roundtrip_and_fsck(tmp_path, dist_env):
+    path, tail, head, _, _ = _graph_file(tmp_path)
+    deg, mv, rec = range_degree_histogram(path, 500, start_edge=100,
+                                          end_edge=900)
+    hp = str(tmp_path / "x.hist")
+    write_histogram(hp, deg, rec, mv, 100, 900)
+    h = read_histogram(hp)
+    assert (h["records"], h["start"], h["end"]) == (800, 100, 900)
+    assert int(h["deg"].sum()) == 2 * 800
+    from sheep_tpu.integrity.fsck import fsck_file
+    assert "range=[100:900)" in fsck_file(hp)
+    # byte-identical artifacts for byte-identical ranges (sealed, so the
+    # supervisor's publish-time fsck can vouch for them)
+    write_histogram(str(tmp_path / "y.hist"), deg, rec, mv, 100, 900)
+    assert open(hp, "rb").read() == \
+        open(str(tmp_path / "y.hist"), "rb").read()
+
+
+def test_hist_fsck_refuses_corruption(tmp_path, dist_env):
+    from sheep_tpu.integrity.errors import IntegrityError
+    path, tail, head, _, _ = _graph_file(tmp_path)
+    deg, mv, rec = range_degree_histogram(path, 500, end_edge=800)
+    hp = str(tmp_path / "x.hist")
+    write_histogram(hp, deg, rec, mv, 0, 800)
+    with open(hp, "r+b") as f:  # flip one payload byte under the sidecar
+        f.seek(40)
+        b = f.read(1)
+        f.seek(-1, 1)
+        f.write(bytes([b[0] ^ 0xFF]))
+    with pytest.raises(IntegrityError):
+        read_histogram(hp)
+    # even in trust mode (no checksum), the structural invariants catch
+    # a histogram whose totals disagree with its recorded range
+    with pytest.raises(IntegrityError):
+        read_histogram(hp, integrity="trust")
+
+
+def test_hist_merge_refuses_foreign_shard_map(tmp_path, dist_env):
+    from sheep_tpu.integrity.errors import MalformedArtifact
+    path, tail, head, _, _ = _graph_file(tmp_path)
+    deg, mv, rec = range_degree_histogram(path, 500, end_edge=1000)
+    h = {"deg": deg[: mv + 1], "records": rec, "max_vid": mv,
+         "start": 0, "end": 1000}
+    with pytest.raises(MalformedArtifact, match="shard map"):
+        merge_histograms([h], expect_shards=[[0, 999]])
+    with pytest.raises(MalformedArtifact, match="shard"):
+        merge_histograms([h, h], expect_shards=[[0, 1000]])
+
+
+# ---------------------------------------------------------------------------
+# per-leg range builds: parity + checkpoint identity
+# ---------------------------------------------------------------------------
+
+
+def test_range_build_matches_partial_oracle(tmp_path, dist_env):
+    """A leg's forest over [a, b) equals build_forest over that record
+    slice with the shared sequence — the exact map-leg contract, so the
+    tournament merge carries it unchanged."""
+    path, tail, head, seq0, _ = _graph_file(tmp_path, seed=45)
+    n = int(max(tail.max(), head.max()))
+    for a, b in plan_shards(len(tail), 3):
+        want = build_forest(tail[a:b], head[a:b], seq0, max_vid=n)
+        seq, f = build_forest_extmem(path, block_edges=300, seq=seq0,
+                                     start_edge=a, end_edge=b)
+        np.testing.assert_array_equal(f.parent, want.parent)
+        np.testing.assert_array_equal(f.pst_weight, want.pst_weight)
+
+
+def test_range_build_requires_shared_seq(tmp_path, dist_env):
+    path, *_ = _graph_file(tmp_path)
+    with pytest.raises(ValueError, match="shared"):
+        build_forest_extmem(path, start_edge=0, end_edge=100)
+
+
+def test_range_build_kill_resume_and_shard_identity(tmp_path, dist_env):
+    """Kill a range build at a block boundary: a resume completes
+    bit-identically (the checkpoint carries the range); the same
+    checkpoint under a DIFFERENT range is refused — a leg can never
+    resume under a foreign shard map."""
+    from sheep_tpu.integrity.errors import IntegrityError
+    from sheep_tpu.runtime import (BuildKilled, FaultPlan, clear_plan,
+                                   install_plan, reset_counters)
+    path, tail, head, seq0, _ = _graph_file(tmp_path, seed=47)
+    n = int(max(tail.max(), head.max()))
+    a, b = 200, 1800
+    want = build_forest(tail[a:b], head[a:b], seq0, max_vid=n)
+    ck = str(tmp_path / "ck")
+    reset_counters()
+    install_plan(FaultPlan(site="ext-boundary", at=2, kind="kill"))
+    with pytest.raises(BuildKilled):
+        build_forest_extmem(path, block_edges=300, seq=seq0,
+                            start_edge=a, end_edge=b, checkpoint_dir=ck)
+    clear_plan()
+    reset_counters()
+    with pytest.raises(IntegrityError):
+        build_forest_extmem(path, block_edges=300, seq=seq0,
+                            start_edge=a - 100, end_edge=b,
+                            checkpoint_dir=ck, resume=True)
+    events = []
+    seq, f = build_forest_extmem(path, block_edges=300, seq=seq0,
+                                 start_edge=a, end_edge=b,
+                                 checkpoint_dir=ck, resume=True,
+                                 events=events)
+    assert any(e[0] == "ext-resume" for e in events), events
+    np.testing.assert_array_equal(f.parent, want.parent)
+    np.testing.assert_array_equal(f.pst_weight, want.pst_weight)
+
+
+# ---------------------------------------------------------------------------
+# the supervised job end to end
+# ---------------------------------------------------------------------------
+
+
+def test_distext_oracle_bit_identical(tmp_path, dist_env):
+    from sheep_tpu.io.trefile import read_tree
+    path, tail, head, seq0, want = _graph_file(tmp_path)
+    for legs in (1, 2, 3):
+        _, m = _run(path, tmp_path / f"st{legs}", legs=legs)
+        parent, pst = read_tree(m.final_tree)
+        np.testing.assert_array_equal(parent, want.parent)
+        np.testing.assert_array_equal(pst, want.pst_weight)
+        assert all(leg.dispatches == 1 for leg in m.legs)
+        # the shared sequence the histsum published IS the oracle's
+        from sheep_tpu.io.seqfile import read_sequence
+        np.testing.assert_array_equal(read_sequence(m.seq_file), seq0)
+
+
+def test_distext_rerun_is_noop_and_refusals(tmp_path, dist_env):
+    path, *_ = _graph_file(tmp_path)
+    base, m = _run(path, tmp_path / "st", legs=2)
+    again, m2 = _run(path, tmp_path / "st", legs=2)
+    assert again == base
+    assert sum(leg.dispatches for leg in m2.legs) == \
+        sum(leg.dispatches for leg in m.legs)  # nothing re-dispatched
+    with pytest.raises(SupervisionFailed, match="shard map"):
+        _run(path, tmp_path / "st", legs=3)
+    with pytest.raises(SupervisionFailed, match=r"\.dat"):
+        run_distext(str(tmp_path / "g.net"), str(tmp_path / "st2"),
+                    _config())
+
+
+def test_chaos_at_every_round_bit_identical(tmp_path, dist_env):
+    """kill/corrupt/hang at every (round, leg) of the distext bracket —
+    the hist legs, the histogram merge, the map legs, the merge round —
+    each yields the bit-identical tree re-dispatching ONLY the faulted
+    leg (exact dispatch counts)."""
+    path, *_ = _graph_file(tmp_path)
+    base, m0 = _run(path, tmp_path / "base", legs=2)
+    keys = {(-2, 0): "h.00", (-2, 1): "h.01", (-1, 0): "sort",
+            (0, 0): "r0.00", (0, 1): "r0.01", (1, 0): "r1.00"}
+    cases = [(k, rnd, leg) for (rnd, leg) in keys
+             for k in ("kill", "corrupt", "hang")]
+    for kind, rnd, leg in cases:
+        name = f"{kind}{rnd}x{leg}"
+        hurt, m = _run(path, tmp_path / name, legs=2,
+                       chaos=parse_fault_plan(f"{kind}@{rnd}:{leg}"),
+                       deadline_s=0.4 if kind == "hang" else 30.0)
+        assert hurt == base, (kind, rnd, leg)
+        counts = {l.key: l.dispatches for l in m.legs}
+        want_key = keys[(rnd, leg)]
+        assert counts[want_key] == 2, (kind, rnd, leg, counts)
+        assert all(n == 1 for k, n in counts.items() if k != want_key), \
+            (kind, rnd, leg, counts)
+
+
+def test_supervisor_death_resumes_only_dirty(tmp_path, dist_env):
+    """stop after a leg publishes: the replacement supervisor fscks the
+    survivors and re-dispatches only the legs the dead one left behind —
+    a clean .hist / partial tree is never rebuilt."""
+    path, *_ = _graph_file(tmp_path)
+    base, _ = _run(path, tmp_path / "base", legs=2)
+    for rnd, leg, done_keys in ((-2, 0, {"h.00"}),
+                                (0, 0, {"h.00", "h.01", "sort",
+                                        "r0.00"})):
+        sd = tmp_path / f"stop{rnd}x{leg}"
+        with pytest.raises(SupervisorKilled):
+            _run(path, sd, legs=2,
+                 chaos=parse_fault_plan(f"stop@{rnd}:{leg}"))
+        hurt, m = _run(path, sd, legs=2)
+        assert hurt == base
+        counts = {l.key: l.dispatches for l in m.legs}
+        for key in done_keys:  # published before the death: kept
+            assert counts[key] == 1, (rnd, leg, counts)
+
+
+def test_eio_and_enospc_at_leg_boundaries(tmp_path, dist_env):
+    """Typed I/O faults inside and around the legs: an EIO at a dat
+    block read retries IN the leg (no re-dispatch); an ENOSPC at the
+    histogram publish fails the attempt and the re-dispatch publishes
+    clean — bit-identical either way."""
+    path, *_ = _graph_file(tmp_path)
+    base, _ = _run(path, tmp_path / "base", legs=2)
+    dist_env.setenv("SHEEP_EXT_BLOCK", "300")
+    faultfs.install_plan(faultfs.parse_io_fault_plan("eio@dat:1"))
+    hurt, m = _run(path, tmp_path / "eio", legs=2, cores=1)
+    faultfs.clear_plan()
+    assert hurt == base
+    assert all(l.dispatches == 1 for l in m.legs)  # absorbed in-leg
+    faultfs.install_plan(faultfs.parse_io_fault_plan("enospc@hist:0"))
+    hurt, m = _run(path, tmp_path / "enospc", legs=2, cores=1)
+    faultfs.clear_plan()
+    assert hurt == base
+    counts = {l.key: l.dispatches for l in m.legs}
+    assert counts["h.00"] == 2, counts
+    assert all(n == 1 for k, n in counts.items() if k != "h.00"), counts
+
+
+def test_leg_kill_mid_range_resumes_from_checkpoint(tmp_path, dist_env):
+    """Kill a map leg at a block boundary mid-range: the supervisor
+    re-dispatches only that leg, whose --resume picks up the leg's own
+    block checkpoint — and the tree is bit-identical."""
+    from sheep_tpu.runtime import (FaultPlan, clear_plan, install_plan,
+                                   reset_counters)
+    path, *_ = _graph_file(tmp_path)
+    base, _ = _run(path, tmp_path / "base", legs=2)
+    dist_env.setenv("SHEEP_EXT_BLOCK", "200")
+    reset_counters()
+    install_plan(FaultPlan(site="ext-boundary", at=1, kind="kill"))
+    hurt, m = _run(path, tmp_path / "legkill", legs=2, cores=1)
+    clear_plan()
+    assert hurt == base
+    counts = {l.key: l.dispatches for l in m.legs}
+    assert counts["r0.00"] == 2, counts
+    assert all(n == 1 for k, n in counts.items() if k != "r0.00"), counts
+
+
+# ---------------------------------------------------------------------------
+# fsck: the state dir and the shard-map chain
+# ---------------------------------------------------------------------------
+
+
+def test_fsck_state_dir_and_shard_chain(tmp_path, dist_env):
+    from sheep_tpu.cli.fsck import main as fsck_main
+    path, *_ = _graph_file(tmp_path)
+    _, m = _run(path, tmp_path / "st", legs=2)
+    assert fsck_main(["-q", str(tmp_path / "st")]) == 0
+    # a histogram that disagrees with the manifest's shard map: rebuilt
+    # over the WRONG range (structurally valid, sidecar-sealed) — only
+    # the chain check can catch it
+    deg, mv, rec = range_degree_histogram(path, 500, start_edge=0,
+                                          end_edge=500)
+    hist_leg = next(l for l in m.legs if l.kind == "hist")
+    write_histogram(hist_leg.output, deg, rec, mv, 0, 500)
+    rc = fsck_main([str(tmp_path / "st")])
+    assert rc == 1
+
+
+def test_fsck_distext_manifest_validates_cover(tmp_path, dist_env):
+    from sheep_tpu.integrity.errors import MalformedArtifact
+    from sheep_tpu.integrity.fsck import fsck_distext_manifest
+    from sheep_tpu.supervisor.manifest import (load_manifest,
+                                               save_manifest)
+    path, *_ = _graph_file(tmp_path)
+    _, m = _run(path, tmp_path / "st", legs=2)
+    detail = fsck_distext_manifest(str(tmp_path / "st"))
+    assert "shard-map-ok" in detail
+    man = load_manifest(str(tmp_path / "st"))
+    man.shards[1][0] += 1  # a hole in the cover
+    save_manifest(man, str(tmp_path / "st"))
+    with pytest.raises(MalformedArtifact, match="contiguous"):
+        fsck_distext_manifest(str(tmp_path / "st"))
+
+
+def test_fsck_plain_tournament_dir_unchanged(tmp_path, dist_env):
+    """A plain (non-distext) supervised dir gets no chain line and still
+    fscks clean — the new walk hook is distext-only."""
+    from sheep_tpu.cli.fsck import main as fsck_main
+    from sheep_tpu.io.edges import write_net
+    from sheep_tpu.supervisor import run_supervised
+    from sheep_tpu.utils.synth import rmat_edges
+    tail, head = rmat_edges(6, 4 << 6, seed=5)
+    graph = str(tmp_path / "g.net")
+    write_net(graph, tail, head)
+    run_supervised(graph, str(tmp_path / "st"), _config(),
+                   runner=InlineRunner(0.05))
+    assert fsck_main(["-q", str(tmp_path / "st")]) == 0
+
+
+# ---------------------------------------------------------------------------
+# governor planning + CLI routing + status
+# ---------------------------------------------------------------------------
+
+
+def test_governor_distext_leg_plan(dist_env, monkeypatch):
+    import sheep_tpu.resources.governor as G
+    monkeypatch.setattr(G, "rss_bytes", lambda: 0)
+    dist_env.setenv("SHEEP_DISTEXT_LEGS", "5")
+    plan = G.distext_leg_plan()
+    assert plan["legs"] == 5 and plan["forced"]
+    dist_env.delenv("SHEEP_DISTEXT_LEGS")
+    plan = G.distext_leg_plan()
+    assert plan["legs"] >= 2 and not plan["forced"]
+    # the aggregate budget cuts N toward (but never below) 2
+    gov = G.ResourceGovernor(mem_budget=plan["per_leg_peak_bytes"])
+    assert G.distext_leg_plan(governor=gov)["legs"] == 2
+
+
+def test_should_use_distext_routing(tmp_path, dist_env, monkeypatch):
+    import sheep_tpu.resources.governor as G
+    from sheep_tpu.resources.governor import ResourceGovernor
+    path, *_ = _graph_file(tmp_path)
+    assert not should_use_distext(path)  # no budget, no opt-in
+    dist_env.setenv("SHEEP_DISTEXT_LEGS", "2")
+    assert should_use_distext(path)
+    assert not should_use_distext(str(tmp_path / "g.net"))
+    dist_env.delenv("SHEEP_DISTEXT_LEGS")
+    monkeypatch.setattr(G, "rss_bytes", lambda: 0)
+    # a budget the ext FLOOR block still cannot stream under: the build
+    # must leave this process
+    assert should_use_distext(path, ResourceGovernor(mem_budget=1 << 18))
+    assert not should_use_distext(path,
+                                  ResourceGovernor(mem_budget=1 << 24))
+
+
+def test_graph2tree_distext_cli(tmp_path, dist_env):
+    from sheep_tpu.cli.graph2tree import main
+    from sheep_tpu.io.trefile import read_tree
+    path, tail, head, _, want = _graph_file(tmp_path, seed=53)
+    out = str(tmp_path / "out.tre")
+    dist_env.setenv("SHEEP_DISTEXT_LEGS", "2")
+    assert main([path, "-o", out, "--distext"]) == 0
+    parent, pst = read_tree(out)
+    np.testing.assert_array_equal(parent, want.parent)
+    np.testing.assert_array_equal(pst, want.pst_weight)
+    assert os.path.isdir(out + ".distext")
+    # a partition request cannot ride the distributed job: warned + falls
+    # back to a single-process path, still exits 0
+    assert main([path, "-o", str(tmp_path / "p"), "-p", "4",
+                 "--distext"]) == 0
+
+
+def test_status_reports_leg_ext_progress(tmp_path, dist_env):
+    from sheep_tpu.runtime import (FaultPlan, clear_plan, install_plan,
+                                   reset_counters)
+    from sheep_tpu.supervisor.status import render_status, status_json
+    path, *_ = _graph_file(tmp_path)
+    dist_env.setenv("SHEEP_EXT_BLOCK", "200")
+    reset_counters()
+    install_plan(FaultPlan(site="ext-boundary", at=1, kind="kill"))
+    with pytest.raises(SupervisionFailed):
+        _run(path, tmp_path / "st", legs=2, cores=1, max_retries=0)
+    clear_plan()
+    sj = status_json(str(tmp_path / "st"))
+    row = next(r for r in sj["legs"] if r["key"] == "r0.00")
+    assert row["ext_blocks_done"] == 2
+    assert row["ext_blocks_total"] == -(-1024 // 200)
+    text = render_status(str(tmp_path / "st"))
+    assert "2/6blk" in text
+    # the supervise CLI face renders it too
+    from sheep_tpu.cli.supervise import main as sup_main
+    assert sup_main(["--status", "-d", str(tmp_path / "st")]) == 0
+
+
+def test_leg_perf_reports_land(tmp_path, dist_env):
+    """Every map leg self-reports perf + proc_status (the DISTEXTBENCH
+    honesty surface): overlap_frac and VmHWM are in the file."""
+    from sheep_tpu.ops.distext import leg_perf_path
+    path, *_ = _graph_file(tmp_path)
+    _, m = _run(path, tmp_path / "st", legs=2)
+    for key in ("r0.00", "r0.01"):
+        with open(leg_perf_path(str(tmp_path / "st"), key)) as f:
+            rep = json.load(f)
+        assert 0.0 <= rep["perf"]["overlap_frac"] <= 1.0
+        assert "vmhwm" in rep["proc_status"]
+        assert rep["range"][1] > rep["range"][0]

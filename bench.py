@@ -386,6 +386,38 @@ def _run_one(log_n: int) -> dict:
         host_s = min(host_times)
         rec["host_native"] = {"best_s": round(host_s, 4),
                               "edges_per_sec": round(e / host_s, 1)}
+        # threads_ab (round 14, SHEEP_BENCH_THREADS_AB=1): the same host
+        # build under forced SHEEP_NATIVE_THREADS ∈ {1,2,4}, best-of-reps,
+        # CRC-asserted bit-identical across T.  The dedicated acceptance
+        # record is scripts/threadbench.py (own subprocess per arm); this
+        # in-sweep arm rides the existing sizes so a committed BENCH
+        # record carries the per-size thread scaling too.
+        if os.environ.get("SHEEP_BENCH_THREADS_AB", "") == "1":
+            import zlib
+            prev_t = os.environ.get("SHEEP_NATIVE_THREADS")
+            ab: dict = {}
+            crcs = set()
+            try:
+                for t in (1, 2, 4):
+                    os.environ["SHEEP_NATIVE_THREADS"] = str(t)
+                    seq_host = degree_sequence(tail, head)
+                    f = build_forest(tail, head, seq_host, max_vid=n - 1)
+                    crcs.add((zlib.crc32(f.parent.tobytes()),
+                              zlib.crc32(f.pst_weight.tobytes())))
+                    times = []
+                    for _ in range(reps):
+                        t0 = time.perf_counter()
+                        host_build()
+                        times.append(time.perf_counter() - t0)
+                    ab[f"t{t}_best_s"] = round(min(times), 4)
+            finally:
+                if prev_t is None:
+                    os.environ.pop("SHEEP_NATIVE_THREADS", None)
+                else:
+                    os.environ["SHEEP_NATIVE_THREADS"] = prev_t
+            ab["crc_identical"] = len(crcs) == 1
+            assert ab["crc_identical"], "threads_ab arms diverged"
+            rec["host_native"]["threads_ab"] = ab
 
     _headline(rec)
     # final stream line: the record including host_native (the parent and

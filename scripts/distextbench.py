@@ -108,6 +108,7 @@ def run_distext_arm(path: str, state_dir: str, budget: str,
     env = dict(os.environ)
     env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
     env["SHEEP_MEM_BUDGET"] = budget
+    from sheep_tpu.ops.distext import apply_overlap_honesty
     cfg = SupervisorConfig.from_env(grammar=False)
     t0 = time.perf_counter()
     manifest = run_distext(path, state_dir, cfg,
@@ -140,8 +141,14 @@ def run_distext_arm(path: str, state_dir: str, budget: str,
             "ext_blocks": rep.get("perf", {}).get("ext_blocks"),
             "block_edges": rep.get("perf", {}).get("block_edges"),
             "strategies": rep.get("perf", {}).get("strategies"),
+            "threads": rep.get("perf", {}).get("threads"),
             "proc_status": rep.get("proc_status"),
         }
+    # overlap honesty (round 14): legs time-sharing one core report
+    # overlap_frac null + affinity_limited instead of a misleading 0.0
+    out["affinity_limited"] = apply_overlap_honesty(
+        out["per_leg"], len([leg for leg in manifest.legs
+                             if leg.kind == "distmap"]))
     parent, pst = read_tree(manifest.final_tree)
 
     class _F:  # the shape _crcs expects
@@ -215,10 +222,12 @@ def main() -> int:
         "_note": ("serialized runs; the distext arm's legs are real CLI "
                   "subprocesses each under its own SHEEP_MEM_BUDGET, "
                   "self-reporting VmHWM/affinity/overlap via "
-                  "obs.metrics.proc_status — on this 1-core host the "
-                  "legs time-share, so per-leg overlap_frac and any "
-                  "read scale-out must be re-judged on real cores from "
-                  "the per_leg affinity data in this record"),
+                  "obs.metrics.proc_status — when the legs time-share "
+                  "cores (per_leg affinity union < leg count) each "
+                  "leg's overlap_frac is published as null with "
+                  "affinity_limited: true (the raw clock reading stays "
+                  "in overlap_frac_raw): a 0.0 there measures the "
+                  "host, not the prefetcher; re-judge on real cores"),
     }
     state_dir = tempfile.mkdtemp(prefix="distextbench-state.")
     try:

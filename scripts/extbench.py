@@ -180,6 +180,12 @@ def main() -> int:
                     help="also run an UNBUDGETED ext arm at this "
                          "SHEEP_EXT_BLOCK (the block/throughput trade, "
                          "informational — not part of the acceptance)")
+    ap.add_argument("--threads-ab", action="store_true",
+                    help="add forced SHEEP_NATIVE_THREADS in {1,2,4} "
+                         "unbudgeted ext arms (ISSUE 14), CRC-asserted "
+                         "identical across T; on an affinity-limited "
+                         "host the forced counts clamp to the granted "
+                         "cores and the arms say so")
     ap.add_argument("--keep-file", action="store_true")
     ap.add_argument("--out", default="EXTBENCH_r01.json")
     ap.add_argument("--child", choices=("ext", "spill", "oracle"),
@@ -239,6 +245,26 @@ def main() -> int:
             record["arms"][name]["_note"] = \
                 "informational: unbudgeted, operator-pinned block"
             print(json.dumps(record["arms"][name]), file=sys.stderr)
+        if args.threads_ab:
+            # threaded-fold A/B (ISSUE 14): the ext stream under forced
+            # worker-thread counts — bit-identical by the deterministic
+            # partial merge, asserted here, with each arm's resolved
+            # count (the library clamps to granted cores) in its perf
+            crcs = set()
+            for t in (1, 2, 4):
+                name = f"ext_t{t}"
+                print(f"running {name} arm (unbudgeted)...",
+                      file=sys.stderr)
+                record["arms"][name] = run_child(
+                    "ext", path, None,
+                    extra_env={"SHEEP_NATIVE_THREADS": str(t)})
+                rec_t = record["arms"][name]
+                if "error" not in rec_t:
+                    crcs.add((rec_t["parent_crc32"], rec_t["pst_crc32"]))
+                print(json.dumps(rec_t), file=sys.stderr)
+            record["threads_ab_crc_identical"] = len(crcs) == 1
+            assert record["threads_ab_crc_identical"], \
+                "threads_ab ext arms diverged"
         ext = record["arms"]["ext"]
         spill = record["arms"]["spill"]
         oracle = record["arms"]["oracle"]

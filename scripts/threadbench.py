@@ -1,0 +1,262 @@
+#!/usr/bin/env python3
+"""THREADBENCH: the threaded-native-kernel A/B record (ISSUE 14).
+
+Runs the SAME build under forced ``SHEEP_NATIVE_THREADS`` ∈ {1, 2, 4}
+(one arm per value), each arm in its OWN subprocess per the bench-
+honesty rules (the arm's ``_proc_capture`` — pid/affinity/VmHWM through
+``obs.metrics.proc_status`` — is that process's true lifetime story, and
+a forced thread count can never leak into a sibling arm).  Per arm,
+best-of-reps:
+
+  build   the in-RAM fused native build (records -> forest) — the
+          kernel the threaded fold decomposes.
+  ext     the out-of-core stream over the same graph's ``.dat`` (ext
+          rung, own prefetcher): its ``overlap_frac`` under worker
+          threads is the number that retires the "prefetch overlap is
+          structurally zero on 1 core" caveat on a real host.
+
+CRCs (parent + pst) are asserted IDENTICAL across every T — the
+deterministic-merge contract, enforced in the record, not just claimed.
+
+The acceptance gate is host-aware, by design:
+
+  >= 4 effective cores   t4 build throughput must be >= 3x t1
+                         (``threaded_speedup_ge_3x``).
+  fewer (this container) forced threads must cost <= 10% vs t1
+                         (``forced_overhead_le_10pct``) and the record
+                         carries ``affinity_limited: true`` with the 3x
+                         gate ARMED (``multicore_gate_armed``) — the
+                         next multi-core run judges it from this same
+                         script with no edits.
+
+On an affinity-limited host the forced arms resolve to 1 thread (the
+library clamps SHEEP_NATIVE_THREADS to the granted cores — spinning T
+compute threads on one core is never what an operator wants), and each
+arm's ``threads_resolved`` says so in the record.  A separate
+``t4_oversub`` arm (SHEEP_NATIVE_OVERSUB=1) runs the REAL parallel code
+path anyway and records its honest time-shared price — informational,
+never gated: it measures the decomposition's work overhead, not
+anything a sane deployment pays.
+
+Usage:
+  python scripts/threadbench.py --out THREADBENCH_r01.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import time
+import zlib
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+THREAD_ARMS = (1, 2, 4)
+
+
+def child_arm(path: str, threads: int, log_n: int, reps: int) -> dict:
+    """One forced-T arm: fused in-RAM build + ext stream, best-of-reps,
+    CRCs and this subprocess's proc capture embedded."""
+    os.environ["SHEEP_NATIVE_THREADS"] = str(threads)
+    from sheep_tpu import native
+    from sheep_tpu.core.forest import build_forest
+    from sheep_tpu.core.sequence import degree_sequence
+    from sheep_tpu.io.edges import read_dat
+    from sheep_tpu.obs.metrics import proc_status
+    from sheep_tpu.ops.extmem import build_forest_extmem
+
+    edges = read_dat(path)
+    tail, head = edges.tail, edges.head
+    m = len(tail)
+
+    seq = degree_sequence(tail, head)
+    f = build_forest(tail, head, seq)
+    crcs = {"parent_crc32": zlib.crc32(f.parent.tobytes()) & 0xFFFFFFFF,
+            "pst_crc32": zlib.crc32(f.pst_weight.tobytes()) & 0xFFFFFFFF}
+    build_times = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        seq_r = degree_sequence(tail, head)
+        build_forest(tail, head, seq_r)
+        build_times.append(time.perf_counter() - t0)
+    build_s = min(build_times)
+
+    ext_perf: dict = {}
+    t0 = time.perf_counter()
+    seq_e, f_e = build_forest_extmem(path, perf=ext_perf)
+    ext_wall = time.perf_counter() - t0
+    ext_crcs = {
+        "parent_crc32": zlib.crc32(f_e.parent.tobytes()) & 0xFFFFFFFF,
+        "pst_crc32": zlib.crc32(f_e.pst_weight.tobytes()) & 0xFFFFFFFF}
+
+    return {
+        "threads_forced": threads,
+        "threads_resolved": native.resolve_threads(),
+        "threads_for_m": native.threads_for(m),
+        "omp_compiled": native.omp_compiled(),
+        "records": m,
+        "build": {"best_s": round(build_s, 4),
+                  "times": [round(x, 4) for x in build_times],
+                  "edges_per_s": round(m / build_s, 1), **crcs},
+        "ext": {"wall_s": round(ext_wall, 4),
+                "edges_per_s": round(m / ext_wall, 1),
+                "overlap_frac": ext_perf.get("overlap_frac"),
+                "overlap_s": ext_perf.get("overlap_s"),
+                "read_s": ext_perf.get("read_s"),
+                "fold_s": ext_perf.get("fold_s"),
+                "threads": ext_perf.get("threads"), **ext_crcs},
+        "_proc_capture": proc_status(),
+    }
+
+
+def run_child(path: str, threads: int, log_n: int, reps: int,
+              oversub: bool = False, timeout: int = 1800) -> dict:
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["SHEEP_NATIVE_THREADS"] = str(threads)
+    if oversub:
+        env["SHEEP_NATIVE_OVERSUB"] = "1"
+    else:
+        env.pop("SHEEP_NATIVE_OVERSUB", None)
+    proc = subprocess.run(
+        [sys.executable, os.path.abspath(__file__), "--child",
+         str(threads), "--dat", path, "--log-n", str(log_n),
+         "--reps", str(reps)],
+        capture_output=True, text=True, timeout=timeout, env=env)
+    if proc.returncode != 0:
+        return {"threads_forced": threads, "error": proc.stderr[-2000:]}
+    return json.loads(proc.stdout.strip().splitlines()[-1])
+
+
+def generate(path: str, log_n: int, edge_factor: int, seed: int = 23
+             ) -> None:
+    from sheep_tpu.io.edges import write_dat
+    from sheep_tpu.utils.synth import rmat_edges
+    n = 1 << log_n
+    tail, head = rmat_edges(log_n, edge_factor * n, seed=seed)
+    write_dat(path, tail, head)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default="THREADBENCH_r01.json")
+    ap.add_argument("--log-n", type=int, default=20)
+    ap.add_argument("--edge-factor", type=int, default=8)
+    ap.add_argument("--reps", type=int, default=3)
+    ap.add_argument("--dat", help="existing .dat (default: generate)")
+    ap.add_argument("--child", type=int, help=argparse.SUPPRESS)
+    args = ap.parse_args()
+
+    if args.child is not None:
+        rec = child_arm(args.dat, args.child, args.log_n, args.reps)
+        print(json.dumps(rec), flush=True)
+        return 0
+
+    # load the native lib in the parent so env_capture reports the
+    # OpenMP fields, and the .so is warm before any timed child runs
+    from sheep_tpu import native
+    from sheep_tpu.utils.envinfo import effective_cores, env_capture
+    native.available()
+
+    tmp = None
+    path = args.dat
+    if not path:
+        tmp = tempfile.mkdtemp(prefix="threadbench.")
+        path = os.path.join(tmp, f"rmat{args.log_n}.dat")
+        print(f"generating 2^{args.log_n} x{args.edge_factor} .dat ...",
+              file=sys.stderr)
+        generate(path, args.log_n, args.edge_factor)
+
+    cores = effective_cores()
+    record: dict = {
+        "bench": "THREADBENCH",
+        "round": "r01",
+        "log_n": args.log_n,
+        "edge_factor": args.edge_factor,
+        "reps": args.reps,
+        "effective_cores": cores,
+        "env_capture": env_capture(),
+        "arms": {},
+        "_note": ("one subprocess per forced-T arm (its _proc_capture "
+                  "is that arm's true affinity/VmHWM story); CRCs "
+                  "asserted identical across T — the deterministic "
+                  "per-thread partial merge, enforced in the record"),
+    }
+    try:
+        for t in THREAD_ARMS:
+            print(f"running t{t} arm...", file=sys.stderr)
+            record["arms"][f"t{t}"] = run_child(path, t, args.log_n,
+                                                args.reps)
+            print(json.dumps(record["arms"][f"t{t}"]), file=sys.stderr)
+        if cores < 4:
+            # informational: the REAL parallel code path time-sharing
+            # this host's core — the decomposition's honest work price,
+            # CRC-checked with the rest, never part of the gate
+            print("running t4_oversub arm...", file=sys.stderr)
+            record["arms"]["t4_oversub"] = run_child(
+                path, 4, args.log_n, args.reps, oversub=True)
+            record["arms"]["t4_oversub"]["_informational"] = True
+            print(json.dumps(record["arms"]["t4_oversub"]),
+                  file=sys.stderr)
+
+        ok_arms = [a for a in record["arms"].values() if "error" not in a]
+        gated_ok = [record["arms"].get(f"t{t}") for t in THREAD_ARMS]
+        gated_ok = [a for a in gated_ok if a and "error" not in a]
+        build_crcs = {(a["build"]["parent_crc32"],
+                       a["build"]["pst_crc32"]) for a in ok_arms}
+        ext_crcs = {(a["ext"]["parent_crc32"],
+                     a["ext"]["pst_crc32"]) for a in ok_arms}
+        t1 = record["arms"].get("t1", {})
+        t4 = record["arms"].get("t4", {})
+        speedup = None
+        if "build" in t1 and "build" in t4 and t4["build"]["best_s"] > 0:
+            speedup = round(t1["build"]["best_s"] / t4["build"]["best_s"],
+                            3)
+        record["build_speedup_t4_vs_t1"] = speedup
+        acceptance: dict = {
+            "all_arms_ran": len(gated_ok) == len(THREAD_ARMS),
+            "build_crc_identical_across_t": len(build_crcs) == 1,
+            "ext_crc_identical_across_t": len(ext_crcs) == 1,
+            "build_ext_crc_agree":
+                build_crcs == ext_crcs and len(build_crcs) == 1,
+        }
+        if cores >= 4:
+            # the real gate: threaded throughput on real cores
+            acceptance["threaded_speedup_ge_3x"] = (speedup is not None
+                                                    and speedup >= 3.0)
+            record["affinity_limited"] = False
+        else:
+            # this host cannot scale anything: forced threads must at
+            # least be nearly free, and the 3x gate stays ARMED for the
+            # next multi-core run of this same script
+            acceptance["forced_overhead_le_10pct"] = (
+                speedup is not None and speedup >= 1.0 / 1.10)
+            record["affinity_limited"] = True
+            record["multicore_gate_armed"] = (
+                "rerun scripts/threadbench.py on a >=4-core host; "
+                "acceptance flips to threaded_speedup_ge_3x >= 3.0")
+        record["acceptance"] = acceptance
+        record["passed"] = all(acceptance.values())
+    finally:
+        if tmp:
+            import shutil
+            shutil.rmtree(tmp, ignore_errors=True)
+
+    record["_utc"] = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+    with open(args.out, "w") as fobj:
+        json.dump(record, fobj, indent=1, sort_keys=True)
+        fobj.write("\n")
+    print(json.dumps({"passed": record["passed"],
+                      "speedup_t4": record["build_speedup_t4_vs_t1"],
+                      "affinity_limited": record["affinity_limited"]},
+                     indent=2))
+    return 0 if record["passed"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -202,6 +202,61 @@ then
 fi
 # -------------------------------------------------------------------------
 
+# --- threaded-native smoke (OpenMP kernels, ISSUE 14) --------------------
+# Forced SHEEP_NATIVE_THREADS=4 (with the explicit oversubscription
+# opt-in so the parallel code path runs even on a 1-core host, and the
+# test floor so it engages at smoke size): the fused build, the
+# resumable fold, and the histogram+counting-sort must be CRC-identical
+# to the serial build — the deterministic per-thread partial merge.  On
+# a library compiled without OpenMP the forced count resolves to 1 and
+# the same assertions hold trivially (the Makefile fallback contract).
+if ! env JAX_PLATFORMS=cpu SHEEP_NATIVE_THREADS=4 SHEEP_NATIVE_OVERSUB=1 \
+     SHEEP_NATIVE_THREAD_FLOOR=0 python - <<'EOF'
+import os
+import numpy as np
+from sheep_tpu import native
+from sheep_tpu.core import build_forest, degree_sequence
+from sheep_tpu.core.forest import PyLinksFold, edges_to_positions
+from sheep_tpu.utils.synth import rmat_edges
+
+n = 1 << 12
+tail, head = rmat_edges(12, 6 * n, seed=41)
+seq = degree_sequence(tail, head)
+got = build_forest(tail, head, seq)          # forced threads (or serial)
+os.environ["SHEEP_NATIVE_THREADS"] = "1"
+want = build_forest(tail, head, seq)         # serial oracle arm
+np.testing.assert_array_equal(got.parent, want.parent)
+np.testing.assert_array_equal(got.pst_weight, want.pst_weight)
+
+os.environ["SHEEP_NATIVE_THREADS"] = "4"
+if native.available():
+    m = len(seq)
+    lo, hi = edges_to_positions(tail, head, seq)
+    oracle = PyLinksFold(m)
+    oracle.block(lo, hi)
+    want_p, want_w = oracle.finish()
+    linked = hi < m
+    order = np.argsort(hi[linked], kind="stable")
+    lo_s, hi_s = lo[linked][order], hi[linked][order]
+    fold = native.LinksFold(m)
+    cut = len(lo_s) // 2
+    fold.block(np.concatenate([lo[~linked], lo_s[:cut]]),
+               np.concatenate([hi[~linked], hi_s[:cut]]))
+    fold.block(lo_s[cut:], hi_s[cut:])
+    p, w = fold.finish()
+    np.testing.assert_array_equal(p, want_p)
+    np.testing.assert_array_equal(w, want_w)
+    if native.omp_compiled():
+        assert native.resolve_threads() == 4, native.resolve_threads()
+print("threaded-native smoke ok (omp=%s)" % native.omp_compiled())
+EOF
+then
+  echo "THREADED-NATIVE SMOKE FAILED: forced-thread build diverged from" \
+       "the serial oracle" >&2
+  exit 1
+fi
+# -------------------------------------------------------------------------
+
 # --- streaming-handoff smoke (hybrid tail, ISSUE 8) ----------------------
 # Forced-on windowed handoff at a small n — the host-side window split at
 # W=4, the accelerator window queue (device hi-sort + slice stream)

@@ -123,10 +123,82 @@ def _bind(lib: ctypes.CDLL) -> None:
         ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64, ctypes.c_int32,
         _u64p, _u64p, ctypes.c_void_p, ctypes.c_void_p,
         _u8p, _i64p, _i64p, _i64p, ctypes.c_int64]
+    lib.sheep_native_omp.restype = ctypes.c_int
+    lib.sheep_native_omp.argtypes = []
+    lib.sheep_native_threads.restype = ctypes.c_int
+    lib.sheep_native_threads.argtypes = []
+    lib.sheep_threads_for.restype = ctypes.c_int
+    lib.sheep_threads_for.argtypes = [ctypes.c_int64]
+    lib.sheep_omp_max_threads.restype = ctypes.c_int
+    lib.sheep_omp_max_threads.argtypes = []
+    lib.sheep_last_thread_stats.restype = ctypes.c_int
+    lib.sheep_last_thread_stats.argtypes = [
+        ctypes.POINTER(ctypes.c_double), ctypes.c_int]
 
 
 def available() -> bool:
     return _load() is not None
+
+
+# -- threading (round-14) ---------------------------------------------------
+# SHEEP_NATIVE_THREADS (resolved by the governor from SHEEP_LEG_CORES /
+# affinity / cgroup quota, resources/governor.py) arms the kernels'
+# OpenMP decomposition: per-thread partial forests/histograms over
+# contiguous slices, merged deterministically (bit-identical to T=1 for
+# every thread count).  The LIBRARY is the authority on what actually
+# runs — a build compiled without OpenMP reports threads=1 no matter
+# what the environment says.
+
+
+def omp_compiled() -> bool:
+    """True when the loaded library was compiled with OpenMP (the
+    Makefile probes the toolchain and degrades to a serial build)."""
+    lib = _load()
+    return bool(lib is not None and lib.sheep_native_omp())
+
+
+def resolve_threads() -> int:
+    """The resolved ``SHEEP_NATIVE_THREADS`` of the loaded library —
+    what an ungated kernel call would use (1 without OpenMP)."""
+    lib = _load()
+    return int(lib.sheep_native_threads()) if lib is not None else 1
+
+
+def threads_for(m: int) -> int:
+    """Threads a kernel call over ``m`` records/links will ACTUALLY use
+    (after the engagement floor and per-slice-work gates)."""
+    lib = _load()
+    return int(lib.sheep_threads_for(m)) if lib is not None else 1
+
+
+def omp_max_threads() -> int:
+    """``omp_get_max_threads()`` of the loaded runtime (1 without
+    OpenMP) — the env_capture field bench records embed."""
+    lib = _load()
+    return int(lib.sheep_omp_max_threads()) if lib is not None else 1
+
+
+def _threads_live() -> bool:
+    """Cheap pre-gate: skip the per-call stats read entirely when no
+    thread count is configured (the overwhelming default path)."""
+    return os.environ.get("SHEEP_NATIVE_THREADS", "") not in ("", "0", "1")
+
+
+def _annotate_threads(sp) -> None:
+    """Merge the last kernel call's thread telemetry into its span:
+    ``threads`` (what the kernel really used — the gates may have picked
+    1) and per-thread busy seconds, the flight recorder's arbiter for
+    whether a forced T did parallel work or just time-shared a core."""
+    lib = _lib
+    if lib is None:
+        return
+    buf = (ctypes.c_double * 64)()
+    used = int(lib.sheep_last_thread_stats(buf, 64))
+    if used > 1:
+        sp.annotate(threads=used,
+                    thread_busy_s=[round(buf[i], 6) for i in range(used)])
+    else:
+        sp.annotate(threads=1)
 
 
 def build_forest_links(lo: np.ndarray, hi: np.ndarray, n: int,
@@ -146,9 +218,11 @@ def build_forest_links(lo: np.ndarray, hi: np.ndarray, n: int,
         pst_ptr = pst.ctypes.data_as(ctypes.c_void_p)
     pre_out = np.empty(n, dtype=np.uint32) if compute_pre else None
     pre_ptr = pre_out.ctypes.data_as(ctypes.c_void_p) if compute_pre else None
-    with _obs.span("native.build_forest", links=len(lo), n=n):
+    with _obs.span("native.build_forest", links=len(lo), n=n) as sp:
         rc = lib.sheep_build_forest(lo, hi, len(lo), n, pst_ptr, parent,
                                     pst_out, pre_ptr)
+        if _threads_live():
+            _annotate_threads(sp)
     if rc != 0:
         raise RuntimeError(f"sheep_build_forest failed rc={rc}")
     if compute_pre:
@@ -198,11 +272,13 @@ class LinksFold:
         assert not self._done, "fold already finished"
         lo = np.ascontiguousarray(lo, dtype=np.uint32)
         hi = np.ascontiguousarray(hi, dtype=np.uint32)
-        with _obs.span("native.links_fold.block", links=len(lo)):
+        with _obs.span("native.links_fold.block", links=len(lo)) as sp:
             r = self._lib.sheep_build_forest_links_block(
                 lo, hi, len(lo), self.n, self._bound,
                 1 if self.accumulate_pst else 0, self.parent, self.pst,
                 self._uf)
+            if _threads_live():
+                _annotate_threads(sp)
         if r == -7:
             raise ValueError(
                 "out-of-order fold window: a linked hi precedes the "
@@ -247,10 +323,13 @@ def build_forest_edges(tail: np.ndarray, head: np.ndarray, pos: np.ndarray,
     pst_out = np.empty(n, dtype=np.uint32)
     pre_out = np.empty(n, dtype=np.uint32) if compute_pre else None
     pre_ptr = pre_out.ctypes.data_as(ctypes.c_void_p) if compute_pre else None
-    with _obs.span("native.build_forest_edges", records=len(tail), n=n):
+    with _obs.span("native.build_forest_edges", records=len(tail),
+                   n=n) as sp:
         rc = lib.sheep_build_forest_edges(tail, head, len(tail), pos,
                                           len(pos), n, parent, pst_out,
                                           pre_ptr)
+        if _threads_live():
+            _annotate_threads(sp)
     if rc != 0:
         raise RuntimeError(f"sheep_build_forest_edges failed rc={rc}")
     if compute_pre:
@@ -323,9 +402,11 @@ def degree_histogram_acc(tail: np.ndarray, head: np.ndarray,
     tail = np.ascontiguousarray(tail, dtype=np.uint32)
     head = np.ascontiguousarray(head, dtype=np.uint32)
     assert deg.dtype == np.int64 and deg.flags["C_CONTIGUOUS"]
-    with _obs.span("native.degree_histogram_acc", records=len(tail)):
+    with _obs.span("native.degree_histogram_acc", records=len(tail)) as sp:
         rc = lib.sheep_degree_histogram_acc(tail, head, len(tail),
                                             len(deg), deg)
+        if _threads_live():
+            _annotate_threads(sp)
     if rc == -3:
         raise ValueError(
             f"corrupt edge records: a vid is out of range for n={len(deg)}")
@@ -409,8 +490,10 @@ def degree_sequence_from_edges(tail: np.ndarray, head: np.ndarray,
     tail = np.ascontiguousarray(tail, dtype=np.uint32)
     head = np.ascontiguousarray(head, dtype=np.uint32)
     seq = np.empty(n, dtype=np.uint32)
-    with _obs.span("native.degree_sequence_edges", records=len(tail)):
+    with _obs.span("native.degree_sequence_edges", records=len(tail)) as sp:
         k = lib.sheep_degree_sequence_edges(tail, head, len(tail), n, seq)
+        if _threads_live():
+            _annotate_threads(sp)
     if k == -3:
         raise ValueError(
             f"corrupt edge records: a vid is out of range for n={n}")
@@ -432,8 +515,10 @@ def degree_sequence_from_degrees(deg: np.ndarray) -> np.ndarray | None:
     lib = _load()
     assert lib is not None
     seq = np.empty(len(deg), dtype=np.uint32)
-    with _obs.span("native.degree_sequence", n=len(deg)):
+    with _obs.span("native.degree_sequence", n=len(deg)) as sp:
         k = lib.sheep_degree_sequence(deg, len(deg), seq)
+        if _threads_live():
+            _annotate_threads(sp)
     return seq[:k].copy()
 
 

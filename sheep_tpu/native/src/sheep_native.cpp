@@ -21,8 +21,136 @@
 #include <memory>
 #include <vector>
 
+#ifdef _OPENMP
+#include <omp.h>
+#endif
+
 namespace {
 constexpr uint32_t kInvalid = 0xFFFFFFFFu;
+
+// ---------------------------------------------------------------------------
+// Threading (round-14).  SHEEP_NATIVE_THREADS (resolved by the governor
+// from SHEEP_LEG_CORES/affinity, resources/governor.py) arms an OpenMP
+// path in the hot kernels; unset/1, a build without OpenMP, or an input
+// below the engagement floor all take the unchanged serial code.  The
+// parallel decomposition is the SAME associative primitive the whole
+// repo leans on: every thread folds a contiguous slice of the input
+// into a PRIVATE partial forest / histogram, and the partials merge
+// deterministically — histogram adds commute, and partial forests over
+// one sequence merge through the existing grouping+adoption to the
+// unique forest of the union (lib/jnode.cpp:174-201; the tournament
+// bracket's exactness argument).  Outputs are therefore BIT-IDENTICAL
+// to the single-thread build for every thread count — the merge is not
+// a heuristic, it is the same fold.
+// ---------------------------------------------------------------------------
+
+constexpr int kMaxThreads = 64;
+//: links/records below which the per-thread O(n) partial tables cost
+//: more than the slice saves (SHEEP_NATIVE_THREAD_FLOOR overrides —
+//: tests force 0 to engage the threaded path on small inputs)
+constexpr int64_t kThreadFloor = int64_t{1} << 15;
+
+static inline int affinity_cores() {
+#ifdef _OPENMP
+  // affinity-aware available-processor count: forcing more compute
+  // threads than granted cores buys nothing and costs real work (the
+  // partial merges are not free), so the resolver clamps to it unless
+  // SHEEP_NATIVE_OVERSUB=1 explicitly opts into oversubscription (the
+  // determinism tests and the informational bench arm use that to
+  // exercise the parallel code path on a 1-core host)
+  return omp_get_num_procs();
+#else
+  return 1;
+#endif
+}
+
+static inline int resolve_threads() {
+#ifdef _OPENMP
+  const char* v = std::getenv("SHEEP_NATIVE_THREADS");
+  if (!v || !v[0]) return 1;
+  int t = std::atoi(v);
+  if (t <= 1) return 1;
+  const char* over = std::getenv("SHEEP_NATIVE_OVERSUB");
+  if (!(over && over[0] == '1')) {
+    const int cores = affinity_cores();
+    if (t > cores) t = cores;
+  }
+  return t > kMaxThreads ? kMaxThreads : (t < 1 ? 1 : t);
+#else
+  return 1;  // compiled without OpenMP: always serial, report so
+#endif
+}
+
+static inline int64_t thread_floor() {
+  const char* v = std::getenv("SHEEP_NATIVE_THREAD_FLOOR");
+  if (v && v[0]) {
+    long long f = std::atoll(v);
+    if (f >= 0) return (int64_t)f;
+  }
+  return kThreadFloor;
+}
+
+// Threads the NEXT kernel call over m records will actually use: the
+// resolved count, gated by the engagement floor and capped so every
+// slice still carries real work.
+static inline int threads_for_work(int64_t m) {
+  int t = resolve_threads();
+  if (t <= 1 || m < thread_floor()) return 1;
+  while (t > 1 && m / t < 256) --t;
+  return t;
+}
+
+// Per-call thread telemetry, read by the Python bindings right after a
+// kernel returns (sheep_last_thread_stats) and annotated onto the
+// native.* flight-recorder spans.  thread_local on the CALLING thread,
+// so concurrent Python-level callers never smear each other; OpenMP
+// workers write distinct slots through the captured pointer.
+struct ThreadStats {
+  int used = 1;
+  double busy[kMaxThreads] = {};
+};
+static thread_local ThreadStats g_tstats;
+
+// Per-caller-thread slab arena for the per-thread partial tables (8n+8
+// bytes per OpenMP thread: a union-find + parent pair, or an int64
+// histogram partial — exactly the 8n-per-extra-thread the governor
+// prices as RESIDENT).  Persistent across kernel calls on purpose: the
+// streaming folds call the kernel once per block, and re-faulting ~8n
+// of freshly mmap'd pages per thread per block was measured to be most
+// of the forced-thread overhead on the 1-core host.  Grows, never
+// shrinks; freed when the calling thread dies.
+struct ThreadArena {
+  int64_t units = 0;  // uint32 units per slab
+  int slots = 0;
+  std::unique_ptr<uint32_t[]> buf;
+  uint32_t* ensure(int64_t n, int T) {
+    const int64_t need = 2 * n + 2;
+    if (units < need || slots < T) {
+      buf.reset(new uint32_t[(size_t)(need * T)]);
+      units = need;
+      slots = T;
+    }
+    return buf.get();
+  }
+  uint32_t* slab(int t) { return buf.get() + (size_t)t * (size_t)units; }
+};
+static thread_local ThreadArena g_arena;
+
+// Per-thread (h, kid) capture lists of the bucket-run fold — same
+// persistence story as the arena: capacity survives across calls so
+// the per-block folds stop re-faulting fresh pages every block.
+static thread_local std::vector<std::vector<uint64_t>> g_caps;
+
+static inline void tstats_reset() {
+  g_tstats.used = 1;
+  std::memset(g_tstats.busy, 0, sizeof(g_tstats.busy));
+}
+
+static inline double mono_s() {
+  return std::chrono::duration<double>(
+             std::chrono::steady_clock::now().time_since_epoch())
+      .count();
+}
 
 // SHEEP_NATIVE_TIME=1: per-phase stderr timings for the hot kernels
 // (dev observability; costs two getenv + clock reads per call when off).
@@ -142,7 +270,8 @@ template <bool kPre>
 static inline void adopt_group_impl(const uint32_t* grp, int64_t len,
                                     uint32_t hh, uint32_t* uf,
                                     uint32_t* parent_out, uint32_t* pre_out,
-                                    std::vector<uint32_t>& adopted) {
+                                    std::vector<uint32_t>& adopted,
+                                    std::vector<uint64_t>* cap) {
   adopted.clear();
   for (int64_t i = 0; i < len; ++i) {
     if (i + 8 < len) __builtin_prefetch(&uf[grp[i + 8]]);
@@ -163,16 +292,22 @@ static inline void adopt_group_impl(const uint32_t* grp, int64_t len,
     }
   }
   for (uint32_t r : adopted) uf[r] = hh;
+  if (cap)  // the threaded arm's (h, kid) capture — already h-ascending
+    for (uint32_t r : adopted)
+      cap->push_back(((uint64_t)hh << 32) | r);
 }
 
 static inline void adopt_group(const uint32_t* grp, int64_t len, uint32_t hh,
                                uint32_t* uf, uint32_t* parent_out,
                                uint32_t* pre_out,
-                               std::vector<uint32_t>& adopted) {
+                               std::vector<uint32_t>& adopted,
+                               std::vector<uint64_t>* cap = nullptr) {
   if (pre_out)
-    adopt_group_impl<true>(grp, len, hh, uf, parent_out, pre_out, adopted);
+    adopt_group_impl<true>(grp, len, hh, uf, parent_out, pre_out, adopted,
+                           cap);
   else
-    adopt_group_impl<false>(grp, len, hh, uf, parent_out, pre_out, adopted);
+    adopt_group_impl<false>(grp, len, hh, uf, parent_out, pre_out, adopted,
+                            cap);
 }
 
 static inline uint32_t rec_lo(uint64_t r) { return (uint32_t)r; }
@@ -182,6 +317,19 @@ static void blocked_group_adopt(const uint32_t* lo, const uint32_t* hi,
                                 int64_t m, int64_t n, uint32_t* pst_out,
                                 uint32_t* uf, uint32_t* parent_out,
                                 uint32_t* pre_out, PhaseTimer& pt);
+
+// Serial grouping+adoption dispatch (the one place the blocked/plain
+// choice lives for a given slice of links).
+static inline void group_adopt_dispatch(const uint32_t* lo, const uint32_t* hi,
+                                        int64_t m, int64_t n,
+                                        uint32_t* pst_out, uint32_t* uf,
+                                        uint32_t* parent_out,
+                                        uint32_t* pre_out, PhaseTimer& pt);
+
+static void threaded_group_adopt(const uint32_t* lo, const uint32_t* hi,
+                                 int64_t m, int64_t n, uint32_t* pst_out,
+                                 uint32_t* uf, uint32_t* parent_out, int T,
+                                 PhaseTimer& pt);
 
 // Unblocked grouping + adoption (counting sort by hi, then the shared
 // adopt_group): the small-input path of sheep_build_forest, factored so
@@ -233,21 +381,44 @@ static int64_t fold_links_block(const uint32_t* lo, const uint32_t* hi,
                                 bool accumulate_pst, uint32_t* uf,
                                 uint32_t* parent_out, uint32_t* pst_out,
                                 uint32_t* pre_out, PhaseTimer& pt) {
+  // pre accounting is inherently order-within-the-whole-build (the root
+  // BEFORE adoption), so the threaded partial decomposition keeps off it
+  const int T = pre_out ? 1 : threads_for_work(m);
   int64_t mx = lo_bound;
-  for (int64_t i = 0; i < m; ++i) {
-    if (lo[i] >= (uint64_t)n) return -3;  // malformed link
-    if (hi[i] < (uint64_t)n) {
-      if ((int64_t)hi[i] < lo_bound) return -7;  // out-of-order block
-      if ((int64_t)hi[i] > mx) mx = (int64_t)hi[i];
+  bool bad_lo = false, bad_order = false;
+#ifdef _OPENMP
+  if (T > 1) {
+#pragma omp parallel for num_threads(T) schedule(static) \
+    reduction(max : mx) reduction(|| : bad_lo, bad_order)
+    for (int64_t i = 0; i < m; ++i) {
+      if (lo[i] >= (uint64_t)n) bad_lo = true;
+      if (hi[i] < (uint64_t)n) {
+        if ((int64_t)hi[i] < lo_bound) bad_order = true;
+        if ((int64_t)hi[i] > mx) mx = (int64_t)hi[i];
+      }
+    }
+  } else
+#endif
+  {
+    for (int64_t i = 0; i < m; ++i) {
+      if (lo[i] >= (uint64_t)n) return -3;  // malformed link
+      if (hi[i] < (uint64_t)n) {
+        if ((int64_t)hi[i] < lo_bound) return -7;  // out-of-order block
+        if ((int64_t)hi[i] > mx) mx = (int64_t)hi[i];
+      }
     }
   }
+  if (bad_lo) return -3;
+  if (bad_order) return -7;
   pt.mark("validate");
-  if (use_blocked(m, n)) {
-    blocked_group_adopt(lo, hi, m, n, accumulate_pst ? pst_out : nullptr,
-                        uf, parent_out, pre_out, pt);
+  if (T > 1) {
+    threaded_group_adopt(lo, hi, m, n,
+                         accumulate_pst ? pst_out : nullptr, uf,
+                         parent_out, T, pt);
   } else {
-    plain_group_adopt(lo, hi, m, n, accumulate_pst ? pst_out : nullptr,
-                      uf, parent_out, pre_out, pt);
+    group_adopt_dispatch(lo, hi, m, n,
+                         accumulate_pst ? pst_out : nullptr, uf,
+                         parent_out, pre_out, pt);
   }
   return mx;
 }
@@ -347,6 +518,333 @@ static void blocked_group_adopt(const uint32_t* lo, const uint32_t* hi,
                  scat_s, adopt_s);
   pt.mark("buckets");
 }
+
+static inline void group_adopt_dispatch(const uint32_t* lo, const uint32_t* hi,
+                                        int64_t m, int64_t n,
+                                        uint32_t* pst_out, uint32_t* uf,
+                                        uint32_t* parent_out,
+                                        uint32_t* pre_out, PhaseTimer& pt) {
+  if (use_blocked(m, n))
+    blocked_group_adopt(lo, hi, m, n, pst_out, uf, parent_out, pre_out, pt);
+  else
+    plain_group_adopt(lo, hi, m, n, pst_out, uf, parent_out, pre_out, pt);
+}
+
+// The threaded fold (round-14): T contiguous record slices, each folded
+// by one thread into a PRIVATE partial forest (its own identity
+// union-find + kInvalid parent over the full [n] position space — the
+// 8n-per-extra-thread the governor prices), then ONE deterministic
+// merge replaying every partial's (kid -> parent) links into the real
+// carried state through the same grouping+adoption.
+//
+// Exactness is the associative-merge theorem the tournament and every
+// streaming fold already stand on: forest(A ∪ B) == forest(links(
+// forest(A)) ∪ links(forest(B))), so the merged result is the unique
+// forest of the whole multiset — independent of T, of where the slices
+// cut (an equal-hi group MAY span slices: the same group-split argument
+// as resumable block boundaries), and of the merge bracket (k-way
+// concat here == any pairwise tree, proven by the bracket-independence
+// test).  pst partials are int-add commutative, summed in fixed thread
+// order.  Slices are cut on raw record positions, not on hi-group
+// boundaries: the input is not hi-sorted at this layer (each slice's
+// own counting sort / quantile bucketing does that privately), so
+// aligning cuts would cost a full partition pass before any thread
+// could start — and exactness needs no alignment.
+#ifdef _OPENMP
+// One thread's half of the decomposition: fold already-mapped slice
+// links into a PRIVATE partial forest (identity union-find + kInvalid
+// parent over the full [n] space) and emit the partial's (kid ->
+// parent) links for the merge.  pst_l non-null accumulates this slice's
+// pst contribution (all links, pst-only included).
+static void slice_partial_fold(const uint32_t* lo, const uint32_t* hi,
+                               int64_t m, int64_t n, uint32_t* pst_l,
+                               std::vector<uint32_t>& out_lo,
+                               std::vector<uint32_t>& out_hi) {
+  std::vector<uint32_t> uf_l((size_t)n), parent_l((size_t)n);
+  for (int64_t v = 0; v < n; ++v) {
+    uf_l[(size_t)v] = (uint32_t)v;
+    parent_l[(size_t)v] = kInvalid;
+  }
+  PhaseTimer ptl("thread_slice");
+  ptl.on = false;  // per-thread phase prints would interleave
+  group_adopt_dispatch(lo, hi, m, n, pst_l, uf_l.data(), parent_l.data(),
+                       nullptr, ptl);
+  for (int64_t v = 0; v < n; ++v)
+    if (parent_l[(size_t)v] != kInvalid) {
+      out_lo.push_back((uint32_t)v);
+      out_hi.push_back(parent_l[(size_t)v]);
+    }
+}
+
+// The deterministic merge: per-thread pst partials sum in fixed
+// ascending thread order (uint32 adds commute — the sum is the serial
+// count bit for bit), and every partial forest's links replay into the
+// real carried state through the same grouping+adoption.  The k-way
+// concat here equals ANY pairwise merge bracket by associativity
+// (proven by the bracket-independence test in test_native_threads.py).
+static void merge_partials(std::vector<std::vector<uint32_t>>& mlo,
+                           std::vector<std::vector<uint32_t>>& mhi,
+                           std::vector<std::vector<uint32_t>>& psts,
+                           uint32_t* pst_out, int64_t n, int T, uint32_t* uf,
+                           uint32_t* parent_out, PhaseTimer& pt) {
+  if (pst_out) {
+#pragma omp parallel for num_threads(T) schedule(static)
+    for (int64_t v = 0; v < n; ++v) {
+      uint32_t s = 0;
+      for (int tt = 0; tt < T; ++tt) s += psts[(size_t)tt][(size_t)v];
+      pst_out[v] += s;
+    }
+  }
+  size_t total = 0;
+  for (auto& v2 : mlo) total += v2.size();
+  std::vector<uint32_t> alo, ahi;
+  alo.reserve(total);
+  ahi.reserve(total);
+  for (int tt = 0; tt < T; ++tt) {
+    alo.insert(alo.end(), mlo[(size_t)tt].begin(), mlo[(size_t)tt].end());
+    ahi.insert(ahi.end(), mhi[(size_t)tt].begin(), mhi[(size_t)tt].end());
+  }
+  // partial links never count pst (they are tree edges, not records)
+  group_adopt_dispatch(alo.data(), ahi.data(), (int64_t)alo.size(), n,
+                       nullptr, uf, parent_out, nullptr, pt);
+  pt.mark("merge");
+}
+#endif
+
+#ifdef _OPENMP
+// The threaded BLOCKED kernel (round-14, the tentpole): the cache-
+// blocked kernel's quantile buckets are the parallel decomposition.
+// One shared count + bucket partition (threaded over contiguous record
+// slices with per-thread partial counts / cursor matrices — adds
+// commute, cursor segments are disjoint), then the K equal-count
+// buckets split into T contiguous RUNS cut on bucket boundaries, so no
+// bucket — and therefore no hi-group — ever spans two threads.  Each
+// thread adopts its run into a PRIVATE partial forest (identity uf +
+// kInvalid parent over the full [n] space: the 8n-per-extra-thread the
+// governor prices), capturing its (h, kid) adoptions; runs own
+// DISJOINT ASCENDING h-ranges, so the captures concatenated in thread
+// order are one globally h-ascending stream, and the merge is a single
+// scaffold-free linear fold of that stream into the real carried state
+// through the same adopt_group (no counting sort, no bucket tables —
+// the merge reuses the exact serial group semantics).
+//
+// Exactness is the associative-merge theorem the tournament and every
+// streaming fold already stand on: the merged result is the unique
+// forest of the whole multiset, independent of T, of where the runs
+// cut, and of the merge bracket (proven by the bracket-independence
+// test in test_native_threads.py) — parent and pst are bit-identical
+// to the single-thread build for every thread count.
+static void blocked_group_adopt_mt(const uint32_t* lo, const uint32_t* hi,
+                                   int64_t m, int64_t n, uint32_t* pst_out,
+                                   uint32_t* uf, uint32_t* parent_out,
+                                   int T, PhaseTimer& pt) {
+  ThreadStats* ts = &g_tstats;
+  ts->used = T;
+  // the arena's T slabs back BOTH per-thread table phases (count+pst
+  // partials here, union-find+parent partials in phase 4) — 8n+8 bytes
+  // per thread, warm across calls (struct comment)
+  ThreadArena* arena = &g_arena;
+  arena->ensure(n, T);
+  // phase 1: per-h counts (+ pst) — per-thread partials over contiguous
+  // record slices, summed in fixed thread order (int adds commute)
+  std::vector<int32_t> offs((size_t)n + 1, 0);
+#pragma omp parallel num_threads(T)
+  {
+    const int t = omp_get_thread_num();
+    const double t0 = mono_s();
+    const int64_t a = m * t / T, b = m * (t + 1) / T;
+    int32_t* c = (int32_t*)arena->slab(t);  // [n+1]
+    uint32_t* p = arena->slab(t) + n + 1;   // [n]
+    std::memset(c, 0, sizeof(int32_t) * (size_t)(n + 1));
+    for (int64_t i = a; i < b; ++i)
+      if (hi[i] < (uint64_t)n) ++c[hi[i] + 1];
+    if (pst_out) {
+      std::memset(p, 0, sizeof(uint32_t) * (size_t)n);
+      for (int64_t i = a; i < b; ++i) ++p[lo[i]];
+    }
+    if (t < kMaxThreads) ts->busy[t] += mono_s() - t0;
+  }
+#pragma omp parallel for num_threads(T) schedule(static)
+  for (int64_t h = 0; h <= n; ++h) {
+    int32_t s = 0;
+    for (int tt = 0; tt < T; ++tt) s += ((int32_t*)arena->slab(tt))[h];
+    offs[(size_t)h] = s;
+  }
+  if (pst_out) {
+    // one region, fixed ascending tt order per v — adds commute
+#pragma omp parallel for num_threads(T) schedule(static)
+    for (int64_t v = 0; v < n; ++v) {
+      uint32_t s = 0;
+      for (int tt = 0; tt < T; ++tt) s += (arena->slab(tt) + n + 1)[v];
+      pst_out[v] += s;
+    }
+  }
+  pt.mark("count+pst");
+  // phase 2: prefix + the SAME quantile bucket rule as the serial
+  // kernel (equal-count boundaries over the per-h prefix)
+  for (int64_t h = 0; h < n; ++h) offs[h + 1] += offs[h];
+  const int64_t linked = offs[n];
+  const int64_t K = kMaxBuckets;
+  std::vector<int64_t> bound((size_t)K + 1);
+  bound[0] = 0;
+  bound[(size_t)K] = n;
+  for (int64_t b = 1; b < K; ++b)
+    bound[(size_t)b] = std::lower_bound(offs.begin(), offs.begin() + n + 1,
+                                        (int32_t)(b * linked / K)) -
+                       offs.begin();
+  std::vector<uint8_t> bucket_of((size_t)n);
+  for (int64_t b = 0; b < K; ++b)
+    std::memset(bucket_of.data() + bound[(size_t)b], (int)b,
+                (size_t)(bound[(size_t)b + 1] - bound[(size_t)b]));
+  std::vector<int64_t> bstart((size_t)K + 1);
+  for (int64_t b = 0; b <= K; ++b) bstart[(size_t)b] = offs[bound[(size_t)b]];
+  // phase 3: threaded partition — per-(thread, bucket) counts give each
+  // thread disjoint write segments (thread-major inside a bucket; group
+  // adoption is order-free within a group, so outputs are unchanged).
+  // The counts come FREE from phase 1's per-h slabs (a sequential O(n)
+  // sum per thread) instead of a second O(m) pass over the records.
+  std::unique_ptr<uint64_t[]> recs(new uint64_t[(size_t)linked]);
+  {
+    std::vector<std::vector<int64_t>> bcnt((size_t)T);
+#pragma omp parallel num_threads(T)
+    {
+      const int t = omp_get_thread_num();
+      const double t0 = mono_s();
+      const int64_t a = m * t / T, b = m * (t + 1) / T;
+      const int32_t* c = (const int32_t*)arena->slab(t);  // phase-1 counts
+      std::vector<int64_t>& bc = bcnt[(size_t)t];
+      bc.assign((size_t)K, 0);
+      for (int64_t b2 = 0; b2 < K; ++b2) {
+        int64_t s = 0;
+        for (int64_t h = bound[(size_t)b2]; h < bound[(size_t)b2 + 1]; ++h)
+          s += c[h + 1];
+        bc[(size_t)b2] = s;
+      }
+#pragma omp barrier
+      std::vector<int64_t> curl((size_t)K);
+      for (int64_t b2 = 0; b2 < K; ++b2) {
+        int64_t at = bstart[(size_t)b2];
+        for (int tt = 0; tt < t; ++tt) at += bcnt[(size_t)tt][(size_t)b2];
+        curl[(size_t)b2] = at;
+      }
+      for (int64_t i = a; i < b; ++i) {
+        const uint32_t h = hi[i];
+        if (h >= (uint64_t)n) continue;
+        recs[(size_t)curl[bucket_of[h]]++] = ((uint64_t)h << 32) | lo[i];
+      }
+      if (t < kMaxThreads) ts->busy[t] += mono_s() - t0;
+    }
+  }
+  pt.mark("partition");
+  // phase 4: bucket RUNS — T contiguous runs cut on bucket boundaries
+  // balanced by link count; each thread adopts its run into a private
+  // partial forest, capturing (h, kid) pairs in ascending-h order
+  std::vector<int64_t> rb((size_t)T + 1);
+  rb[0] = 0;
+  rb[(size_t)T] = K;
+  for (int64_t t = 1; t < T; ++t) {
+    int64_t cut = std::lower_bound(bstart.begin(), bstart.begin() + K + 1,
+                                   t * linked / T) -
+                  bstart.begin();
+    rb[(size_t)t] = std::max(rb[(size_t)t - 1], std::min(cut, (int64_t)K));
+  }
+  std::vector<std::vector<uint64_t>>& caps = g_caps;
+  if ((int)caps.size() < T) caps.resize((size_t)T);
+  for (int t = 0; t < T; ++t) caps[(size_t)t].clear();  // capacity kept
+#pragma omp parallel num_threads(T)
+  {
+    const int t = omp_get_thread_num();
+    const double t0 = mono_s();
+    uint32_t* uf_l = arena->slab(t);          // [n] — phase 1 is done
+    uint32_t* parent_l = arena->slab(t) + n;  // [n] with these slabs
+    for (int64_t v = 0; v < n; ++v) {
+      uf_l[(size_t)v] = (uint32_t)v;
+      parent_l[(size_t)v] = kInvalid;
+    }
+    std::vector<uint32_t> grouped, adopted;
+    std::vector<uint64_t>* cap = &caps[(size_t)t];
+    for (int64_t b2 = rb[(size_t)t]; b2 < rb[(size_t)t + 1]; ++b2) {
+      const int64_t s = bstart[(size_t)b2], e = bstart[(size_t)b2 + 1];
+      if (s == e) continue;
+      if ((int64_t)grouped.size() < e - s) grouped.resize((size_t)(e - s));
+      // offs[h] mutates as the scatter cursor exactly like the serial
+      // bucket loop — every h lives in exactly one bucket, every bucket
+      // in exactly one run, so the mutation is thread-exclusive
+      for (int64_t i = s; i < e; ++i)
+        grouped[(size_t)(offs[rec_h(recs[(size_t)i])]++ - s)] =
+            rec_lo(recs[(size_t)i]);
+      int64_t prev = s;
+      for (int64_t h = bound[(size_t)b2]; h < bound[(size_t)b2 + 1]; ++h) {
+        const int64_t end = offs[h];
+        if (end > prev)
+          adopt_group(grouped.data() + (prev - s), end - prev, (uint32_t)h,
+                      uf_l, parent_l, nullptr, adopted, cap);
+        prev = end;
+      }
+    }
+    if (t < kMaxThreads) ts->busy[t] += mono_s() - t0;
+  }
+  pt.mark("slices");
+  // phase 5: the linear merge — thread captures concatenate into one
+  // globally h-ascending stream (runs own disjoint ascending h-ranges;
+  // an equal-h group can never span runs because runs cut on bucket
+  // boundaries), replayed into the real carried state group by group
+  // through the exact serial adoption
+  std::vector<uint32_t> run, adopted;
+  for (int64_t t = 0; t < T; ++t) {
+    const std::vector<uint64_t>& cp = caps[(size_t)t];
+    size_t i = 0;
+    while (i < cp.size()) {
+      const uint32_t h = (uint32_t)(cp[i] >> 32);
+      run.clear();
+      while (i < cp.size() && (uint32_t)(cp[i] >> 32) == h)
+        run.push_back((uint32_t)cp[i++]);
+      adopt_group(run.data(), (int64_t)run.size(), h, uf, parent_out,
+                  nullptr, adopted);
+    }
+  }
+  pt.mark("merge");
+}
+#endif
+
+static void threaded_group_adopt(const uint32_t* lo, const uint32_t* hi,
+                                 int64_t m, int64_t n, uint32_t* pst_out,
+                                 uint32_t* uf, uint32_t* parent_out, int T,
+                                 PhaseTimer& pt) {
+#ifdef _OPENMP
+  if (use_blocked(m, n)) {
+    // the tentpole path: shared count/partition, bucket-run slices
+    blocked_group_adopt_mt(lo, hi, m, n, pst_out, uf, parent_out, T, pt);
+    return;
+  }
+  // plain-path inputs (below the blocked floor, or past the int32
+  // prefix limit): per-thread partial forests over contiguous record
+  // slices, merged through the same grouping+adoption
+  std::vector<std::vector<uint32_t>> mlo((size_t)T), mhi((size_t)T);
+  std::vector<std::vector<uint32_t>> psts(pst_out ? (size_t)T : 0);
+  ThreadStats* ts = &g_tstats;
+  ts->used = T;
+#pragma omp parallel num_threads(T)
+  {
+    const int t = omp_get_thread_num();
+    const double t0 = mono_s();
+    const int64_t a = m * t / T, b = m * (t + 1) / T;
+    uint32_t* pst_l = nullptr;
+    if (pst_out) {
+      psts[(size_t)t].assign((size_t)n, 0);
+      pst_l = psts[(size_t)t].data();
+    }
+    slice_partial_fold(lo + a, hi + a, b - a, n, pst_l, mlo[(size_t)t],
+                       mhi[(size_t)t]);
+    if (t < kMaxThreads) ts->busy[t] += mono_s() - t0;
+  }
+  pt.mark("slices");
+  merge_partials(mlo, mhi, psts, pst_out, n, T, uf, parent_out, pt);
+#else
+  (void)T;
+  group_adopt_dispatch(lo, hi, m, n, pst_out, uf, parent_out, nullptr, pt);
+#endif
+}
 }  // namespace
 
 extern "C" {
@@ -378,6 +876,7 @@ int sheep_build_forest(const uint32_t* lo, const uint32_t* hi, int64_t m,
                        uint32_t* parent_out, uint32_t* pst_out,
                        uint32_t* pre_out) {
   if (n < 0 || m < 0) return -1;
+  tstats_reset();
   PhaseTimer pt("build_forest");
   const bool blocked = use_blocked(m, n);
   if (pst_in) {
@@ -437,6 +936,7 @@ int64_t sheep_build_forest_links_block(const uint32_t* lo, const uint32_t* hi,
                                        uint32_t* parent_out,
                                        uint32_t* pst_out, uint32_t* uf) {
   if (n < 0 || m < 0 || lo_bound < 0) return -1;
+  tstats_reset();
   PhaseTimer pt("links_block");
   return fold_links_block(lo, hi, m, n, lo_bound, accumulate_pst != 0, uf,
                           parent_out, pst_out, nullptr, pt);
@@ -570,15 +1070,13 @@ int64_t sheep_forward_partition(const uint32_t* parent, const int64_t* weights,
 // Returns 0, or -3 when a record names a vid >= n (corrupt input; the
 // reference's LLAMA path sizes the table from the real max vid, so an
 // out-of-range vid can only come from a malformed file).
+int sheep_degree_histogram_acc(const uint32_t* tail, const uint32_t* head,
+                               int64_t m, int64_t n, int64_t* deg_io);
+
 int sheep_degree_histogram(const uint32_t* tail, const uint32_t* head,
                            int64_t m, int64_t n, int64_t* deg_out) {
   std::memset(deg_out, 0, sizeof(int64_t) * (size_t)n);
-  for (int64_t i = 0; i < m; ++i) {
-    if (tail[i] >= (uint64_t)n || head[i] >= (uint64_t)n) return -3;
-    ++deg_out[tail[i]];
-    ++deg_out[head[i]];
-  }
-  return 0;
+  return sheep_degree_histogram_acc(tail, head, m, n, deg_out);
 }
 
 // Accumulating variant for the out-of-core streaming pass (round-8): adds
@@ -592,6 +1090,49 @@ int sheep_degree_histogram(const uint32_t* tail, const uint32_t* head,
 // abort the pass — the accumulator is not salvageable mid-block).
 int sheep_degree_histogram_acc(const uint32_t* tail, const uint32_t* head,
                                int64_t m, int64_t n, int64_t* deg_io) {
+  tstats_reset();
+#ifdef _OPENMP
+  // Threaded arm (round-14): per-thread int64 partial histograms over
+  // contiguous record slices, summed in fixed thread order — integer
+  // adds commute, so the sum equals the serial accumulation bit for
+  // bit.  Costs 8n per extra thread (the governor's veto term).  On a
+  // bad vid NO partial is merged (a stricter contract than the serial
+  // loop's partial adds; callers abort the pass either way).
+  const int T = threads_for_work(m);
+  if (T > 1) {
+    bool bad = false;
+    ThreadStats* ts = &g_tstats;
+    ts->used = T;
+    ThreadArena* arena = &g_arena;  // int64[n] partial per slab
+    arena->ensure(n, T);
+#pragma omp parallel num_threads(T) reduction(|| : bad)
+    {
+      const int t = omp_get_thread_num();
+      const double t0 = mono_s();
+      const int64_t a = m * t / T, b = m * (t + 1) / T;
+      int64_t* part = (int64_t*)arena->slab(t);
+      std::memset(part, 0, sizeof(int64_t) * (size_t)n);
+      for (int64_t i = a; i < b; ++i) {
+        if (tail[i] >= (uint64_t)n || head[i] >= (uint64_t)n) {
+          bad = true;
+          break;
+        }
+        ++part[tail[i]];
+        ++part[head[i]];
+      }
+      if (t < kMaxThreads) ts->busy[t] += mono_s() - t0;
+    }
+    if (bad) return -3;
+#pragma omp parallel for num_threads(T) schedule(static)
+    for (int64_t v = 0; v < n; ++v) {
+      int64_t s = 0;
+      for (int tt = 0; tt < T; ++tt)
+        s += ((const int64_t*)arena->slab(tt))[v];
+      deg_io[v] += s;
+    }
+    return 0;
+  }
+#endif
   for (int64_t i = 0; i < m; ++i) {
     if (tail[i] >= (uint64_t)n || head[i] >= (uint64_t)n) return -3;
     ++deg_io[tail[i]];
@@ -614,11 +1155,51 @@ int64_t sheep_degree_sequence_edges(const uint32_t* tail,
                                     const uint32_t* head, int64_t m,
                                     int64_t n, uint32_t* seq_out) {
   if (n < 0 || m < 0 || 2 * m > (int64_t)kInvalid) return -5;
+  tstats_reset();
   std::vector<uint32_t> deg((size_t)n, 0);
-  for (int64_t i = 0; i < m; ++i) {
-    if (tail[i] >= (uint64_t)n || head[i] >= (uint64_t)n) return -3;
-    ++deg[tail[i]];
-    ++deg[head[i]];
+#ifdef _OPENMP
+  // Threaded histogram (round-14): uint32 per-thread partials (the same
+  // narrow-counter win as the serial kernel) summed in thread order —
+  // commutative adds, bit-identical to the serial count.
+  const int T = threads_for_work(m);
+  if (T > 1) {
+    bool bad = false;
+    ThreadStats* ts = &g_tstats;
+    ts->used = T;
+    ThreadArena* arena = &g_arena;  // uint32[n] partial per slab
+    arena->ensure(n, T);
+#pragma omp parallel num_threads(T) reduction(|| : bad)
+    {
+      const int t = omp_get_thread_num();
+      const double t0 = mono_s();
+      const int64_t a = m * t / T, b = m * (t + 1) / T;
+      uint32_t* part = arena->slab(t);
+      std::memset(part, 0, sizeof(uint32_t) * (size_t)n);
+      for (int64_t i = a; i < b; ++i) {
+        if (tail[i] >= (uint64_t)n || head[i] >= (uint64_t)n) {
+          bad = true;
+          break;
+        }
+        ++part[tail[i]];
+        ++part[head[i]];
+      }
+      if (t < kMaxThreads) ts->busy[t] += mono_s() - t0;
+    }
+    if (bad) return -3;
+#pragma omp parallel for num_threads(T) schedule(static)
+    for (int64_t v = 0; v < n; ++v) {
+      uint32_t s = 0;
+      for (int tt = 0; tt < T; ++tt) s += arena->slab(tt)[v];
+      deg[(size_t)v] += s;
+    }
+  } else
+#endif
+  {
+    for (int64_t i = 0; i < m; ++i) {
+      if (tail[i] >= (uint64_t)n || head[i] >= (uint64_t)n) return -3;
+      ++deg[tail[i]];
+      ++deg[head[i]];
+    }
   }
   uint32_t max_deg = 0;
   for (int64_t v = 0; v < n; ++v)
@@ -655,12 +1236,82 @@ int sheep_build_forest_edges(const uint32_t* tail, const uint32_t* head,
                              int64_t n, uint32_t* parent_out,
                              uint32_t* pst_out, uint32_t* pre_out) {
   if (n < 0 || m < 0) return -1;
+  tstats_reset();
   PhaseTimer pt("build_forest_edges");
   std::memset(pst_out, 0, sizeof(uint32_t) * (size_t)n);
   for (int64_t v = 0; v < n; ++v) parent_out[v] = kInvalid;
   if (pre_out) std::memset(pre_out, 0, sizeof(uint32_t) * (size_t)n);
   std::vector<uint32_t> uf((size_t)n);
   for (int64_t v = 0; v < n; ++v) uf[(size_t)v] = (uint32_t)v;
+
+#ifdef _OPENMP
+  // Threaded arm (round-14): the mapping pass parallelizes over record
+  // slices with a count-then-write split — pass A counts each slice's
+  // kept records (and validates), pass B writes them DIRECTLY into the
+  // final arrays at prefix offsets, so the mapped table is byte-
+  // identical to the serial pass's with no per-thread staging buffers
+  // (staging was measured to double the phase in page faults alone).
+  // The shared bucket-run kernel does the rest — pst accumulates inside
+  // its threaded count pass exactly like the serial fused path.
+  const int T = pre_out ? 1 : threads_for_work(m);
+  if (T > 1) {
+    bool bad = false;
+    std::vector<int64_t> kept((size_t)T, 0);
+    ThreadStats* ts = &g_tstats;  // the CALLER's telemetry slot —
+    // g_tstats inside the parallel region is each worker's own
+#pragma omp parallel num_threads(T) reduction(|| : bad)
+    {
+      const int t = omp_get_thread_num();
+      const double t0 = mono_s();
+      const int64_t a = m * t / T, b = m * (t + 1) / T;
+      int64_t cnt = 0;
+      for (int64_t i = a; i < b; ++i) {
+        const uint32_t pt_ =
+            tail[i] < (uint64_t)pos_len ? pos[tail[i]] : kInvalid;
+        const uint32_t ph_ =
+            head[i] < (uint64_t)pos_len ? pos[head[i]] : kInvalid;
+        if (pt_ == ph_) continue;  // self-loop or both absent
+        if ((pt_ < ph_ ? pt_ : ph_) >= (uint64_t)n) {  // corrupt pos
+          bad = true;
+          break;
+        }
+        ++cnt;
+      }
+      kept[(size_t)t] = cnt;
+      if (t < kMaxThreads) ts->busy[t] += mono_s() - t0;
+    }
+    if (bad) return -3;
+    int64_t k = 0;
+    std::vector<int64_t> starts((size_t)T);
+    for (int t = 0; t < T; ++t) {
+      starts[(size_t)t] = k;
+      k += kept[(size_t)t];
+    }
+    std::vector<uint32_t> mlo((size_t)k), mhi((size_t)k);
+#pragma omp parallel num_threads(T)
+    {
+      const int t = omp_get_thread_num();
+      const double t0 = mono_s();
+      const int64_t a = m * t / T, b = m * (t + 1) / T;
+      int64_t at = starts[(size_t)t];
+      for (int64_t i = a; i < b; ++i) {
+        const uint32_t pt_ =
+            tail[i] < (uint64_t)pos_len ? pos[tail[i]] : kInvalid;
+        const uint32_t ph_ =
+            head[i] < (uint64_t)pos_len ? pos[head[i]] : kInvalid;
+        if (pt_ == ph_) continue;
+        mlo[(size_t)at] = pt_ < ph_ ? pt_ : ph_;
+        mhi[(size_t)at] = pt_ < ph_ ? ph_ : pt_;
+        ++at;
+      }
+      if (t < kMaxThreads) ts->busy[t] += mono_s() - t0;
+    }
+    pt.mark("map");
+    threaded_group_adopt(mlo.data(), mhi.data(), k, n, pst_out, uf.data(),
+                         parent_out, T, pt);
+    return 0;
+  }
+#endif
 
   // Tight mapping pass (the only pos-gather pass; pst and the group
   // count live in blocked_group_adopt's own read passes — a fused loop
@@ -694,9 +1345,69 @@ int sheep_build_forest_edges(const uint32_t* tail, const uint32_t* head,
 // versus the reference's comparison sort.  Returns the sequence length.
 int64_t sheep_degree_sequence(const int64_t* deg, int64_t n,
                               uint32_t* seq_out) {
+  tstats_reset();
   int64_t max_deg = 0;
+#ifdef _OPENMP
+  int T = threads_for_work(n);
+#pragma omp parallel for num_threads(T) schedule(static) \
+    reduction(max : max_deg) if (T > 1)
+#endif
   for (int64_t v = 0; v < n; ++v)
     if (deg[v] > max_deg) max_deg = deg[v];
+#ifdef _OPENMP
+  // Threaded counting sort (round-14): per-thread degree-bucket counts
+  // over contiguous vid slices, exclusive-prefixed into per-thread
+  // write cursors — thread t's vids land after threads < t's within
+  // every bucket, so the scatter preserves the ascending-vid tie break
+  // and the output is bit-identical to the serial sort.  Gated off when
+  // the T bucket tables would dwarf the O(n) work they parallelize.
+  if (T > 1 && (max_deg + 2) * (int64_t)T * 8 > 16 * n) T = 1;
+  if (T > 1) {
+    std::vector<std::vector<int64_t>> cnt((size_t)T);
+    ThreadStats* ts = &g_tstats;
+    ts->used = T;
+#pragma omp parallel num_threads(T)
+    {
+      const int t = omp_get_thread_num();
+      const double t0 = mono_s();
+      const int64_t a = n * t / T, b = n * (t + 1) / T;
+      std::vector<int64_t>& c = cnt[(size_t)t];
+      c.assign((size_t)max_deg + 2, 0);
+      for (int64_t v = a; v < b; ++v)
+        if (deg[v] > 0) ++c[(size_t)deg[v]];
+      if (t < kMaxThreads) ts->busy[t] = mono_s() - t0;
+    }
+    // serial exclusive prefix over (degree, thread): cursor[t][d] =
+    // (elements of degree < d anywhere) + (degree-d elements of earlier
+    // threads)
+    std::vector<int64_t> base((size_t)max_deg + 2, 0);
+    int64_t run = 0;
+    for (int64_t d = 1; d <= max_deg; ++d) {
+      base[(size_t)d] = run;
+      for (int tt = 0; tt < T; ++tt) run += cnt[(size_t)tt][(size_t)d];
+    }
+    const int64_t total = run;
+    std::vector<std::vector<int64_t>> cur((size_t)T);
+    for (int tt = 0; tt < T; ++tt)
+      cur[(size_t)tt].assign((size_t)max_deg + 2, 0);
+    for (int64_t d = 1; d <= max_deg; ++d) {
+      int64_t at = base[(size_t)d];
+      for (int tt = 0; tt < T; ++tt) {
+        cur[(size_t)tt][(size_t)d] = at;
+        at += cnt[(size_t)tt][(size_t)d];
+      }
+    }
+#pragma omp parallel num_threads(T)
+    {
+      const int t = omp_get_thread_num();
+      const int64_t a = n * t / T, b = n * (t + 1) / T;
+      std::vector<int64_t>& c = cur[(size_t)t];
+      for (int64_t v = a; v < b; ++v)
+        if (deg[v] > 0) seq_out[c[(size_t)deg[v]]++] = (uint32_t)v;
+    }
+    return total;
+  }
+#endif
   std::vector<int64_t> offs((size_t)max_deg + 2, 0);
   for (int64_t v = 0; v < n; ++v)
     if (deg[v] > 0) ++offs[deg[v] + 1];
@@ -1129,6 +1840,51 @@ int64_t sheep_eval_block(const uint32_t* tail, const uint32_t* head,
     }
   }
   return edges_cut;
+}
+
+// ---------------------------------------------------------------------------
+// Threading introspection (round-14): the Python bindings and the
+// governor ask the library — not the environment — what the kernels
+// will actually do, so a build compiled without OpenMP reports
+// threads=1 honestly no matter what SHEEP_NATIVE_THREADS says.
+// ---------------------------------------------------------------------------
+
+// 1 when the library was compiled with OpenMP (the Makefile probes the
+// toolchain and drops -fopenmp when absent — kernels then run serial).
+int sheep_native_omp(void) {
+#ifdef _OPENMP
+  return 1;
+#else
+  return 0;
+#endif
+}
+
+// The resolved SHEEP_NATIVE_THREADS (1 without OpenMP; clamped to
+// [1, 64]) — what an UNGATED kernel call would use.
+int sheep_native_threads(void) { return resolve_threads(); }
+
+// Threads a kernel call over m records/links will actually use (the
+// resolved count after the engagement floor and per-slice-work gates).
+int sheep_threads_for(int64_t m) { return threads_for_work(m); }
+
+// omp_get_max_threads() of the loaded runtime (1 without OpenMP) — the
+// env_capture field bench records embed.
+int sheep_omp_max_threads(void) {
+#ifdef _OPENMP
+  return omp_get_max_threads();
+#else
+  return 1;
+#endif
+}
+
+// Per-thread busy seconds of this caller thread's LAST kernel call
+// (filled by the threaded arms, reset to {1, 0.0} at every kernel
+// entry).  Returns the thread count used; writes min(used, cap) busy
+// values.  The bindings annotate native.* spans with these.
+int sheep_last_thread_stats(double* busy_out, int cap) {
+  const int u = g_tstats.used;
+  for (int i = 0; i < u && i < cap; ++i) busy_out[i] = g_tstats.busy[i];
+  return u;
 }
 
 }  // extern "C"

@@ -802,6 +802,8 @@ def _stream_tail(lo, hi, live: int, n: int, pst_h, accumulate: bool,
     if perf is not None:
         wall = time.perf_counter() - t_start
         fetch_busy = stream.busy_s if stream is not None else sum(fetch_s)
+        from ..core.forest import native_or_none
+        native = native_or_none("auto")
         perf.update({
             "stream_mode": "windowed",
             "fetch_windows": w,
@@ -813,6 +815,11 @@ def _stream_tail(lo, hi, live: int, n: int, pst_h, accumulate: bool,
             "handoff_links": links_folded,
             "packed_handoff": stream.packed if stream is not None
             else False,
+            # worker threads under the fold (round 14): >1 means the
+            # windows folded on real parallel cores while the fetch ran
+            # ahead — the knob that makes the overlap real off 1 core
+            "native_threads": native.resolve_threads()
+            if native is not None else 1,
         })
     return parent, pst_out
 

@@ -308,8 +308,36 @@ def leg_perf_path(state_dir: str, key: str) -> str:
     return os.path.join(state_dir, f"{key}.perf.json")
 
 
+def apply_overlap_honesty(per_leg: dict, legs: int) -> bool:
+    """The per-leg ``overlap_frac`` honesty rule (round 14): when the
+    concurrent legs TIME-SHARE cores — the union of their affinity
+    masks holds fewer cores than there are legs — a measured 0.0 is not
+    "the prefetch never overlapped the fold", it is "the host could not
+    have overlapped anything"; publishing the number invites a tuning
+    conclusion the record cannot support.  Each affected leg row gets
+    ``overlap_frac: None`` plus ``affinity_limited: True`` (the raw
+    measurement survives under ``overlap_frac_raw`` so a reader can
+    still see what the clock said).  Returns whether the rule fired;
+    rows from hosts with enough distinct cores pass through untouched."""
+    cores: set = set()
+    for row in per_leg.values():
+        aff = row.get("affinity_cores")
+        if aff:
+            cores.update(aff)
+    limited = bool(per_leg) and bool(cores) and len(cores) < max(1, legs)
+    if not limited:
+        return False
+    for row in per_leg.values():
+        if "overlap_frac" in row:
+            row["overlap_frac_raw"] = row["overlap_frac"]
+            row["overlap_frac"] = None
+        row["affinity_limited"] = True
+    return True
+
+
 __all__ = [
     "HIST_MAGIC",
+    "apply_overlap_honesty",
     "leg_checkpoint_dir",
     "leg_perf_path",
     "merge_histograms",

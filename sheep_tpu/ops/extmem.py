@@ -411,6 +411,7 @@ def build_forest_extmem(path: str, block_edges: int | None = None,
     if perf is not None:
         wall = time.perf_counter() - t_start
         fold_s = sum(stats["fold_series"])
+        native = native_or_none("auto")
         perf.update({
             "ext_blocks": done,
             "block_edges": block,
@@ -423,6 +424,11 @@ def build_forest_extmem(path: str, block_edges: int | None = None,
             "wall_s": round(wall, 4),
             "strategies": dict(fold.strategies),
             "retries": attempt,
+            # fold worker threads (round 14): >1 means each block folded
+            # on parallel cores WHILE the prefetcher read ahead — the
+            # fetch/fold overlap the 1-core records could only cap
+            "threads": native.resolve_threads() if native is not None
+            else 1,
         })
     return seq, forest
 

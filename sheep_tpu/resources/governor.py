@@ -45,6 +45,7 @@ SCRATCH_DIR_ENV = "SHEEP_SCRATCH_DIR"
 EXT_BLOCK_ENV = "SHEEP_EXT_BLOCK"
 DISTEXT_LEGS_ENV = "SHEEP_DISTEXT_LEGS"
 LEG_CORES_ENV = "SHEEP_LEG_CORES"
+NATIVE_THREADS_ENV = "SHEEP_NATIVE_THREADS"
 
 #: free space a preflighted write must leave behind (the filesystem needs
 #: breathing room for directory blocks, the sidecar, and the journal; a
@@ -154,9 +155,21 @@ def chunk_tables_nbytes(n: int, levels: int) -> int:
     return 4 * (n + 1) * max(1, levels)
 
 
+def native_thread_tables_nbytes(n: int, threads: int) -> int:
+    """Priced resident bytes of the threaded native kernels' per-thread
+    partial tables (round 14): each EXTRA thread folds its slice into a
+    private union-find + parent pair over the full [n] position space —
+    8n bytes — and the transient pst/histogram partials ride inside the
+    estimate's deliberate coarseness (module docstring: over-pricing
+    degrades earlier, which is the safe direction).  T=1 prices zero:
+    the serial kernels' state is already in every rung's own term."""
+    return 8 * n * max(0, threads - 1)
+
+
 def rung_peak_nbytes(rung: str, n: int, links: int,
                      workers: int = 1, levels: int = 10,
-                     ext_block: int | None = None) -> int:
+                     ext_block: int | None = None,
+                     threads: int = 1) -> int:
     """Rough peak resident bytes of one degradation-ladder rung
     (runtime/driver.py) reducing ``links`` live links over ``n``
     positions.  Terms:
@@ -188,21 +201,28 @@ def rung_peak_nbytes(rung: str, n: int, links: int,
                    state is the union-find fold's O(n) arrays plus one
                    block of links (SPILL_BLOCK) and the carry (<= n
                    kid->parent pairs).
+
+    ``threads`` > 1 adds the threaded native kernels' per-thread partial
+    tables (round 14, :func:`native_thread_tables_nbytes`) to the rungs
+    that run through the native fold — host, stream, ext, spill — so a
+    budget that fits the serial build but not T partial tables vetoes
+    the thread count, not the rung.
     """
     pad = _pad_pow2(max(1, links))
+    tthreads = native_thread_tables_nbytes(n, threads)
     if rung in ("mesh", "single"):
         return (2 * 4 * pad * 2
                 + chunk_tables_nbytes(n, levels)
                 + 12 * (n + 1))
     if rung == "host":
-        return 16 * links + 8 * n + 8 * n
+        return 16 * links + 8 * n + 8 * n + tthreads
     if rung == "stream":
-        return 12 * n + 8 * min(links, SPILL_BLOCK) + 5 * links
+        return 12 * n + 8 * min(links, SPILL_BLOCK) + 5 * links + tthreads
     if rung == "ext":
         block = ext_block if ext_block is not None else ext_block_edges()
-        return 32 * n + EXT_RECORD_BYTES * block
+        return 32 * n + EXT_RECORD_BYTES * block + tthreads
     if rung == "spill":
-        return 8 * SPILL_BLOCK + 16 * n + 8 * n
+        return 8 * SPILL_BLOCK + 16 * n + 8 * n + tthreads
     raise ValueError(f"unknown rung {rung!r}")
 
 
@@ -321,16 +341,75 @@ def distext_leg_plan(n: int = 0, governor: "ResourceGovernor | None" = None
         return {"legs": forced, "per_leg_peak_bytes": per_leg,
                 "block_edges": block, "forced": True}
     leg_cores = int(os.environ.get(LEG_CORES_ENV, "0") or 0)
-    try:
-        host = len(os.sched_getaffinity(0))
-    except (AttributeError, OSError):
-        host = os.cpu_count() or 1
+    # quota-aware (round 14): a container limited to q cpu-seconds/second
+    # reports every host core in the affinity mask — sizing legs off that
+    # number just time-shares the quota (utils/envinfo.effective_cores)
+    from ..utils.envinfo import effective_cores
+    host = effective_cores()
     legs = max(2, host // max(1, leg_cores))
     budget = gov.mem_budget
     while legs > 2 and budget is not None and legs * per_leg > budget:
         legs -= 1
     return {"legs": legs, "per_leg_peak_bytes": per_leg,
             "block_edges": block, "forced": False}
+
+
+def native_thread_plan(n: int, governor: "ResourceGovernor | None" = None
+                       ) -> dict:
+    """Resolve the threaded native kernels' thread count (round 14) —
+    the value the driver exports as ``SHEEP_NATIVE_THREADS`` for the
+    kernels to read.
+
+    Resolution order:
+
+      pinned   an explicit ``SHEEP_NATIVE_THREADS`` is the operator's
+               word (A/B arms, the forced-T bench) — never second-
+               guessed, reported ``forced``.
+      cores    otherwise T starts at the host's EFFECTIVE core count
+               (affinity ∩ cgroup quota, utils/envinfo.effective_cores)
+               capped by the per-leg cores budget ``SHEEP_LEG_CORES``
+               when one is set — a distext leg or supervised worker
+               running beside siblings must not oversubscribe the cores
+               the supervisor granted it.
+      budget   the per-thread partial tables cost
+               :func:`native_thread_tables_nbytes` (8n per extra
+               thread); T shrinks until they fit the current memory
+               headroom — a budget can veto threading entirely.
+
+    Returns ``{"threads", "forced", "cores", "leg_cores",
+    "partial_bytes", "reason"}``; ``reason`` names the binding
+    constraint so the ``ladder.plan`` trace event can explain the
+    choice."""
+    forced = os.environ.get(NATIVE_THREADS_ENV, "")
+    if forced:
+        t = max(1, min(64, int(forced)))
+        return {"threads": t, "forced": True, "cores": None,
+                "leg_cores": None,
+                "partial_bytes": native_thread_tables_nbytes(n, t),
+                "reason": (f"pinned by {NATIVE_THREADS_ENV} (the library "
+                           f"still clamps to granted cores unless "
+                           f"SHEEP_NATIVE_OVERSUB=1)")}
+    from ..utils.envinfo import effective_cores
+    cores = effective_cores()
+    leg_cores = int(os.environ.get(LEG_CORES_ENV, "0") or 0)
+    t = min(cores, leg_cores) if leg_cores else cores
+    t = max(1, min(64, t))
+    reason = (f"leg cores budget ({LEG_CORES_ENV}={leg_cores})"
+              if leg_cores and leg_cores < cores
+              else f"host effective cores ({cores})")
+    gov = governor if governor is not None else ResourceGovernor.from_env()
+    head = gov.mem_headroom()
+    if head is not None:
+        vetoed = t
+        while t > 1 and native_thread_tables_nbytes(n, t) > head:
+            t -= 1
+        if t < vetoed:
+            reason = (f"memory budget vetoed {vetoed} -> {t} "
+                      f"(partial tables 8n/thread vs headroom)")
+    return {"threads": t, "forced": False, "cores": cores,
+            "leg_cores": leg_cores or None,
+            "partial_bytes": native_thread_tables_nbytes(n, t),
+            "reason": reason}
 
 
 @dataclass
@@ -404,13 +483,16 @@ class ResourceGovernor:
         return block
 
     def plan_rungs(self, rungs: list[str], n: int, links: int,
-                   workers: int = 1) -> tuple[list[str], list[tuple]]:
+                   workers: int = 1, threads: int = 1
+                   ) -> tuple[list[str], list[tuple]]:
         """Drop ladder rungs whose estimated peak cannot fit the memory
         headroom (the LAST rung always survives — something must run, and
         the spill floor is sized to fit any budget that fits n).  The ext
         rung prices at its FITTED block (ext_fitted_block): it can shrink
         its stream to the headroom, and skipping it for a default it
-        would never use would waste the fastest beyond-RAM path.  Returns
+        would never use would waste the fastest beyond-RAM path.
+        ``threads`` prices the threaded native kernels' per-thread
+        partial tables into the native-fold rungs (round 14).  Returns
         (kept_rungs, [(rung, estimate, "skip"|"keep"), ...])."""
         head = self.mem_headroom()
         if head is None or not rungs:
@@ -420,7 +502,8 @@ class ResourceGovernor:
             est = rung_peak_nbytes(
                 rung, n, links, workers,
                 ext_block=self.ext_fitted_block(n) if rung == "ext"
-                else None)
+                else None,
+                threads=threads)
             if est > head and i < len(rungs) - 1:
                 trace.append((rung, est, "skip"))
             else:

@@ -35,6 +35,7 @@ pst, and ECV(down) against the uninterrupted build.
 
 from __future__ import annotations
 
+import contextlib
 import os
 from dataclasses import dataclass, field
 
@@ -47,7 +48,8 @@ from ..integrity.errors import IntegrityError
 from ..integrity.sidecar import resolve_policy
 from ..obs import trace as obs
 from ..resources.errors import MemoryBudgetExceeded, ResourceError
-from ..resources.governor import ResourceGovernor, rss_bytes
+from ..resources.governor import (NATIVE_THREADS_ENV, ResourceGovernor,
+                                  native_thread_plan, rss_bytes)
 from .faults import (RetryBudgetExhausted, fault_point, is_retryable,
                      reset_counters)
 from .retry import RetryPolicy, run_with_retry
@@ -462,6 +464,28 @@ _RUNGS = {"mesh": _rung_mesh, "single": _rung_single, "host": _rung_host,
           "stream": _rung_stream, "ext": _rung_ext, "spill": _rung_spill}
 
 
+@contextlib.contextmanager
+def _native_threads_env(tplan: dict):
+    """Export the governor's resolved thread count as
+    ``SHEEP_NATIVE_THREADS`` for the duration of one rung attempt (the
+    kernels read the env per call), restoring the previous value on any
+    exit — one driver call must never re-pin the whole process.  A
+    pinned env (``forced``) is the operator's word and is left alone."""
+    if tplan["forced"] or (tplan["threads"] <= 1
+                           and NATIVE_THREADS_ENV not in os.environ):
+        yield
+        return
+    prev = os.environ.get(NATIVE_THREADS_ENV)
+    os.environ[NATIVE_THREADS_ENV] = str(tplan["threads"])
+    try:
+        yield
+    finally:
+        if prev is None:
+            os.environ.pop(NATIVE_THREADS_ENV, None)
+        else:
+            os.environ[NATIVE_THREADS_ENV] = prev
+
+
 def _ladder_rungs(config: RuntimeConfig, num_workers) -> list[str]:
     import jax
 
@@ -544,6 +568,16 @@ def build_graph_resilient(tail, head, num_vertices=None, num_workers=None,
             hi = hi64[tree].astype(np.int32)
         rounds = 0
 
+    # Threaded native kernels (round 14): the governor resolves the
+    # thread count from SHEEP_LEG_CORES / affinity / cgroup quota, the
+    # memory budget can veto it (8n of partial tables per extra thread),
+    # and the choice is exported as SHEEP_NATIVE_THREADS for the kernels
+    # to read — restored after the build so one driver call never
+    # re-pins a whole process.  An operator pin is never second-guessed.
+    tplan = native_thread_plan(n, gov)
+    events.append(("native-threads", tplan["threads"],
+                   "pinned" if tplan["forced"] else tplan["reason"]))
+
     # Memory-budget ladder planning (ISSUE 5): price each rung's peak
     # analytically and route around the ones that cannot fit the
     # headroom — degrading up-front beats OOM-ing mid-rung.  The last
@@ -552,7 +586,8 @@ def build_graph_resilient(tail, head, num_vertices=None, num_workers=None,
     price_of: dict[str, int] = {}
     if gov.active:
         rungs, trace = gov.plan_rungs(rungs, n, len(lo),
-                                      num_workers or 1)
+                                      num_workers or 1,
+                                      threads=tplan["threads"])
         for rung, est, verdict in trace:
             priced.append({"rung": rung, "est_bytes": int(est),
                            "verdict": verdict})
@@ -560,12 +595,14 @@ def build_graph_resilient(tail, head, num_vertices=None, num_workers=None,
             if verdict == "skip":
                 events.append(("mem-skip-rung", rung, est))
     # the rung-decision record `sheep trace` explains: the planned order,
-    # each rung's governor price + keep/skip verdict, and the measured
-    # headroom the verdicts were made against
+    # each rung's governor price + keep/skip verdict, the measured
+    # headroom the verdicts were made against, and the threaded-vs-serial
+    # pick with the constraint that bound it
     obs.event("ladder.plan", rungs=list(rungs), priced=priced,
               headroom_bytes=gov.mem_headroom() if gov.active else None,
               rss_bytes=rss_bytes() if gov.active else None,
-              budget_bytes=gov.mem_budget if gov.active else None)
+              budget_bytes=gov.mem_budget if gov.active else None,
+              native_threads=dict(tplan))
     if snap is not None:
         obs.event("rung.resume", rung=snap.rung, boundary=snap.boundary,
                   rounds=rounds)
@@ -582,7 +619,8 @@ def build_graph_resilient(tail, head, num_vertices=None, num_workers=None,
             # resumes without re-running the degree sort / link mapping
             rt.boundary(0, lambda: (lo, hi))
         try:
-            with obs.span("rung", rung=rung, links=len(lo)):
+            with obs.span("rung", rung=rung, links=len(lo)), \
+                    _native_threads_env(tplan):
                 parent = _RUNGS[rung](lo, hi, n, rt, num_workers)
             obs.event("rung.ok", rung=rung, rss_bytes=rss_bytes(),
                       est_bytes=price_of.get(rung))

@@ -6,18 +6,69 @@ records carried nothing that could attribute it (was the host loaded?
 pinned differently? a different backend?).  Every benchmark record now
 embeds this capture so driver-vs-clean divergences are attributable from
 the artifact alone: host load at measurement time, core count and the
-process's actual affinity mask (thread pins), cpu model, thread-count
-env pins, and the jax backend when one is already up.
+process's actual affinity mask (thread pins), the cgroup cpu quota (a
+container limited to 4 cpu-seconds/second reports every host core in
+nproc/affinity — round 14's threaded kernels size themselves off the
+EFFECTIVE count, and the record must show which number the host lied
+about), cpu model, thread-count env pins, the native runtime's OpenMP
+ceiling when it is already loaded, and the jax backend when one is up.
 
 Deliberately import-light: no jax import (a capture must never be the
-thing that initializes a backend), /proc reads are best-effort, and any
-failure degrades to omitting the field, never to raising.
+thing that initializes a backend), no native-library build (reported
+only when the module is already loaded), /proc and /sys reads are
+best-effort, and any failure degrades to omitting the field, never to
+raising.
 """
 
 from __future__ import annotations
 
+import math
 import os
 import sys
+
+
+def cpu_quota_cores(root: str = "/sys/fs/cgroup") -> float | None:
+    """The cgroup cpu quota as fractional cores, or None when unlimited
+    or undetectable.  Reads v2 ``cpu.max`` ("<quota> <period>" in µs,
+    "max" = unlimited) and falls back to v1 ``cpu/cpu.cfs_quota_us`` /
+    ``cpu.cfs_period_us`` (-1 = unlimited)."""
+    try:
+        with open(os.path.join(root, "cpu.max")) as f:
+            quota_s, period_s = (f.read().split() + ["100000"])[:2]
+        if quota_s != "max":
+            period = int(period_s)
+            if period > 0:
+                return int(quota_s) / period
+            return None
+        return None
+    except (OSError, ValueError):
+        pass
+    try:
+        with open(os.path.join(root, "cpu", "cpu.cfs_quota_us")) as f:
+            quota = int(f.read().strip())
+        if quota <= 0:  # -1 = unlimited
+            return None
+        with open(os.path.join(root, "cpu", "cpu.cfs_period_us")) as f:
+            period = int(f.read().strip())
+        return quota / period if period > 0 else None
+    except (OSError, ValueError):
+        return None
+
+
+def effective_cores(root: str = "/sys/fs/cgroup") -> int:
+    """Cores this process can actually burn concurrently: the minimum of
+    the affinity mask (else nproc) and the cgroup quota, floor 1 — the
+    governor's input for sizing thread counts and leg counts (a quota'd
+    container that reports 16 affinity cores must not spawn 16 threads
+    to time-share 4 cpu-seconds/second)."""
+    try:
+        cores = len(os.sched_getaffinity(0))
+    except (AttributeError, OSError):
+        cores = os.cpu_count() or 1
+    quota = cpu_quota_cores(root)
+    if quota is not None:
+        cores = min(cores, max(1, math.ceil(quota)))
+    return max(1, cores)
 
 
 def env_capture(platform: str | None = None) -> dict:
@@ -31,6 +82,10 @@ def env_capture(platform: str | None = None) -> dict:
         rec["affinity_cores"] = sorted(os.sched_getaffinity(0))
     except (AttributeError, OSError):
         pass
+    quota = cpu_quota_cores()
+    if quota is not None:
+        rec["cpu_quota_cores"] = round(quota, 2)
+    rec["effective_cores"] = effective_cores()
     try:
         with open("/proc/cpuinfo") as f:
             for line in f:
@@ -41,9 +96,19 @@ def env_capture(platform: str | None = None) -> dict:
         pass
     pins = {k: v for k, v in os.environ.items()
             if k in ("OMP_NUM_THREADS", "XLA_FLAGS", "TASKSET",
-                     "GOMP_CPU_AFFINITY", "JAX_PLATFORMS")}
+                     "GOMP_CPU_AFFINITY", "JAX_PLATFORMS",
+                     "SHEEP_NATIVE_THREADS", "SHEEP_LEG_CORES")}
     if pins:
         rec["thread_env"] = pins
+    # the native runtime's OpenMP view — only when something else
+    # already paid for loading it (this capture never triggers a build)
+    native = sys.modules.get("sheep_tpu.native")
+    if native is not None and getattr(native, "_lib", None) is not None:
+        try:
+            rec["omp_compiled"] = native.omp_compiled()
+            rec["omp_max_threads"] = native.omp_max_threads()
+        except Exception:
+            pass
     if platform is not None:
         rec["backend"] = platform
     elif "jax" in sys.modules:  # never initialize one just to report it

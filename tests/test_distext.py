@@ -548,3 +548,33 @@ def test_leg_perf_reports_land(tmp_path, dist_env):
         assert 0.0 <= rep["perf"]["overlap_frac"] <= 1.0
         assert "vmhwm" in rep["proc_status"]
         assert rep["range"][1] > rep["range"][0]
+
+
+def test_overlap_honesty_nulls_time_shared_legs():
+    """ISSUE 14 satellite: when the legs' affinity union holds fewer
+    cores than there are legs (they time-share), per-leg overlap_frac
+    becomes null with affinity_limited — a 0.0 there measures the host,
+    not the prefetcher.  Hosts with enough cores pass through."""
+    from sheep_tpu.ops.distext import apply_overlap_honesty
+    shared = {
+        "a": {"affinity_cores": [0], "overlap_frac": 0.0},
+        "b": {"affinity_cores": [0], "overlap_frac": 0.12},
+    }
+    assert apply_overlap_honesty(shared, legs=2)
+    for row in shared.values():
+        assert row["overlap_frac"] is None
+        assert row["affinity_limited"]
+    assert shared["b"]["overlap_frac_raw"] == 0.12
+
+    roomy = {
+        "a": {"affinity_cores": [0], "overlap_frac": 0.3},
+        "b": {"affinity_cores": [1], "overlap_frac": 0.4},
+    }
+    assert not apply_overlap_honesty(roomy, legs=2)
+    assert roomy["a"]["overlap_frac"] == 0.3
+    assert "affinity_limited" not in roomy["a"]
+
+    # unknown affinity (no proc capture): leave the numbers alone
+    unknown = {"a": {"overlap_frac": 0.0}}
+    assert not apply_overlap_honesty(unknown, legs=2)
+    assert unknown["a"]["overlap_frac"] == 0.0

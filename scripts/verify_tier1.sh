@@ -429,6 +429,82 @@ else
 fi
 # -------------------------------------------------------------------------
 
+# --- deterministic-plan smoke (the planner, ISSUE 15) --------------------
+# `sheep plan --explain` on a small .dat under a budget: the output must
+# name the chosen rung, and — with the measured-RSS input pinned
+# (--assume-rss 0) — the same inputs must print byte-identical plans
+# twice.  Seconds of work; a nondeterministic or broken planner fails
+# the gate before pytest even runs.
+PLAN_DIR=$(mktemp -d)
+if env JAX_PLATFORMS=cpu python - "$PLAN_DIR" <<'EOF'
+import sys
+from sheep_tpu.io.edges import write_dat
+from sheep_tpu.utils.synth import rmat_edges
+tail, head = rmat_edges(12, 1 << 14, seed=7)
+write_dat(sys.argv[1] + "/g.dat", tail, head)
+EOF
+then
+  if ! env JAX_PLATFORMS=cpu SHEEP_MEM_BUDGET=64M \
+      bin/plan --explain --assume-rss 0 "$PLAN_DIR/g.dat" \
+      > "$PLAN_DIR/plan1.txt"; then
+    echo "PLAN SMOKE FAILED: sheep plan --explain did not run" >&2
+    rm -rf "$PLAN_DIR"; exit 1
+  fi
+  if ! grep -q "chosen rung:" "$PLAN_DIR/plan1.txt"; then
+    echo "PLAN SMOKE FAILED: the plan did not name a chosen rung" >&2
+    cat "$PLAN_DIR/plan1.txt" >&2
+    rm -rf "$PLAN_DIR"; exit 1
+  fi
+  env JAX_PLATFORMS=cpu SHEEP_MEM_BUDGET=64M \
+      bin/plan --explain --assume-rss 0 "$PLAN_DIR/g.dat" \
+      > "$PLAN_DIR/plan2.txt"
+  if ! cmp -s "$PLAN_DIR/plan1.txt" "$PLAN_DIR/plan2.txt"; then
+    echo "PLAN SMOKE FAILED: the same inputs yielded two different" \
+         "plans" >&2
+    diff "$PLAN_DIR/plan1.txt" "$PLAN_DIR/plan2.txt" >&2
+    rm -rf "$PLAN_DIR"; exit 1
+  fi
+  rm -rf "$PLAN_DIR"
+else
+  echo "PLAN SMOKE FAILED: could not write the probe graph" >&2
+  rm -rf "$PLAN_DIR"; exit 1
+fi
+# -------------------------------------------------------------------------
+
+# --- hep-th ECV(down) regression gate (quality matrix, first slice) ------
+# Build the bundled hep-th graph, partition the degree-sequence tree for
+# every published part count, and assert ECV(down) <= the recorded
+# baseline (data/hepth-ecv-baseline.json — the reference's published
+# sweep): a quality regression anywhere in sequence/build/partition
+# fails the gate before pytest even runs; an improvement passes.
+if ! env JAX_PLATFORMS=cpu python - <<'EOF'
+import json
+from sheep_tpu.core import build_forest, degree_sequence
+from sheep_tpu.io import load_edges
+from sheep_tpu.partition.evaluate import evaluate_partition
+from sheep_tpu.partition.partition import Partition
+
+base = json.load(open("data/hepth-ecv-baseline.json"))["ecv_down"]
+e = load_edges("data/hep-th.dat")
+seq = degree_sequence(e.tail, e.head)
+forest = build_forest(e.tail, e.head, seq)
+for p_s, ceiling in sorted(base.items(), key=lambda kv: int(kv[0])):
+    p = int(p_s)
+    part = Partition.from_forest(seq, forest, p, max_vid=e.max_vid)
+    rep = evaluate_partition(part.parts, e.tail, e.head, seq, p,
+                             max_vid=e.max_vid, file_edges=e.num_edges)
+    assert rep.ecv_down <= ceiling, (
+        f"hep-th ECV(down) regressed at p={p}: {rep.ecv_down} > "
+        f"baseline {ceiling}")
+    print(f"hep-th p={p}: ECV(down) {rep.ecv_down} <= {ceiling}")
+EOF
+then
+  echo "HEP-TH ECV GATE FAILED: partition quality regressed past the" \
+       "recorded baseline" >&2
+  exit 1
+fi
+# -------------------------------------------------------------------------
+
 # --- flight-recorder smoke (observability, ISSUE 10) ---------------------
 # One traced build (SHEEP_TRACE on): the tree must stay oracle-exact, the
 # trace file must fsck clean (sealed sidecar + parseable JSONL), and

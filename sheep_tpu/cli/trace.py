@@ -111,7 +111,20 @@ def ladder_explanation(records: list[dict]) -> list[str]:
                 verdict = p.get("verdict", "?")
                 lines.append(
                     f"  {p.get('rung', '?'):<7} governor price "
-                    f"{_fmt_bytes(p.get('est_bytes')):>8} -> {verdict}")
+                    f"{_fmt_bytes(p.get('est_bytes')):>8}"
+                    + (f" (history x-> {_fmt_bytes(p['corrected_bytes'])}"
+                       f" via {p.get('prior', '?')})"
+                       if p.get("corrected_bytes") is not None else "")
+                    + f" -> {verdict}")
+            # planner decisions (ISSUE 15): surface the overridden /
+            # history-corrected knobs — the full story is `sheep plan`
+            for d in a.get("decisions", []):
+                if d.get("provenance") in ("forced", "learned"):
+                    lines.append(
+                        f"  knob {d.get('name')} = {d.get('value')} "
+                        f"[{d.get('provenance')}]"
+                        + (f" (analytic said {d['analytic']})"
+                           if d.get("analytic") is not None else ""))
         elif name == "rung.degrade":
             lines.append(f"degrade: {a.get('rung')} -> {a.get('next')} "
                          f"({a.get('why', '?')})")

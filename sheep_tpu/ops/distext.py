@@ -61,8 +61,7 @@ import numpy as np
 from ..integrity.errors import MalformedArtifact
 from ..integrity.sidecar import checksummed_write, resolve_policy, verify_bytes
 from ..resources.governor import (EXT_BLOCK_FLOOR, EXT_RECORD_BYTES,
-                                  ResourceGovernor, distext_forced_legs,
-                                  distext_leg_plan)
+                                  ResourceGovernor, distext_forced_legs)
 from .extmem import dat_num_records
 
 #: sealed per-range histogram artifact (one per pass-1 leg): the magic
@@ -270,13 +269,19 @@ def run_distext(graph: str, state_dir: str, config=None, runner=None,
         config.events.append(("resume", clean, dirty))
     else:
         records = dat_num_records(graph)
-        plan = distext_leg_plan(governor=gov) if not forced else None
+        # the leg count routes through the planner (ISSUE 15): same
+        # governor arithmetic, plus the provenance record — a forced
+        # count (arg or SHEEP_DISTEXT_LEGS) is the operator's word
+        from ..plan import plan_distext_legs
+        plan = plan_distext_legs(governor=gov) if not forced else None
         n_legs = forced or plan["legs"]
         shards = plan_shards(records, n_legs)
         manifest = plan_distext(graph, prefix, final, shards,
                                 config.reduction)
         obs.event("distext.plan", legs=n_legs, records=records,
                   forced=bool(forced),
+                  provenance=("forced" if forced
+                              else plan["provenance"]),
                   block_edges=plan["block_edges"] if plan else None,
                   per_leg_peak_bytes=(plan["per_leg_peak_bytes"]
                                       if plan else None))

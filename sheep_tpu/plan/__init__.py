@@ -1,0 +1,39 @@
+"""The history-learning cost-model executor (ISSUE 15).
+
+``plan_build`` resolves one build's execution plan — rung order, native
+thread count, ext/spill block size, handoff windows, distext legs —
+folding the governor's analytic prices (resources/governor.py) with
+measured priors learned from past traces and bench records
+(plan/priors.py).  Every ``SHEEP_*`` knob is an *override* recorded in
+the plan with its provenance (default | priced | learned | forced);
+``sheep plan --explain`` (cli/plan.py) renders the whole story.
+
+Jax-free on purpose: the planner must be importable from the CLI, the
+supervisor parent, and the serve daemon without initializing a backend.
+"""
+
+from .model import (DEFAULT_LADDER, PROV_DEFAULT, PROV_FORCED,
+                    PROV_LEARNED, PROV_PRICED, Decision, Plan,
+                    available_rungs, plan_build, plan_distext_legs)
+from .priors import (MIN_CORRECT_SAMPLES, PRIORS_ENV, PriorStore,
+                     host_fingerprint, mem_ratio, prior_key, scale_bucket)
+
+__all__ = [
+    "DEFAULT_LADDER",
+    "Decision",
+    "MIN_CORRECT_SAMPLES",
+    "PRIORS_ENV",
+    "PROV_DEFAULT",
+    "PROV_FORCED",
+    "PROV_LEARNED",
+    "PROV_PRICED",
+    "Plan",
+    "PriorStore",
+    "available_rungs",
+    "host_fingerprint",
+    "mem_ratio",
+    "plan_build",
+    "plan_distext_legs",
+    "prior_key",
+    "scale_bucket",
+]

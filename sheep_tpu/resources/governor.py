@@ -321,8 +321,11 @@ def distext_forced_legs() -> int:
 
 def distext_leg_plan(n: int = 0, governor: "ResourceGovernor | None" = None
                      ) -> dict:
-    """The distext planner (ISSUE 13): how many supervised ext legs to
-    shard a ``.dat`` across, and what one leg's priced peak is.
+    """The distext planner's ARITHMETIC (ISSUE 13): how many supervised
+    ext legs to shard a ``.dat`` across, and what one leg's priced peak
+    is.  Callers route through ``sheep_tpu.plan.plan_distext_legs``
+    (ISSUE 15), which adds the provenance record; this function stays
+    the single source of the numbers.
 
     ``SHEEP_DISTEXT_LEGS`` pins N (the operator's word).  Otherwise N
     starts at the host's concurrency budget — ``host_cores //
@@ -358,7 +361,9 @@ def native_thread_plan(n: int, governor: "ResourceGovernor | None" = None
                        ) -> dict:
     """Resolve the threaded native kernels' thread count (round 14) —
     the value the driver exports as ``SHEEP_NATIVE_THREADS`` for the
-    kernels to read.
+    kernels to read.  The driver reaches this through
+    ``sheep_tpu.plan.plan_build`` (ISSUE 15), which records the choice
+    as a provenance-carrying Decision; the resolution rules live here.
 
     Resolution order:
 
@@ -485,7 +490,12 @@ class ResourceGovernor:
     def plan_rungs(self, rungs: list[str], n: int, links: int,
                    workers: int = 1, threads: int = 1
                    ) -> tuple[list[str], list[tuple]]:
-        """Drop ladder rungs whose estimated peak cannot fit the memory
+        """[The driver now plans through ``sheep_tpu.plan.plan_build``
+        (ISSUE 15), which runs this same arithmetic plus measured-prior
+        corrections; this method remains the analytic reference the
+        planner is parity-tested against.]
+
+        Drop ladder rungs whose estimated peak cannot fit the memory
         headroom (the LAST rung always survives — something must run, and
         the spill floor is sized to fit any budget that fits n).  The ext
         rung prices at its FITTED block (ext_fitted_block): it can shrink

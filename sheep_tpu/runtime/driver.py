@@ -47,9 +47,11 @@ from ..core.sequence import degree_sequence
 from ..integrity.errors import IntegrityError
 from ..integrity.sidecar import resolve_policy
 from ..obs import trace as obs
+from ..plan.model import DEFAULT_LADDER, PROV_LEARNED, available_rungs, \
+    plan_build
 from ..resources.errors import MemoryBudgetExceeded, ResourceError
 from ..resources.governor import (NATIVE_THREADS_ENV, ResourceGovernor,
-                                  native_thread_plan, rss_bytes)
+                                  rss_bytes)
 from .faults import (RetryBudgetExhausted, fault_point, is_retryable,
                      reset_counters)
 from .retry import RetryPolicy, run_with_retry
@@ -155,7 +157,8 @@ class ChunkRuntime:
                  pst: np.ndarray, input_sig: str, rounds_base: int = 0,
                  promote_after: int = 0,
                  governor: ResourceGovernor | None = None,
-                 edges_path: str | None = None):
+                 edges_path: str | None = None,
+                 ext_block: int | None = None):
         self.policy = policy
         self.ckpt = checkpointer
         self.events = events
@@ -164,6 +167,10 @@ class ChunkRuntime:
         #: the whole-input .dat file, when one exists (the ext rung's
         #: source; RuntimeConfig.edges_path)
         self.edges_path = edges_path
+        #: a planner-resolved ext block size (ISSUE 15) — set only when a
+        #: measured prior CORRECTED the analytic fit; None lets the ext
+        #: build run the governor's own arithmetic exactly as before
+        self.ext_block = ext_block
         self._last_levels_cap: int | None = None
         self.rung = rung
         self.n = n
@@ -383,6 +390,7 @@ def _rung_ext(lo, hi, n, rt, num_workers):
     gov = rt.governor
     _, forest = build_forest_extmem(
         rt.edges_path, seq=rt.seq,
+        block_edges=rt.ext_block,
         governor=gov if gov is not None else None,
         events=rt.events)
     return forest.parent
@@ -487,17 +495,13 @@ def _native_threads_env(tplan: dict):
 
 
 def _ladder_rungs(config: RuntimeConfig, num_workers) -> list[str]:
+    # availability routes through the planner (ISSUE 15): one filter for
+    # the driver, the plan CLI, and anything else that must answer
+    # "which rungs could even run here"
     import jax
 
-    rungs = [r for r in config.ladder if r in _RUNGS]
-    devs = len(jax.devices())
-    if devs < 2 or (num_workers is not None and num_workers < 2):
-        rungs = [r for r in rungs if r != "mesh"]
-    if not (config.edges_path and config.edges_path.endswith(".dat")
-            and os.path.exists(config.edges_path)):
-        # ext re-streams the original file; without one it has no input
-        rungs = [r for r in rungs if r != "ext"]
-    return rungs or ["host"]
+    return available_rungs(config.ladder, len(jax.devices()), num_workers,
+                           config.edges_path, known=_RUNGS)
 
 
 def build_graph_resilient(tail, head, num_vertices=None, num_workers=None,
@@ -568,41 +572,62 @@ def build_graph_resilient(tail, head, num_vertices=None, num_workers=None,
             hi = hi64[tree].astype(np.int32)
         rounds = 0
 
-    # Threaded native kernels (round 14): the governor resolves the
-    # thread count from SHEEP_LEG_CORES / affinity / cgroup quota, the
-    # memory budget can veto it (8n of partial tables per extra thread),
-    # and the choice is exported as SHEEP_NATIVE_THREADS for the kernels
-    # to read — restored after the build so one driver call never
-    # re-pins a whole process.  An operator pin is never second-guessed.
-    tplan = native_thread_plan(n, gov)
+    # One planner to rule the rungs (ISSUE 15): rung feasibility, the
+    # native thread count (SHEEP_LEG_CORES / affinity / cgroup quota,
+    # budget-vetoable at 8n partial tables per extra thread — round 14),
+    # and the ext block all resolve through plan_build, which folds the
+    # governor's analytic prices with any measured priors
+    # (SHEEP_PLAN_PRIORS).  With no prior store the plan reproduces the
+    # pre-planner choices exactly; every knob is an override recorded
+    # with its provenance (default | priced | learned | forced).  The
+    # resolved thread count is exported as SHEEP_NATIVE_THREADS for the
+    # kernels to read — restored after the build so one driver call
+    # never re-pins a whole process; an operator pin is never
+    # second-guessed.
+    plan = plan_build(n, len(lo), rungs=rungs, governor=gov,
+                      num_workers=num_workers,
+                      ladder_forced=tuple(config.ladder) != DEFAULT_LADDER,
+                      edges_path=config.edges_path)
+    tplan = plan.native_threads
     events.append(("native-threads", tplan["threads"],
                    "pinned" if tplan["forced"] else tplan["reason"]))
+    ext_block = plan.decision("ext_block")
+    ext_block_planned = ext_block.value \
+        if ext_block.provenance == PROV_LEARNED else None
 
-    # Memory-budget ladder planning (ISSUE 5): price each rung's peak
-    # analytically and route around the ones that cannot fit the
-    # headroom — degrading up-front beats OOM-ing mid-rung.  The last
-    # rung (spill: O(n + block) resident) always survives.
+    # Memory-budget ladder planning (ISSUE 5, via the planner): rungs
+    # whose (prior-corrected) priced peak cannot fit the headroom are
+    # routed around — degrading up-front beats OOM-ing mid-rung.  The
+    # last rung (spill: O(n + block) resident) always survives.
     priced: list[dict] = []
     price_of: dict[str, int] = {}
     if gov.active:
-        rungs, trace = gov.plan_rungs(rungs, n, len(lo),
-                                      num_workers or 1,
-                                      threads=tplan["threads"])
-        for rung, est, verdict in trace:
-            priced.append({"rung": rung, "est_bytes": int(est),
-                           "verdict": verdict})
-            price_of[rung] = int(est)
-            if verdict == "skip":
-                events.append(("mem-skip-rung", rung, est))
-    # the rung-decision record `sheep trace` explains: the planned order,
-    # each rung's governor price + keep/skip verdict, the measured
-    # headroom the verdicts were made against, and the threaded-vs-serial
-    # pick with the constraint that bound it
+        rungs = plan.rungs
+        for cand in plan.candidates:
+            entry = {"rung": cand["rung"],
+                     "est_bytes": cand["est_bytes"],
+                     "verdict": cand["verdict"]}
+            if "corrected_bytes" in cand:
+                entry["corrected_bytes"] = cand["corrected_bytes"]
+                entry["prior"] = cand["prior"]["key"]
+            priced.append(entry)
+            price_of[cand["rung"]] = cand["est_bytes"]
+            if cand["verdict"] == "skip":
+                events.append(("mem-skip-rung", cand["rung"],
+                               cand.get("corrected_bytes",
+                                        cand["est_bytes"])))
+    # the rung-decision record `sheep trace`/`sheep plan` explain: the
+    # planned order, each rung's governor price (+ any prior correction)
+    # and keep/skip verdict, the measured headroom the verdicts were
+    # made against, the threaded-vs-serial pick with the constraint that
+    # bound it, and every knob decision with its provenance — the
+    # harvestable event the prior store learns from (n/links included)
     obs.event("ladder.plan", rungs=list(rungs), priced=priced,
-              headroom_bytes=gov.mem_headroom() if gov.active else None,
+              headroom_bytes=plan.headroom_bytes if gov.active else None,
               rss_bytes=rss_bytes() if gov.active else None,
               budget_bytes=gov.mem_budget if gov.active else None,
-              native_threads=dict(tplan))
+              native_threads=dict(tplan), n=n, links=len(lo),
+              decisions=plan.decisions_dict())
     if snap is not None:
         obs.event("rung.resume", rung=snap.rung, boundary=snap.boundary,
                   rounds=rounds)
@@ -613,7 +638,8 @@ def build_graph_resilient(tail, head, num_vertices=None, num_workers=None,
                           rounds_base=rounds,
                           promote_after=config.promote_after,
                           governor=gov if gov.active else None,
-                          edges_path=config.edges_path)
+                          edges_path=config.edges_path,
+                          ext_block=ext_block_planned)
         if snap is None and i == 0:
             # boundary 0 = "prep complete": a kill during the first chunk
             # resumes without re-running the degree sort / link mapping
@@ -623,7 +649,7 @@ def build_graph_resilient(tail, head, num_vertices=None, num_workers=None,
                     _native_threads_env(tplan):
                 parent = _RUNGS[rung](lo, hi, n, rt, num_workers)
             obs.event("rung.ok", rung=rung, rss_bytes=rss_bytes(),
-                      est_bytes=price_of.get(rung))
+                      est_bytes=price_of.get(rung), n=n)
             break
         except Exception as exc:
             # Memory exhaustion degrades DOWN the ladder (the cheaper

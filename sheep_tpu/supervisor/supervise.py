@@ -81,6 +81,16 @@ class SupervisorConfig:
     reduction: int = 2
     #: a worker whose heartbeat is older than this is dead
     deadline_s: float = 30.0
+    #: deterministic staleness (ISSUE 15 deflake): when > 0, a silent
+    #: worker is declared dead after this many CONSECUTIVE supervisor
+    #: polls observed its heartbeat mtime unchanged, instead of by the
+    #: wall-clock deadline.  Wall-clock staleness races the scheduler: a
+    #: loaded 1-core host can stall a healthy worker's beat past a short
+    #: deadline and double-dispatch it (the distext chaos sweep's
+    #: 1-in-3 flake).  Poll counting is robust to exactly that — when
+    #: the whole process stalls, the supervisor's polls stall with the
+    #: beats, so no poll observes a silent interval that never happened.
+    stale_after_polls: int = 0
     #: how often workers beat (exported to subprocess workers)
     heartbeat_s: float = 1.0
     #: age at which a still-beating attempt gets a speculative twin
@@ -123,6 +133,7 @@ class SupervisorConfig:
             workers=int(env.get("SHEEP_WORKERS", "2") or 2),
             reduction=int(env.get("REDUCTION", "2") or 2),
             deadline_s=float(env.get("SHEEP_DEADLINE_S", "30")),
+            stale_after_polls=int(env.get("SHEEP_STALE_POLLS", "0") or 0),
             heartbeat_s=float(env.get("SHEEP_HEARTBEAT_S", "1")),
             max_retries=int(env.get("SHEEP_MAX_RETRIES", "3")),
             backoff_base_s=float(env.get("SHEEP_BACKOFF_BASE", "0.05")),
@@ -331,6 +342,11 @@ class _Attempt:
     started: float
     corrupt_on_success: bool = False
     cancelled: bool = False
+    # poll-count staleness state (SupervisorConfig.stale_after_polls):
+    # the last observed beat mtime and how many consecutive polls saw it
+    # unchanged
+    hb_mtime: float | None = None
+    quiet_polls: int = 0
 
 
 # ---------------------------------------------------------------------------
@@ -645,16 +661,22 @@ class TournamentSupervisor:
 
     def _live_temp_bases(self) -> set[str]:
         """Final basenames of every still-running attempt's output (and
-        its sidecar): their atomic-write dot-temps are live rename
-        sources a mid-run sweep must not reclaim (resources/gc.py
-        is_live_temp — the InlineRunner runs sibling legs in THIS
-        process, so a sweep after one leg's fault races their writes)."""
+        its sidecar), plus its side-channel files (the distext leg's
+        ``--perf-out`` self-report lands in the state dir root too):
+        their atomic-write dot-temps are live rename sources a mid-run
+        sweep must not reclaim (resources/gc.py is_live_temp — the
+        InlineRunner runs sibling legs in THIS process, so a sweep after
+        one leg's fault races their writes; a reclaimed perf temp failed
+        the healthy sibling's os.replace and double-dispatched it —
+        the distext chaos sweep's 1-in-3 flake, ISSUE 15)."""
         out: set[str] = set()
         for atts in self._running.values():
             for a in atts:
                 base = os.path.basename(a.tmp)
                 out.add(base)
                 out.add(base + ".sum")
+                # the leg's perf self-report (ops/distext.leg_perf_path)
+                out.add(f"{a.leg.key}.perf.json")
         return out
 
     def _failed(self, att: _Attempt, reason: str) -> None:
@@ -692,8 +714,7 @@ class TournamentSupervisor:
                     continue
                 rc = att.handle.poll()
                 if rc is None:
-                    if is_stale(att.hb, att.started,
-                                self.config.deadline_s, now):
+                    if self._attempt_stale(att, now):
                         att.cancelled = True
                         att.handle.cancel()
                         self.events.append(("stale", key, att.number))
@@ -718,6 +739,26 @@ class TournamentSupervisor:
                         and self.config.chaos.take_stop(att.leg.round,
                                                         att.leg.index):
                     self._die(att.leg)
+
+    def _attempt_stale(self, att: _Attempt, now: float) -> bool:
+        """Is this still-running attempt dead-by-silence?  Default: the
+        wall-clock heartbeat deadline (is_stale).  With
+        ``stale_after_polls`` set, staleness is counted in SUPERVISOR
+        POLLS that observed the beat mtime unchanged — deterministic
+        under whole-process stalls (config field doc), which is what the
+        chaos sweeps need to assert exact dispatch counts."""
+        polls = self.config.stale_after_polls
+        if not polls:
+            return is_stale(att.hb, att.started,
+                            self.config.deadline_s, now)
+        from .heartbeat import last_beat_s
+        m = last_beat_s(att.hb, att.started)
+        if att.hb_mtime is not None and m <= att.hb_mtime:
+            att.quiet_polls += 1
+        else:
+            att.hb_mtime = m
+            att.quiet_polls = 0
+        return att.quiet_polls >= polls
 
     def _die(self, leg: Leg) -> None:
         """Chaos "stop": this supervisor is dead.  Real death would orphan

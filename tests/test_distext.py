@@ -337,9 +337,13 @@ def test_chaos_at_every_round_bit_identical(tmp_path, dist_env):
              for k in ("kill", "corrupt", "hang")]
     for kind, rnd, leg in cases:
         name = f"{kind}{rnd}x{leg}"
+        # hang detection by POLL COUNT, not wall clock (the deflake): a
+        # short wall deadline raced the scheduler on a loaded 1-core
+        # host — a healthy leg's beat could stall past 0.4s and
+        # double-dispatch, breaking the exact-count assertions below
         hurt, m = _run(path, tmp_path / name, legs=2,
                        chaos=parse_fault_plan(f"{kind}@{rnd}:{leg}"),
-                       deadline_s=0.4 if kind == "hang" else 30.0)
+                       stale_after_polls=25 if kind == "hang" else 0)
         assert hurt == base, (kind, rnd, leg)
         counts = {l.key: l.dispatches for l in m.legs}
         want_key = keys[(rnd, leg)]
@@ -548,6 +552,31 @@ def test_leg_perf_reports_land(tmp_path, dist_env):
         assert 0.0 <= rep["perf"]["overlap_frac"] <= 1.0
         assert "vmhwm" in rep["proc_status"]
         assert rep["range"][1] > rep["range"][0]
+
+
+def test_live_temp_bases_protect_perf_reports(tmp_path):
+    """The chaos-sweep deflake's root cause (ISSUE 15): a sibling leg's
+    failure sweep reclaimed a RUNNING distmap leg's in-flight
+    ``--perf-out`` atomic temp (only output temps were in the live set),
+    failing its os.replace and double-dispatching a healthy leg ~1-in-3.
+    The live set must cover the perf self-report too."""
+    from sheep_tpu.resources.gc import is_live_temp
+    from sheep_tpu.supervisor.manifest import Leg
+    from sheep_tpu.supervisor.supervise import (TournamentSupervisor,
+                                                _Attempt)
+    leg = Leg(key="r0.00", kind="distmap", round=0, index=0, inputs=[],
+              output=str(tmp_path / "g.r0.00.tre"))
+    att = _Attempt(leg=leg, number=1, tmp=leg.output + ".a1",
+                   hb=leg.output + ".a1.hb", handle=None, started=0.0)
+    sup = TournamentSupervisor.__new__(TournamentSupervisor)
+    sup._running = {"r0.00": [att]}
+    bases = sup._live_temp_bases()
+    assert "r0.00.perf.json" in bases
+    assert "g.r0.00.tre.a1" in bases
+    # the atomic-write dot-temps of both are live rename sources
+    assert is_live_temp(".r0.00.perf.json.xyz123.tmp", bases)
+    assert is_live_temp(".g.r0.00.tre.a1.abc.tmp", bases)
+    assert not is_live_temp(".r0.01.perf.json.xyz.tmp", bases)
 
 
 def test_overlap_honesty_nulls_time_shared_legs():

@@ -1,0 +1,112 @@
+"""The SHEEP_* knob registry (ISSUE 15 satellite): one authoritative
+declaration per knob, enforced by grep — a knob cannot be added to the
+code or retired from it without the registry (and the generated README
+table) following."""
+
+import os
+import re
+
+import sheep_tpu
+from sheep_tpu.utils import knobs
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(sheep_tpu.__file__)))
+PKG = os.path.join(REPO, "sheep_tpu")
+
+_QUOTED = re.compile(r'["\'](SHEEP_[A-Z0-9_]+)["\']')
+_BARE = re.compile(r"SHEEP_[A-Z0-9_]+")
+
+
+def _iter_files(root, suffixes):
+    for dirpath, dirnames, names in os.walk(root):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for name in names:
+            if name.endswith(suffixes):
+                yield os.path.join(dirpath, name)
+
+
+def _package_reads():
+    """Every quoted SHEEP_* literal in the package's Python plus the
+    native kernels' getenv names — the set the registry must cover."""
+    found = set()
+    for path in _iter_files(PKG, (".py", ".cpp", ".h")):
+        with open(path, encoding="utf-8", errors="replace") as f:
+            for m in _QUOTED.finditer(f.read()):
+                found.add(m.group(1))
+    return found
+
+
+def _repo_mentions():
+    """Everywhere a knob name can legitimately live: the package,
+    the bench/ops scripts, the shell drivers, and the bin shims."""
+    found = set()
+    roots = [(PKG, (".py", ".cpp", ".h")),
+             (os.path.join(REPO, "scripts"), (".py", ".sh"))]
+    for root, suffixes in roots:
+        for path in _iter_files(root, suffixes):
+            with open(path, encoding="utf-8", errors="replace") as f:
+                found.update(_BARE.findall(f.read()))
+    for extra in ("bench.py",):
+        p = os.path.join(REPO, extra)
+        if os.path.exists(p):
+            with open(p, encoding="utf-8", errors="replace") as f:
+                found.update(_BARE.findall(f.read()))
+    return found
+
+
+def test_every_package_env_read_is_registered():
+    """The enforcement grep: any SHEEP_ env read in the package missing
+    from the registry fails here with the exact names to add."""
+    missing = knobs.missing_from_registry(_package_reads())
+    assert not missing, (
+        f"SHEEP_* knobs read in the package but missing from "
+        f"sheep_tpu/utils/knobs.py: {missing}")
+
+
+def test_every_registered_knob_is_read_somewhere():
+    """The reverse direction: a registry entry nothing reads is a
+    retired knob that must be deleted, not documented forever."""
+    mentions = _repo_mentions()
+    stale = sorted(set(knobs.KNOBS) - mentions)
+    assert not stale, (
+        f"registry entries no code mentions (retire them): {stale}")
+
+
+def test_registry_entries_are_complete():
+    for k in knobs.KNOBS.values():
+        assert k.name.startswith("SHEEP_")
+        assert k.type in ("flag", "int", "float", "str", "size", "path",
+                          "plan", "list"), k
+        assert k.subsystem and k.doc, k
+
+
+def test_markdown_table_lists_every_knob():
+    table = knobs.markdown_table()
+    assert table.startswith(knobs.MARK_BEGIN)
+    for name in knobs.KNOBS:
+        assert f"`{name}`" in table, name
+
+
+def test_readme_table_in_sync():
+    """The checked-in README 'Configuration knobs' table is exactly the
+    generated one — regenerate with
+    ``python -m sheep_tpu.utils.knobs --markdown`` when this fails."""
+    with open(os.path.join(REPO, "README.md"), encoding="utf-8") as f:
+        text = f.read()
+    assert knobs.MARK_BEGIN in text, \
+        "README.md lost the KNOBS:BEGIN marker"
+    assert knobs.readme_in_sync(text), (
+        "README knob table is stale: regenerate with "
+        "`python -m sheep_tpu.utils.knobs --markdown` and paste between "
+        "the KNOBS markers")
+
+
+def test_cli_markdown_and_check(capsys, tmp_path):
+    assert knobs.main(["--markdown"]) == 0
+    out = capsys.readouterr().out
+    assert knobs.MARK_END in out
+    good = tmp_path / "README.md"
+    good.write_text("# x\n\n" + out + "\ntail\n")
+    assert knobs.main(["--check", str(good)]) == 0
+    bad = tmp_path / "BAD.md"
+    bad.write_text("# x\nno table\n")
+    assert knobs.main(["--check", str(bad)]) == 1

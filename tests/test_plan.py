@@ -1,0 +1,443 @@
+"""The history-learning planner (ISSUE 15): the Plan object, the prior
+store, and the provenance contract.
+
+Covered here: parity (with no prior store, plan_build reproduces the
+governor's pre-planner choices for every budget shape), forced knobs
+winning with ``forced`` provenance across the knob surface, the
+demonstrated history-corrected decision (a mispriced rung/ext block
+fixed by a synthetic prior store, asserted end-to-end through the
+driver), prior-store roundtrip + corruption tolerance, harvesting
+through ROTATED trace segment chains with a torn newest segment (the
+kill -9 shape) and a rotten mid-chain segment, the ``sheep plan``
+CLI's determinism and harvest mode, and the enriched ``ladder.plan``
+event the store learns from."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import sheep_tpu.resources.governor as G
+from sheep_tpu.plan import (MIN_CORRECT_SAMPLES, PriorStore,
+                            available_rungs, plan_build,
+                            plan_distext_legs, prior_key, scale_bucket)
+
+pytestmark = pytest.mark.filterwarnings("ignore::UserWarning")
+
+
+@pytest.fixture
+def plan_env(monkeypatch):
+    for k in ("SHEEP_MEM_BUDGET", "SHEEP_DISK_BUDGET", "SHEEP_EXT_BLOCK",
+              "SHEEP_NATIVE_THREADS", "SHEEP_LEG_CORES",
+              "SHEEP_DISTEXT_LEGS", "SHEEP_HANDOFF_WINDOWS",
+              "SHEEP_PIPELINE_CHUNKS", "SHEEP_PLATEAU_ADAPT",
+              "SHEEP_PLAN_PRIORS", "SHEEP_TRACE", "SHEEP_TRACE_MAX_MB"):
+        monkeypatch.delenv(k, raising=False)
+    yield monkeypatch
+
+
+N, LINKS = 1 << 16, 1 << 18
+
+
+# ---------------------------------------------------------------------------
+# parity: no priors => the pre-planner choices, bit for bit
+# ---------------------------------------------------------------------------
+
+
+def test_unbudgeted_plan_keeps_everything(plan_env):
+    ladder = ("single", "host", "stream", "spill")
+    p = plan_build(N, LINKS, ladder=ladder)
+    assert p.rungs == list(ladder)
+    assert p.chosen == "single"
+    assert all(c["verdict"] == "keep" for c in p.candidates)
+    assert p.decision("rungs").provenance == "default"
+    assert p.budget_bytes is None
+
+
+def test_budgeted_plan_matches_governor_for_every_budget(plan_env):
+    """The parity sweep: for budgets that keep all / some / only the
+    floor, plan_build's kept rungs equal gov.plan_rungs' — the planner
+    calls the same arithmetic, it does not fork it."""
+    ladder = ["host", "stream", "spill"]
+    rss = G.rss_bytes()
+    budgets = [rss + G.rung_peak_nbytes("host", N, LINKS) * 2,
+               rss + (G.rung_peak_nbytes("host", N, LINKS)
+                      + G.rung_peak_nbytes("stream", N, LINKS)) // 2,
+               rss + G.rung_peak_nbytes("spill", N, LINKS) + 1,
+               rss + 1]
+    for budget in budgets:
+        gov = G.ResourceGovernor(mem_budget=budget)
+        p = plan_build(N, LINKS, ladder=tuple(ladder), governor=gov)
+        kept, _ = gov.plan_rungs(list(ladder), N, LINKS)
+        assert p.rungs == kept, budget
+    # provenance: a priced skip is "priced", never "learned"
+    gov = G.ResourceGovernor(mem_budget=budgets[1])
+    p = plan_build(N, LINKS, ladder=tuple(ladder), governor=gov)
+    if len(p.rungs) < len(ladder):
+        assert p.decision("rungs").provenance == "priced"
+
+
+def test_available_rungs_filter(plan_env, tmp_path):
+    full = ("mesh", "single", "host", "stream", "ext", "spill")
+    # no devices info: mesh survives; no .dat: ext dropped
+    assert available_rungs(full) == ["mesh", "single", "host", "stream",
+                                     "spill"]
+    assert available_rungs(full, devices=1) == ["single", "host",
+                                                "stream", "spill"]
+    assert available_rungs(full, num_workers=1)[0] == "single"
+    dat = tmp_path / "g.dat"
+    dat.write_bytes(b"\x00" * 24)
+    assert "ext" in available_rungs(full, edges_path=str(dat))
+    assert "ext" not in available_rungs(full,
+                                        edges_path=str(tmp_path / "no.dat"))
+    assert available_rungs(("nope",)) == ["host"]
+
+
+# ---------------------------------------------------------------------------
+# forced knobs win, provenance says forced (the A/B-arm contract)
+# ---------------------------------------------------------------------------
+
+
+def test_forced_knobs_win_with_forced_provenance(plan_env):
+    plan_env.setenv("SHEEP_NATIVE_THREADS", "4")
+    plan_env.setenv("SHEEP_EXT_BLOCK", "300")
+    plan_env.setenv("SHEEP_HANDOFF_WINDOWS", "8")
+    plan_env.setenv("SHEEP_DISTEXT_LEGS", "3")
+    plan_env.setenv("SHEEP_PIPELINE_CHUNKS", "0")
+    p = plan_build(N, LINKS, ladder=("host", "spill"), with_distext=True)
+    d = {name: dec for name, dec in p.decisions.items()}
+    assert d["native_threads"].value == 4
+    assert d["native_threads"].provenance == "forced"
+    assert d["ext_block"].value == 300
+    assert d["ext_block"].provenance == "forced"
+    assert d["handoff_windows"].value == 8
+    assert d["handoff_windows"].provenance == "forced"
+    assert d["distext_legs"].value == 3
+    assert d["distext_legs"].provenance == "forced"
+    assert d["pipeline_chunks"].value is False
+    assert d["pipeline_chunks"].provenance == "forced"
+    # a forced ext block is never second-guessed even by a prior that
+    # screams (the resume-identity rule)
+    st = PriorStore()
+    for _ in range(4):
+        st.observe("mem_ratio", "ext", N, 8.0)
+    gov = G.ResourceGovernor(mem_budget=G.rss_bytes() + (64 << 20))
+    p2 = plan_build(N, LINKS, ladder=("ext", "spill"), governor=gov,
+                    priors=st, edges_path=None)
+    assert p2.decision("ext_block").value == 300
+    assert p2.decision("ext_block").provenance == "forced"
+
+
+def test_forced_ladder_provenance(plan_env):
+    p = plan_build(N, LINKS, ladder=("host",), ladder_forced=True)
+    assert p.decision("rungs").provenance == "forced"
+
+
+def test_distext_leg_plan_provenance(plan_env):
+    out = plan_distext_legs(governor=G.ResourceGovernor())
+    assert out["provenance"] == "default" and out["legs"] >= 2
+    plan_env.setenv("SHEEP_DISTEXT_LEGS", "5")
+    out = plan_distext_legs(governor=G.ResourceGovernor())
+    assert out["legs"] == 5 and out["provenance"] == "forced"
+
+
+# ---------------------------------------------------------------------------
+# the history-corrected decision (the acceptance demonstration)
+# ---------------------------------------------------------------------------
+
+
+def test_prior_flips_a_keep_verdict(plan_env):
+    """A rung the analytic model keeps is skipped once measured history
+    says its real cost runs 4x the price — provenance ``learned``, and
+    the explain text names the prior that did it."""
+    st = PriorStore()
+    st.observe("mem_ratio", "stream", N, 4.0)
+    st.observe("mem_ratio", "stream", N, 4.0)
+    gov = G.ResourceGovernor(
+        mem_budget=G.rss_bytes() + G.rung_peak_nbytes("stream", N, LINKS) * 2)
+    base = plan_build(N, LINKS, ladder=("stream", "spill"), governor=gov)
+    assert base.chosen == "stream"  # analytic: fits
+    p = plan_build(N, LINKS, ladder=("stream", "spill"), governor=gov,
+                   priors=st)
+    assert p.chosen == "spill"
+    d = p.decision("rungs")
+    assert d.provenance == "learned"
+    assert d.analytic == ["stream", "spill"]
+    text = "\n".join(p.explain())
+    assert "history corrected" in text
+    assert "mem_ratio:stream" in text
+    assert p.corrections()
+
+
+def test_prior_needs_min_samples_to_correct(plan_env):
+    st = PriorStore()
+    st.observe("mem_ratio", "stream", N, 4.0)  # one sample only
+    assert MIN_CORRECT_SAMPLES > 1
+    gov = G.ResourceGovernor(
+        mem_budget=G.rss_bytes() + G.rung_peak_nbytes("stream", N, LINKS) * 2)
+    p = plan_build(N, LINKS, ladder=("stream", "spill"), governor=gov,
+                   priors=st)
+    assert p.chosen == "stream"  # a single noisy run must not flip plans
+    assert p.decision("rungs").provenance != "learned"
+
+
+def test_prior_corrects_mispriced_ext_block(plan_env):
+    """The ROADMAP's named example: a mispriced ext block size fixed by
+    a prior trace's measured cost.  History says ext really costs 4x
+    the analytic price on this host, so the fitted block halves further
+    than the analytic fit — provenance ``learned``."""
+    st = PriorStore()
+    st.observe("mem_ratio", "ext", N, 4.0)
+    st.observe("mem_ratio", "ext", N, 4.0)
+    head = 32 * N + G.EXT_RECORD_BYTES * G.ext_block_edges() // 2
+    gov = G.ResourceGovernor(mem_budget=G.rss_bytes() + head)
+    base = plan_build(N, LINKS, ladder=("ext", "spill"), governor=gov)
+    p = plan_build(N, LINKS, ladder=("ext", "spill"), governor=gov,
+                   priors=st)
+    d = p.decision("ext_block")
+    assert d.value < base.decision("ext_block").value
+    assert d.provenance == "learned"
+    assert d.analytic == base.decision("ext_block").value
+    assert d.prior and d.prior["count"] == 2
+    text = "\n".join(p.explain())
+    assert "mem_ratio:ext" in text
+
+
+def test_driver_builds_with_learned_ext_block(plan_env, tmp_path):
+    """End to end through the driver: a synthetic prior store shrinks
+    the ext block, the ladder.plan event records the learned decision,
+    and the tree is still oracle-bit-identical (a plan can only ever
+    change COST, never the forest)."""
+    from sheep_tpu.core import build_forest, degree_sequence
+    from sheep_tpu.io.edges import write_dat
+    from sheep_tpu.obs import trace as obs_trace
+    from sheep_tpu.runtime import RuntimeConfig, build_graph_resilient
+    from sheep_tpu.utils.synth import rmat_edges
+
+    tail, head = rmat_edges(12, 1 << 14, seed=3)
+    dat = str(tmp_path / "g.dat")
+    write_dat(dat, tail, head)
+    want_seq = degree_sequence(tail, head)
+    want = build_forest(tail, head, want_seq)
+    n = len(want_seq)
+
+    store = PriorStore(str(tmp_path / "p.store"))
+    store.observe("mem_ratio", "ext", n, 4.0)
+    store.observe("mem_ratio", "ext", n, 4.0)
+    store.save()
+    plan_env.setenv("SHEEP_PLAN_PRIORS", str(tmp_path / "p.store"))
+    budget = G.rss_bytes() + 32 * n \
+        + G.EXT_RECORD_BYTES * G.ext_block_edges() // 4
+    tpath = str(tmp_path / "b.trace")
+    plan_env.setenv("SHEEP_TRACE", tpath)
+    try:
+        cfg = RuntimeConfig(ladder=("ext", "spill"), edges_path=dat,
+                            governor=G.ResourceGovernor(mem_budget=budget))
+        seq, forest = build_graph_resilient(tail, head, config=cfg)
+    finally:
+        obs_trace.close_recorder()
+    np.testing.assert_array_equal(seq, want_seq)
+    np.testing.assert_array_equal(forest.parent, want.parent)
+    np.testing.assert_array_equal(forest.pst_weight, want.pst_weight)
+    records, _, _ = obs_trace.read_trace(tpath, "repair")
+    plans = [r for r in records if r.get("name") == "ladder.plan"]
+    assert plans
+    a = plans[0]["a"]
+    assert a["n"] == n and a["links"] >= 0  # the harvestable context
+    dec = {d["name"]: d for d in a["decisions"]}
+    assert dec["ext_block"]["provenance"] == "learned"
+    assert dec["ext_block"]["value"] < dec["ext_block"]["analytic"]
+    assert "prior" in dec["ext_block"]
+
+
+# ---------------------------------------------------------------------------
+# the prior store itself
+# ---------------------------------------------------------------------------
+
+
+def test_store_roundtrip_and_corruption(tmp_path):
+    st = PriorStore(str(tmp_path / "p.store"))
+    st.observe("mem_ratio", "ext", 1000, 2.0)
+    st.observe("mem_ratio", "ext", 1000, 4.0)
+    p = st.lookup("mem_ratio", "ext", 1000)
+    assert p["count"] == 2 and p["mean"] == pytest.approx(3.0)
+    # same bucket, different exact size
+    assert st.lookup("mem_ratio", "ext", 1023) == p
+    assert st.lookup("mem_ratio", "ext", 4096) is None  # other bucket
+    assert st.lookup("mem_ratio", "ext", 1000, host="other") is None
+    st.save()
+    again = PriorStore(str(tmp_path / "p.store"))
+    assert again.lookup("mem_ratio", "ext", 1000) == p
+    # corruption reads as empty, never raises (priors only ever ADD)
+    (tmp_path / "p.store").write_text("{nope")
+    assert len(PriorStore(str(tmp_path / "p.store"))) == 0
+
+
+def test_scale_bucket_and_key():
+    assert scale_bucket(0) == 0
+    assert scale_bucket(1) == 0
+    assert scale_bucket(1 << 16) == 16
+    assert scale_bucket((1 << 17) - 1) == 16
+    k = prior_key("mem_ratio", "ext", 1 << 16, host="h0")
+    assert k == "h0:mem_ratio:ext:s16"
+
+
+# ---------------------------------------------------------------------------
+# harvesting across rotated segment chains (the satellite)
+# ---------------------------------------------------------------------------
+
+
+def _emit_planned_build(n, est, rss0, rss1, rung="ext", count=1):
+    """Emit `count` synthetic planned-build event pairs into the live
+    recorder (the exact shapes the driver writes)."""
+    from sheep_tpu.obs import trace as obs
+    for _ in range(count):
+        obs.event("ladder.plan", rungs=[rung], priced=[], n=n,
+                  links=4 * n, rss_bytes=rss0, decisions=[])
+        obs.event("rung.ok", rung=rung, rss_bytes=rss1, est_bytes=est,
+                  n=n)
+
+
+def test_harvest_survives_rotation_and_torn_tail(plan_env, tmp_path):
+    """The prior store reads through a rotated ``.NNNN.trace`` chain
+    with a torn newest segment — the state a SHEEP_TRACE_MAX_MB daemon
+    killed mid-line leaves behind."""
+    from sheep_tpu.obs import trace as obs
+    tpath = str(tmp_path / "d.trace")
+    plan_env.setenv(obs.ENV, tpath)
+    plan_env.setenv(obs.MAX_MB_ENV, "0.002")  # ~2KB: rotate fast
+    n, est = 1 << 16, 10 << 20
+    try:
+        _emit_planned_build(n, est, rss0=100 << 20, rss1=(100 << 20) + 2 * est,
+                            count=40)
+    finally:
+        obs.close_recorder()
+    segs = obs.trace_segments(tpath)
+    assert len(segs) >= 3, segs  # rotation really happened
+    # tear the newest (active) file mid-line: the kill -9 shape
+    with open(tpath, "ab") as f:
+        f.write(b'{"k":"ev","name":"rung.ok","a":{"est_b')
+    st = PriorStore()
+    got = st.harvest_trace(tpath)
+    assert got == 40, got  # every rotated segment's samples landed
+    p = st.lookup("mem_ratio", "ext", n)
+    assert p["count"] == 40 and p["mean"] == pytest.approx(2.0)
+    # the chain reader sees one stream too (rollup satellite)
+    records = obs.read_trace_chain(tpath, "repair")
+    assert sum(1 for r in records if r.get("name") == "rung.ok") == 40
+
+
+def test_harvest_skips_rotten_mid_chain_segment(plan_env, tmp_path):
+    """Mid-file rot in a ROTATED segment loses that segment's samples
+    but never the harvest: history degrades to fewer samples."""
+    from sheep_tpu.obs import trace as obs
+    tpath = str(tmp_path / "d.trace")
+    plan_env.setenv(obs.ENV, tpath)
+    plan_env.setenv(obs.MAX_MB_ENV, "0.002")
+    n, est = 1 << 16, 10 << 20
+    try:
+        _emit_planned_build(n, est, rss0=0, rss1=2 * est, count=40)
+    finally:
+        obs.close_recorder()
+    segs = obs.trace_segments(tpath)
+    assert len(segs) >= 3
+    # rot the middle of the FIRST rotated segment (not a legal tear)
+    with open(segs[0], "r+b") as f:
+        f.seek(os.path.getsize(segs[0]) // 2)
+        f.write(b"\x00garbage\x00")
+    st = PriorStore()
+    got = st.harvest_trace(tpath)
+    assert 0 < got < 40, got
+    # and read_trace_chain (strict on rotated segments) refuses — the
+    # harvester is deliberately more forgiving than the artifact reader
+    from sheep_tpu.integrity.errors import IntegrityError
+    with pytest.raises(IntegrityError):
+        obs.read_trace_chain(tpath, "repair")
+
+
+def test_harvest_bench_record(tmp_path):
+    rec = {"arms": {"ext": {"arm": "ext", "wall_s": 8.0,
+                            "records": 1 << 26},
+                    "spill": {"arm": "spill", "wall_s": 15.0,
+                              "records": 1 << 26},
+                    "batch_ab": {"arm": "batch", "wall_s": 1.0}}}
+    path = tmp_path / "EXTBENCH_test.json"
+    path.write_text(json.dumps(rec))
+    st = PriorStore()
+    assert st.harvest_bench(str(path)) == 2  # only rung-named arms
+    assert st.lookup("rung_s", "ext", 1 << 26)["mean"] == pytest.approx(8.0)
+    # garbage harvests zero, never raises
+    bad = tmp_path / "bad.json"
+    bad.write_text("{")
+    assert st.harvest_bench(str(bad)) == 0
+
+
+# ---------------------------------------------------------------------------
+# the CLI
+# ---------------------------------------------------------------------------
+
+
+def _write_dat(tmp_path):
+    from sheep_tpu.io.edges import write_dat
+    from sheep_tpu.utils.synth import rmat_edges
+    tail, head = rmat_edges(10, 1 << 12, seed=9)
+    dat = str(tmp_path / "g.dat")
+    write_dat(dat, tail, head)
+    return dat
+
+
+def test_plan_cli_explain_deterministic(plan_env, tmp_path, capsys):
+    from sheep_tpu.cli.plan import main
+    dat = _write_dat(tmp_path)
+    plan_env.setenv("SHEEP_MEM_BUDGET", "64M")
+    outs = []
+    for _ in range(2):
+        assert main(["--explain", "--assume-rss", "0", dat]) == 0
+        outs.append(capsys.readouterr().out)
+    assert outs[0] == outs[1]  # same inputs -> same plan, byte for byte
+    assert "chosen rung:" in outs[0]
+    assert "[default]" in outs[0] or "[priced]" in outs[0]
+
+
+def test_plan_cli_json_and_hypothetical(plan_env, capsys):
+    from sheep_tpu.cli.plan import main
+    assert main(["--json", "-n", str(1 << 16), "-e", str(1 << 18)]) == 0
+    view = json.loads(capsys.readouterr().out)
+    assert view["chosen"] in view["rungs"]
+    assert {d["name"] for d in view["decisions"]} >= {
+        "rungs", "native_threads", "ext_block", "handoff_windows"}
+
+
+def test_plan_cli_harvest_roundtrip(plan_env, tmp_path, capsys):
+    from sheep_tpu.cli.plan import main
+    from sheep_tpu.obs import trace as obs
+    tpath = str(tmp_path / "b.trace")
+    plan_env.setenv(obs.ENV, tpath)
+    try:
+        _emit_planned_build(1 << 16, 10 << 20, rss0=0, rss1=20 << 20,
+                            count=3)
+    finally:
+        obs.close_recorder()
+    plan_env.delenv(obs.ENV)
+    store = str(tmp_path / "p.store")
+    assert main(["--harvest", store, tpath]) == 0
+    assert "3 sample(s)" in capsys.readouterr().out
+    st = PriorStore(store)
+    assert st.lookup("mem_ratio", "ext", 1 << 16)["count"] == 3
+    # and the store feeds --priors: under a budget the analytic ext fit
+    # keeps the default block but the learned x2 correction halves it —
+    # the explain text names the prior that did it
+    plan_env.setenv("SHEEP_MEM_BUDGET", "48M")
+    assert main(["--explain", "--assume-rss", "0", "--priors", store,
+                 "-n", str(1 << 16), "-e", str(1 << 18)]) == 0
+    out = capsys.readouterr().out
+    assert "mem_ratio:ext" in out
+    assert "ext_block" in out and "[learned]" in out
+
+
+def test_plan_cli_usage_errors(plan_env, capsys):
+    from sheep_tpu.cli.plan import main
+    assert main([]) == 2
+    assert main(["--harvest", "x.store"]) == 2
+    assert main(["/nonexistent/g.dat"]) == 1

@@ -118,6 +118,46 @@ def test_heartbeat_staleness(tmp_path):
                     now=os.path.getmtime(hb) + 11)
 
 
+def test_poll_count_staleness_is_deterministic(tmp_path):
+    """stale_after_polls (the chaos-sweep deflake): a silent attempt is
+    declared dead after exactly N beat-free polls; an attempt that beats
+    between polls resets the count; and the wall clock plays no part —
+    the polls can be arbitrarily far apart in real time."""
+    from sheep_tpu.supervisor.heartbeat import beat
+    from sheep_tpu.supervisor.manifest import Leg
+    from sheep_tpu.supervisor.supervise import (SupervisorConfig,
+                                                TournamentSupervisor,
+                                                _Attempt)
+
+    class _Manifest:
+        legs = []
+    sup = TournamentSupervisor.__new__(TournamentSupervisor)
+    sup.config = SupervisorConfig(stale_after_polls=3, deadline_s=0.0)
+    hb = str(tmp_path / "a.hb")
+    beat(hb)
+    leg = Leg(key="x", kind="map", round=0, index=0, inputs=(),
+              output=str(tmp_path / "x.tre"))
+    att = _Attempt(leg=leg, number=1, tmp="t", hb=hb, handle=None,
+                   started=0.0)
+    # poll 0 observes the mtime; 3 consecutive quiet polls -> stale,
+    # no matter that deadline_s is 0 (wall clock would have fired at
+    # the first poll) or how much real time separates the polls
+    assert not sup._attempt_stale(att, now=1e9)
+    assert not sup._attempt_stale(att, now=2e9)
+    assert not sup._attempt_stale(att, now=3e9)
+    assert sup._attempt_stale(att, now=4e9)
+    # a fresh beat resets the silence count
+    att2 = _Attempt(leg=leg, number=2, tmp="t", hb=hb, handle=None,
+                    started=0.0)
+    assert not sup._attempt_stale(att2, now=0.0)
+    assert not sup._attempt_stale(att2, now=0.0)
+    import time as _time
+    _time.sleep(0.01)  # mtime must advance
+    beat(hb)
+    assert not sup._attempt_stale(att2, now=0.0)
+    assert att2.quiet_polls == 0
+
+
 # ---------------------------------------------------------------------------
 # units: manifest planning + durability
 # ---------------------------------------------------------------------------
@@ -262,8 +302,10 @@ def test_fault_at_every_leg_is_bit_identical(small_graph, tmp_path, kind):
         manifest, cfg = _run(
             graph, tmp_path / f"{kind}-{rnd}-{leg}",
             chaos=parse_fault_plan(spec),
-            # hang legs are declared dead by deadline, not by exit status
-            deadline_s=0.4 if kind == "hang" else 10.0)
+            # hang legs are declared dead by POLL-COUNT silence, not by
+            # exit status — nor by a short wall deadline, which raced
+            # the scheduler on loaded hosts (the chaos-sweep deflake)
+            stale_after_polls=25 if kind == "hang" else 0)
         assert _final(manifest) == base_bytes, spec
         parent, pst = read_tree(manifest.final_tree)
         from sheep_tpu.core.forest import Forest

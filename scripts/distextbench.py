@@ -25,9 +25,28 @@ Acceptance asserted into the record: file >= factor x per-leg budget;
 >= 2 legs; every leg's measured VmHWM inside its budget; distext CRCs ==
 single-host ext CRCs == oracle CRCs (oracle-bit-identical).
 
+``--remote`` (round r02, ISSUE 16) ships the hist/distmap legs to TWO
+real ``bin/worker`` subprocess daemons over loopback — separate state
+dirs, nothing shared but the wire — and additionally records:
+
+  _proc_capture   per-WORKER process gauges scraped over each daemon's
+                  METRICS verb (vmrss/uptime + the sheep_worker_*
+                  counters).  A shipped leg runs inside the daemon's
+                  process, so per-LEG VmHWM is not isolable the way the
+                  r01 subprocess legs' was; the honest per-leg budget
+                  claim rides on each worker's OWN SHEEP_MEM_BUDGET
+                  governing its ext folds, and the record says so.
+  kill arm        kill -9 one worker the moment its first shipped slice
+                  lands: the supervisor must re-dispatch EXACTLY one
+                  leg to the survivor, tree still CRC-identical.
+  netfault sweep  drop/partition/slow/dup at the worker-wire sites
+                  (wleg/wbeat/wart) on a small graph, each case judged
+                  on EXACT dispatch counts + CRC equality.
+
 Usage:
   python scripts/distextbench.py --budget 64M --legs 2 --factor 4 \
       --out DISTEXTBENCH_r01.json
+  python scripts/distextbench.py --remote --budget 96M --log-n 18
 """
 
 from __future__ import annotations
@@ -167,6 +186,249 @@ def _kb(s) -> int | None:
         return None
 
 
+# --- the --remote round (r02, ISSUE 16) ----------------------------------
+
+
+def spawn_workers(n: int, budget: str, base: str,
+                  plan: str | None = None):
+    """``n`` real bin/worker subprocess daemons, each with its OWN state
+    dir and SHEEP_MEM_BUDGET.  ``plan`` (a SHEEP_SERVE_NETFAULT_PLAN
+    spec) installs on the FIRST worker only, so a worker-side site fires
+    exactly once across the fleet — per-process counters would
+    otherwise fire the same nth on every daemon."""
+    from sheep_tpu.serve.worker import read_worker_addr
+    procs, dirs = [], []
+    for i in range(n):
+        wd = os.path.join(base, f"w{i}")
+        env = dict(os.environ)
+        env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+        env["SHEEP_MEM_BUDGET"] = budget
+        env.pop("SHEEP_SERVE_NETFAULT_PLAN", None)
+        if plan and i == 0:
+            env["SHEEP_SERVE_NETFAULT_PLAN"] = plan
+        procs.append(subprocess.Popen(
+            [sys.executable, "-m", "sheep_tpu.cli.worker", "-d", wd],
+            env=env, cwd=REPO, stdout=subprocess.DEVNULL,
+            stderr=subprocess.DEVNULL))
+        dirs.append(wd)
+    addrs = []
+    for wd in dirs:
+        deadline = time.monotonic() + 60
+        while True:
+            try:
+                addrs.append(read_worker_addr(wd))
+                break
+            except (OSError, ValueError):
+                if time.monotonic() > deadline:
+                    raise SystemExit(f"{wd}/worker.addr never appeared")
+                time.sleep(0.05)
+    return procs, dirs, addrs
+
+
+def stop_workers(procs) -> None:
+    import signal
+    for p in procs:
+        if p.poll() is None:
+            p.send_signal(signal.SIGTERM)
+    for p in procs:
+        try:
+            p.wait(timeout=30)
+        except subprocess.TimeoutExpired:
+            p.kill()
+
+
+def worker_proc_capture(addrs) -> dict:
+    """Per-worker METRICS scrape: the daemon's process gauges plus its
+    sheep_worker_* counters — the r02 stand-in for per-leg VmHWM."""
+    from sheep_tpu.obs.metrics import parse_prometheus
+    from sheep_tpu.serve.protocol import ServeClient
+    keep = ("sheep_worker_legs_inflight", "sheep_worker_legs_done",
+            "sheep_worker_bytes_shipped", "sheep_process_vmrss_bytes",
+            "sheep_process_vmhwm_bytes", "sheep_process_uptime_seconds")
+    caps = {}
+    for host, port in addrs:
+        key = f"{host}:{port}"
+        try:
+            with ServeClient(host, port, timeout_s=10.0) as c:
+                samples = parse_prometheus(c.metrics())
+        except (OSError, ConnectionError) as exc:
+            caps[key] = {"error": str(exc)}
+            continue
+        caps[key] = {n[len("sheep_"):]: v for n, _, v in samples
+                     if n in keep}
+    return caps
+
+
+def run_remote_arm(path: str, state_dir: str, budget: str, legs: int,
+                   addrs) -> dict:
+    """The same supervised job as the distext arm, but the hist/distmap
+    legs ship over the wire to the worker daemons (the supervisor holds
+    no leg state; merge/copy legs stay local subprocesses)."""
+    from sheep_tpu.io.trefile import read_tree
+    from sheep_tpu.ops.distext import (dat_num_records, leg_perf_path,
+                                       run_distext)
+    from sheep_tpu.supervisor import (SubprocessRunner, SupervisorConfig,
+                                      wire_status_path)
+
+    # a 1-core host prices the 2-worker wave as an exact tie (DISK_BPS =
+    # 2x WIRE_BPS), and ties stay local — the bench pins the ship arm
+    os.environ["SHEEP_WORKER_TRANSPORT"] = "ship"
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["SHEEP_MEM_BUDGET"] = budget
+    cfg = SupervisorConfig.from_env(grammar=False,
+                                    worker_addrs=list(addrs),
+                                    worker_beat_s=0.5)
+    t0 = time.perf_counter()
+    manifest = run_distext(path, state_dir, cfg,
+                           runner=SubprocessRunner(env=env), legs=legs)
+    wall = time.perf_counter() - t0
+    records = dat_num_records(path)
+    out = {"arm": "remote", "records": records,
+           "wall_s": round(wall, 3),
+           "edges_per_s": round(records / wall, 1),
+           "legs": len(manifest.shards),
+           "workers": [f"{h}:{p}" for h, p in addrs],
+           "dispatches": sum(leg.dispatches for leg in manifest.legs),
+           "dispatch_counts": sorted(leg.dispatches
+                                     for leg in manifest.legs),
+           "per_leg": {}}
+    for leg in manifest.legs:
+        if leg.kind not in ("hist", "distmap"):
+            continue
+        wire, rep = {}, {}
+        try:
+            with open(wire_status_path(state_dir, leg.output)) as f:
+                wire = json.load(f)
+        except (OSError, ValueError):
+            pass
+        try:
+            with open(leg_perf_path(state_dir, leg.key)) as f:
+                rep = json.load(f)
+        except (OSError, ValueError):
+            pass
+        out["per_leg"][leg.key] = {
+            "kind": leg.kind,
+            "dispatches": leg.dispatches,
+            "worker": wire.get("worker") or rep.get("worker"),
+            "wire_dispatches": wire.get("dispatches"),
+            "speculations": wire.get("speculations"),
+            "range": rep.get("range"),
+            "perf": rep.get("perf"),
+        }
+    parent, pst = read_tree(manifest.final_tree)
+
+    class _F:
+        pass
+
+    f = _F()
+    f.parent, f.pst_weight = parent, pst
+    out.update(_crcs(f))
+    return out
+
+
+def run_kill_arm(path: str, base: str, budget: str, legs: int) -> dict:
+    """kill -9 worker 0 the moment its first shipped slice lands; the
+    supervisor must re-dispatch exactly that one leg to the survivor."""
+    import glob
+    import signal
+    import threading
+    procs, dirs, addrs = spawn_workers(2, budget, base)
+    victim, vdir = procs[0], dirs[0]
+
+    def killer():
+        while victim.poll() is None:
+            if glob.glob(vdir + "/*.slice.dat"):
+                victim.send_signal(signal.SIGKILL)
+                return
+            time.sleep(0.002)
+
+    t = threading.Thread(target=killer, daemon=True)
+    t.start()
+    try:
+        out = run_remote_arm(path, os.path.join(base, "state"), budget,
+                             legs, addrs)
+    finally:
+        t.join(timeout=10)
+        stop_workers(procs)
+    out["arm"] = "remote-kill"
+    out["victim_killed"] = victim.poll() is not None
+    counts = out["dispatch_counts"]
+    out["exactly_one_redispatch"] = (
+        counts == [1] * (len(counts) - 1) + [2])
+    return out
+
+
+#: the worker-wire sweep: (kind, site, expect-a-redispatch)
+NETFAULT_CASES = (
+    ("drop", "wleg", True),        # job never arrives; staleness fires
+    ("partition", "wleg", True),   # link dies before dispatch
+    ("slow", "wleg", False),       # latency, not loss
+    ("dup", "wleg", False),        # twin delivery; first finisher wins
+    ("partition", "wbeat", True),  # link dies mid-leg
+    ("drop", "wart", True),        # result never sent
+    ("partition", "wart", True),   # torn mid-payload; crc refuses
+    ("slow", "wart", False),
+    ("dup", "wart", False),        # double delivery; second discarded
+)
+
+
+def run_netfault_sweep(base: str) -> dict:
+    """Every worker-wire netfault case on a small graph, judged on
+    EXACT dispatch counts and CRC equality.  wleg faults arm in THIS
+    (supervisor) process; wbeat/wart plans ride the first worker's
+    environment so they fire exactly once across the fleet."""
+    import zlib
+
+    import numpy as np
+    from sheep_tpu.io.trefile import read_tree
+    from sheep_tpu.ops.distext import run_distext
+    from sheep_tpu.serve import netfaults
+    from sheep_tpu.supervisor import InlineRunner, SupervisorConfig
+
+    os.environ["SHEEP_WORKER_TRANSPORT"] = "ship"
+    os.makedirs(base, exist_ok=True)
+    small = os.path.join(base, "sweep.dat")
+    generate(small, 1 << 18, 14)
+    oracle = run_child("oracle", small, None)
+    crc = lambda t: (zlib.crc32(np.asarray(t[0]).tobytes()),  # noqa: E731
+                     zlib.crc32(np.asarray(t[1]).tobytes()))
+    oracle_crc = (oracle.get("parent_crc32"), oracle.get("pst_crc32"))
+    out: dict = {"arm": "netfault-sweep", "graph_records": 1 << 18,
+                 "cases": {}}
+    for kind, site, redispatch in NETFAULT_CASES:
+        name = f"{kind}@{site}"
+        case_dir = os.path.join(base, f"{kind}-{site}")
+        plan = f"{kind}@{site}:0"
+        sup_side = site == "wleg"
+        procs, _, addrs = spawn_workers(
+            2, "768K", case_dir, plan=None if sup_side else plan)
+        if sup_side:
+            netfaults.install_plan(netfaults.parse_netfault_plan(plan))
+        try:
+            cfg = SupervisorConfig(workers=2, poll_s=0.01,
+                                   backoff_base_s=0.0, grammar=False,
+                                   worker_addrs=list(addrs),
+                                   worker_beat_s=0.05, deadline_s=1.0)
+            m = run_distext(small, os.path.join(case_dir, "state"), cfg,
+                            runner=InlineRunner(0.05), legs=2)
+            counts = sorted(leg.dispatches for leg in m.legs)
+            got_crc = crc(read_tree(m.final_tree))
+        finally:
+            netfaults.clear_plan()
+            stop_workers(procs)
+        want = ([1] * (len(counts) - 1) + [2] if redispatch
+                else [1] * len(counts))
+        out["cases"][name] = {
+            "counts": counts, "want": want,
+            "crc_ok": got_crc == oracle_crc,
+            "ok": counts == want and got_crc == oracle_crc,
+        }
+        print(json.dumps({name: out["cases"][name]}), file=sys.stderr)
+    out["green"] = all(c["ok"] for c in out["cases"].values())
+    return out
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--budget", default="64M",
@@ -178,10 +440,16 @@ def main() -> int:
     ap.add_argument("--log-n", type=int, default=20)
     ap.add_argument("--data", default=None)
     ap.add_argument("--keep-file", action="store_true")
-    ap.add_argument("--out", default="DISTEXTBENCH_r01.json")
+    ap.add_argument("--remote", action="store_true",
+                    help="ship the hist/distmap legs to 2 real worker "
+                         "daemons over loopback (round r02, ISSUE 16)")
+    ap.add_argument("--out", default=None)
     ap.add_argument("--child", choices=("ext", "oracle"),
                     help=argparse.SUPPRESS)
     args = ap.parse_args()
+    if args.out is None:
+        args.out = ("DISTEXTBENCH_r02.json" if args.remote
+                    else "DISTEXTBENCH_r01.json")
 
     if args.child:
         out = {"ext": child_ext, "oracle": child_oracle}[args.child](
@@ -209,7 +477,7 @@ def main() -> int:
 
     record: dict = {
         "bench": "DISTEXTBENCH",
-        "round": "r01",
+        "round": "r02" if args.remote else "r01",
         "budget_per_leg": args.budget,
         "budget_per_leg_bytes": budget_bytes,
         "legs": args.legs,
@@ -231,36 +499,117 @@ def main() -> int:
     }
     state_dir = tempfile.mkdtemp(prefix="distextbench-state.")
     try:
-        print("running distext arm...", file=sys.stderr)
-        record["arms"]["distext"] = run_distext_arm(
-            path, state_dir, args.budget, args.legs)
-        print(json.dumps({k: v for k, v in
-                          record["arms"]["distext"].items()
-                          if k != "per_leg"}), file=sys.stderr)
-        for arm in ("ext", "oracle"):
-            print(f"running {arm} arm...", file=sys.stderr)
-            record["arms"][arm] = run_child(
-                arm, path, args.budget if arm == "ext" else None)
-            print(json.dumps(record["arms"][arm]), file=sys.stderr)
-        dist = record["arms"]["distext"]
-        ext = record["arms"]["ext"]
-        oracle = record["arms"]["oracle"]
-        leg_hwms = [leg.get("vmhwm_bytes") or (1 << 62)
-                    for leg in dist.get("per_leg", {}).values()]
-        record["acceptance"] = {
-            "file_ge_factor_x_leg_budget":
-                file_bytes >= args.factor * budget_bytes,
-            "n_legs_ge_2": dist.get("legs", 0) >= 2,
-            "every_leg_rss_inside_budget":
-                bool(leg_hwms) and max(leg_hwms) <= budget_bytes,
-            "distext_oracle_exact":
-                dist.get("parent_crc32") == oracle.get("parent_crc32")
-                and dist.get("pst_crc32") == oracle.get("pst_crc32"),
-            "distext_matches_single_host_ext":
-                dist.get("parent_crc32") == ext.get("parent_crc32")
-                and dist.get("pst_crc32") == ext.get("pst_crc32"),
-        }
-        record["passed"] = all(record["acceptance"].values())
+        if args.remote:
+            record["_note"] = (
+                "serialized runs; the remote arm's hist/distmap legs "
+                "run INSIDE 2 bin/worker daemons over loopback "
+                "(separate state dirs, nothing shared but the wire), "
+                "each daemon under its own SHEEP_MEM_BUDGET.  Per-LEG "
+                "VmHWM is not isolable there (one process serves many "
+                "legs), so _proc_capture records per-WORKER process "
+                "gauges scraped over the daemons' METRICS verb instead "
+                "— re-judge per-leg peaks on the r01 subprocess round. "
+                "A worker's VmHWM includes ONE buffered slice: the wire "
+                "receive holds the slice in RAM until its crc verdict "
+                "(refusal-before-disk), by design")
+            work = tempfile.mkdtemp(prefix="distextbench-remote.")
+            try:
+                print("running remote arm...", file=sys.stderr)
+                procs, _, addrs = spawn_workers(
+                    2, args.budget, os.path.join(work, "base"))
+                try:
+                    record["arms"]["remote"] = run_remote_arm(
+                        path, state_dir, args.budget, args.legs, addrs)
+                    record["arms"]["remote"]["_proc_capture"] = \
+                        worker_proc_capture(addrs)
+                finally:
+                    stop_workers(procs)
+                print(json.dumps({k: v for k, v in
+                                  record["arms"]["remote"].items()
+                                  if k != "per_leg"}), file=sys.stderr)
+                for arm in ("ext", "oracle"):
+                    print(f"running {arm} arm...", file=sys.stderr)
+                    record["arms"][arm] = run_child(
+                        arm, path, args.budget if arm == "ext" else None)
+                    print(json.dumps(record["arms"][arm]),
+                          file=sys.stderr)
+                print("running kill arm...", file=sys.stderr)
+                record["arms"]["kill"] = run_kill_arm(
+                    path, os.path.join(work, "kill"), args.budget,
+                    args.legs)
+                print(json.dumps({k: v for k, v in
+                                  record["arms"]["kill"].items()
+                                  if k != "per_leg"}), file=sys.stderr)
+                print("running netfault sweep...", file=sys.stderr)
+                record["arms"]["netfaults"] = run_netfault_sweep(
+                    os.path.join(work, "sweep"))
+            finally:
+                shutil.rmtree(work, ignore_errors=True)
+            rem = record["arms"]["remote"]
+            ext = record["arms"]["ext"]
+            oracle = record["arms"]["oracle"]
+            kill = record["arms"]["kill"]
+            caps = rem.get("_proc_capture", {})
+            record["acceptance"] = {
+                "file_ge_factor_x_leg_budget":
+                    file_bytes >= args.factor * budget_bytes,
+                "n_legs_ge_2": rem.get("legs", 0) >= 2,
+                "n_workers_ge_2": len(rem.get("workers", [])) >= 2,
+                "every_worker_served_a_leg":
+                    bool(caps) and all(
+                        c.get("worker_legs_done", 0) >= 1
+                        for c in caps.values()),
+                "worker_proc_capture_present":
+                    bool(caps) and all(
+                        "process_vmrss_bytes" in c
+                        for c in caps.values()),
+                "remote_oracle_exact":
+                    rem.get("parent_crc32") == oracle.get("parent_crc32")
+                    and rem.get("pst_crc32") == oracle.get("pst_crc32"),
+                "remote_matches_single_host_ext":
+                    rem.get("parent_crc32") == ext.get("parent_crc32")
+                    and rem.get("pst_crc32") == ext.get("pst_crc32"),
+                "kill_redispatches_exactly_one_leg":
+                    kill.get("victim_killed") is True
+                    and kill.get("exactly_one_redispatch") is True,
+                "kill_crc_identical":
+                    kill.get("parent_crc32") == oracle.get("parent_crc32")
+                    and kill.get("pst_crc32") == oracle.get("pst_crc32"),
+                "netfault_sweep_green":
+                    record["arms"]["netfaults"].get("green") is True,
+            }
+            record["passed"] = all(record["acceptance"].values())
+        else:
+            print("running distext arm...", file=sys.stderr)
+            record["arms"]["distext"] = run_distext_arm(
+                path, state_dir, args.budget, args.legs)
+            print(json.dumps({k: v for k, v in
+                              record["arms"]["distext"].items()
+                              if k != "per_leg"}), file=sys.stderr)
+            for arm in ("ext", "oracle"):
+                print(f"running {arm} arm...", file=sys.stderr)
+                record["arms"][arm] = run_child(
+                    arm, path, args.budget if arm == "ext" else None)
+                print(json.dumps(record["arms"][arm]), file=sys.stderr)
+            dist = record["arms"]["distext"]
+            ext = record["arms"]["ext"]
+            oracle = record["arms"]["oracle"]
+            leg_hwms = [leg.get("vmhwm_bytes") or (1 << 62)
+                        for leg in dist.get("per_leg", {}).values()]
+            record["acceptance"] = {
+                "file_ge_factor_x_leg_budget":
+                    file_bytes >= args.factor * budget_bytes,
+                "n_legs_ge_2": dist.get("legs", 0) >= 2,
+                "every_leg_rss_inside_budget":
+                    bool(leg_hwms) and max(leg_hwms) <= budget_bytes,
+                "distext_oracle_exact":
+                    dist.get("parent_crc32") == oracle.get("parent_crc32")
+                    and dist.get("pst_crc32") == oracle.get("pst_crc32"),
+                "distext_matches_single_host_ext":
+                    dist.get("parent_crc32") == ext.get("parent_crc32")
+                    and dist.get("pst_crc32") == ext.get("pst_crc32"),
+            }
+            record["passed"] = all(record["acceptance"].values())
     finally:
         shutil.rmtree(state_dir, ignore_errors=True)
         if generated and not args.keep_file:

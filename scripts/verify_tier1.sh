@@ -429,6 +429,116 @@ else
 fi
 # -------------------------------------------------------------------------
 
+# --- multi-host smoke (remote build workers, ISSUE 16) -------------------
+# Two real bin/worker subprocess daemons on loopback with SEPARATE state
+# dirs (nothing shared but the wire): a shipped 2-leg distext build must
+# be CRC-identical to the single-host ext arm and the in-RAM oracle with
+# every dispatch count exactly 1; then kill -9 one worker mid-leg (a
+# watcher fires the moment its first slice lands) and assert the
+# supervisor re-dispatches EXACTLY one leg to the survivor, tree still
+# CRC-identical.  Seconds of work (the worker stack imports no jax); a
+# regression anywhere in the remote-dispatch/recovery path fails the
+# gate before pytest even runs.
+MHOST_DIR=$(mktemp -d)
+if env JAX_PLATFORMS=cpu SHEEP_WORKER_TRANSPORT=ship \
+    python - "$MHOST_DIR" <<'EOF'
+import glob, os, signal, subprocess, sys, threading, time, zlib
+REPO = os.getcwd()
+sys.path.insert(0, REPO)
+import numpy as np
+from sheep_tpu.core import build_forest, degree_sequence
+from sheep_tpu.io.edges import write_dat
+from sheep_tpu.io.trefile import read_tree
+from sheep_tpu.ops.distext import run_distext
+from sheep_tpu.ops.extmem import build_forest_extmem
+from sheep_tpu.serve.worker import read_worker_addr
+from sheep_tpu.supervisor import InlineRunner, SupervisorConfig
+from sheep_tpu.utils.synth import rmat_edges
+
+d = sys.argv[1]
+tail, head = rmat_edges(14, 1 << 18, seed=67)
+p = d + "/g.dat"
+write_dat(p, tail, head)
+want = build_forest(tail, head, degree_sequence(tail, head))
+crc = lambda f: (zlib.crc32(np.asarray(f[0]).tobytes()),
+                 zlib.crc32(np.asarray(f[1]).tobytes()))
+oracle_crc = crc((want.parent, want.pst_weight))
+_, ext_f = build_forest_extmem(p)   # the single-host ext arm
+assert crc((ext_f.parent, ext_f.pst_weight)) == oracle_crc
+
+env = dict(os.environ)
+env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+env["SHEEP_MEM_BUDGET"] = "768K"   # each worker's OWN budget
+
+def spawn_worker(wd):
+    return subprocess.Popen(
+        [sys.executable, "-m", "sheep_tpu.cli.worker", "-d", wd],
+        env=env, cwd=REPO)
+
+def waddr(wd, timeout=60.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        try:
+            return read_worker_addr(wd)
+        except (OSError, ValueError):
+            time.sleep(0.05)
+    raise SystemExit(f"{wd}/worker.addr never appeared")
+
+def run(name, addrs):
+    cfg = SupervisorConfig(poll_s=0.01, backoff_base_s=0.0,
+                           grammar=False, worker_addrs=list(addrs),
+                           worker_beat_s=0.1)
+    m = run_distext(p, f"{d}/{name}", cfg, runner=InlineRunner(0.05),
+                    legs=2)
+    return crc(read_tree(m.final_tree)), m
+
+# two worker daemons, separate state dirs, nothing shared but the wire
+w1d, w2d = d + "/w1", d + "/w2"
+procs = [spawn_worker(w1d), spawn_worker(w2d)]
+base_crc, m = run("base", [waddr(w1d), waddr(w2d)])
+assert base_crc == oracle_crc, "remote build diverged from the ext CRC"
+counts = {leg.key: leg.dispatches for leg in m.legs}
+assert all(n == 1 for n in counts.values()), counts
+shipped = glob.glob(w1d + "/*.slice.dat") + glob.glob(w2d + "/*.slice.dat")
+assert shipped, "no leg was actually shipped over the wire"
+
+# kill -9 one worker the moment its first shipped slice lands: the
+# supervisor must re-dispatch EXACTLY that one leg to the survivor
+w3d, w4d = d + "/w3", d + "/w4"
+procs += [spawn_worker(w3d), spawn_worker(w4d)]
+victim = procs[2]
+addrs2 = [waddr(w3d), waddr(w4d)]
+
+def killer():
+    while victim.poll() is None:
+        if glob.glob(w3d + "/*.slice.dat"):
+            victim.send_signal(signal.SIGKILL)
+            return
+        time.sleep(0.002)
+
+t = threading.Thread(target=killer, daemon=True)
+t.start()
+hurt_crc, m = run("hurt", addrs2)
+t.join(timeout=10)
+assert victim.poll() is not None, "the victim worker was never killed"
+assert hurt_crc == oracle_crc, "killed-worker recovery diverged"
+counts = sorted(leg.dispatches for leg in m.legs)
+assert counts == [1] * (len(counts) - 1) + [2], counts
+
+for pr in procs:
+    if pr.poll() is None:
+        pr.send_signal(signal.SIGTERM)
+        pr.wait(timeout=60)
+EOF
+then
+  rm -rf "$MHOST_DIR"
+else
+  echo "MULTI-HOST SMOKE FAILED: remote-worker build diverged from the" \
+       "oracle or kill -9 did not re-dispatch exactly one leg" >&2
+  rm -rf "$MHOST_DIR"; exit 1
+fi
+# -------------------------------------------------------------------------
+
 # --- deterministic-plan smoke (the planner, ISSUE 15) --------------------
 # `sheep plan --explain` on a small .dat under a budget: the output must
 # name the chosen rung, and — with the measured-RSS input pinned

@@ -48,7 +48,7 @@ def resolve_addr(host_port: str | None,
         except ValueError:
             return None
     if state_dir:
-        for name in ("router.addr", "serve.addr"):
+        for name in ("router.addr", "serve.addr", "worker.addr"):
             try:
                 host, port = open(os.path.join(state_dir, name)) \
                     .read().split()
@@ -64,6 +64,13 @@ def fleet_view(samples) -> dict:
     instances; lag/epoch roll up as max over those instances."""
     tenants: dict[str, dict] = {}
     instances: dict[str, dict] = {}
+    workers: dict[str, dict] = {}
+
+    def wk(labels):
+        # a build worker's scrape (serve/worker.py) has no tenant
+        # series; its identity is its instance label, or "local" when
+        # the scrape came straight off one worker daemon
+        return workers.setdefault(labels.get("instance", "local"), {})
 
     def tn(labels):
         t = labels.get("tenant")
@@ -117,6 +124,23 @@ def fleet_view(samples) -> dict:
             if rec is not None:
                 rec["applied_seqno"] = max(rec["applied_seqno"],
                                            int(val))
+        elif name == "sheep_worker_legs_inflight":
+            wk(labels)["legs_inflight"] = int(val)
+        elif name == "sheep_worker_legs_done":
+            wk(labels)["legs_done"] = int(val)
+        elif name == "sheep_worker_bytes_shipped":
+            wk(labels)["bytes_shipped"] = int(val)
+    # a build worker's process gauges ride the same scrape; attach them
+    # only to scrapes that identified themselves as workers above
+    if workers:
+        for name, labels, val in samples:
+            key = labels.get("instance", "local")
+            if key not in workers:
+                continue
+            if name == "sheep_process_vmrss_bytes":
+                workers[key]["vmrss_mb"] = round(val / (1 << 20), 1)
+            elif name == "sheep_process_uptime_seconds":
+                workers[key]["uptime_s"] = round(val, 1)
     for rec in tenants.values():
         hosting = [instances.get(i, {}) for i in rec["instances"]]
         rec["repl_lag"] = max((h.get("repl_lag", 0) for h in hosting),
@@ -137,7 +161,8 @@ def fleet_view(samples) -> dict:
                 labels.get("cluster", "?")] = int(val)
         elif name == "sheep_fleet_scrape_seconds":
             fleet["scrape_s"] = val
-    return {"tenants": tenants, "instances": instances, "fleet": fleet}
+    return {"tenants": tenants, "instances": instances, "fleet": fleet,
+            "workers": workers}
 
 
 def qps_between(prev: dict, cur: dict, dt: float) -> None:
@@ -171,6 +196,18 @@ def render_table(view: dict, scrape_bytes: int) -> str:
             f"{inst:<22} {rec.get('cluster') or '?':<8} "
             f"{rec.get('epoch', '-'):>5} {rec.get('repl_lag', '-'):>5} "
             f"{(f'{rss}M' if rss is not None else '-'):>9}")
+    if view.get("workers"):
+        whead = (f"{'WORKER':<22} {'INFLT':>5} {'DONE':>6} "
+                 f"{'SHIPPED':>10} {'RSS':>9}")
+        lines += ["", whead, "-" * len(whead)]
+        for w, rec in sorted(view["workers"].items()):
+            rss = rec.get("vmrss_mb")
+            shipped = rec.get("bytes_shipped")
+            lines.append(
+                f"{w:<22} {rec.get('legs_inflight', '-'):>5} "
+                f"{rec.get('legs_done', '-'):>6} "
+                f"{(f'{shipped / (1 << 20):.1f}M' if shipped is not None else '-'):>10} "
+                f"{(f'{rss}M' if rss is not None else '-'):>9}")
     fleet = view["fleet"]
     foot = [f"scrape: {scrape_bytes} bytes"]
     if "scrape_s" in fleet:

@@ -245,6 +245,7 @@ def run_distext(graph: str, state_dir: str, config=None, runner=None,
     gov = config.governor if config.governor is not None \
         else ResourceGovernor.from_env()
     forced = legs or distext_forced_legs()
+    transport = None
     if os.path.exists(manifest_path(state_dir)):
         manifest = load_manifest(state_dir, config.integrity)
         size = os.path.getsize(graph) if os.path.exists(graph) else -1
@@ -272,21 +273,45 @@ def run_distext(graph: str, state_dir: str, config=None, runner=None,
         # the leg count routes through the planner (ISSUE 15): same
         # governor arithmetic, plus the provenance record — a forced
         # count (arg or SHEEP_DISTEXT_LEGS) is the operator's word
-        from ..plan import plan_distext_legs
+        from ..plan import plan_distext_legs, plan_transport
         plan = plan_distext_legs(governor=gov) if not forced else None
         n_legs = forced or plan["legs"]
         shards = plan_shards(records, n_legs)
         manifest = plan_distext(graph, prefix, final, shards,
                                 config.reduction)
+        transport = plan_transport(
+            records, n_legs,
+            len(getattr(config, "worker_addrs", None) or []))
         obs.event("distext.plan", legs=n_legs, records=records,
                   forced=bool(forced),
                   provenance=("forced" if forced
                               else plan["provenance"]),
                   block_edges=plan["block_edges"] if plan else None,
                   per_leg_peak_bytes=(plan["per_leg_peak_bytes"]
-                                      if plan else None))
+                                      if plan else None),
+                  transport=transport["transport"],
+                  transport_provenance=transport["provenance"],
+                  workers=transport["remote_workers"])
         config.events.append(("distext-plan", n_legs, records))
     save_manifest(manifest, state_dir)
+    worker_addrs = getattr(config, "worker_addrs", None) or []
+    if transport is None:
+        # resume path: the shard map is the manifest's, but the
+        # transport decision is per-run — a resumed build prices (or
+        # honors the pin) against TODAY's worker fleet
+        from ..plan import plan_transport
+        records = manifest.graph_bytes // EXT_RECORD_BYTES \
+            if manifest.graph_bytes > 0 else 0
+        transport = plan_transport(records, len(manifest.shards),
+                                   len(worker_addrs))
+    if worker_addrs and transport["transport"] == "ship":
+        from ..supervisor.remote import RemoteRunner
+        if runner is None:
+            runner = sup.SubprocessRunner()
+        if not getattr(runner, "remote", False):
+            runner = RemoteRunner(
+                worker_addrs, base=runner,
+                beat_s=getattr(config, "worker_beat_s", 1.0))
     manifest = TournamentSupervisor(manifest, state_dir, config,
                                     runner).run()
     if out_file and out_file != manifest.final_tree:

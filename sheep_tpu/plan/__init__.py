@@ -13,8 +13,9 @@ supervisor parent, and the serve daemon without initializing a backend.
 """
 
 from .model import (DEFAULT_LADDER, PROV_DEFAULT, PROV_FORCED,
-                    PROV_LEARNED, PROV_PRICED, Decision, Plan,
-                    available_rungs, plan_build, plan_distext_legs)
+                    PROV_LEARNED, PROV_PRICED, WORKER_TRANSPORT_ENV,
+                    Decision, Plan, available_rungs, plan_build,
+                    plan_distext_legs, plan_transport)
 from .priors import (MIN_CORRECT_SAMPLES, PRIORS_ENV, PriorStore,
                      host_fingerprint, mem_ratio, prior_key, scale_bucket)
 
@@ -34,6 +35,8 @@ __all__ = [
     "mem_ratio",
     "plan_build",
     "plan_distext_legs",
+    "plan_transport",
+    "WORKER_TRANSPORT_ENV",
     "prior_key",
     "scale_bucket",
 ]

@@ -14,15 +14,26 @@ deterministically — the same discipline as ``SHEEP_IO_FAULT_PLAN`` and
     SHEEP_SERVE_NETFAULT_PLAN = entry[,entry...]
     entry                     = kind @ site : nth
     kind                      = drop | partition | slow | dup
-    site                      = repl | hb | *
+    site                      = repl | hb | wleg | wbeat | wart | *
     nth                       = 0-based index of that site's firing
 
-Sites are the leader's outbound frame classes:
+Sites are outbound frame classes — the replication leader's, plus the
+build-worker wire's (ISSUE 16, serve/worker.py):
 
   repl   one REPL APPEND frame (a replicated WAL record) about to be
          sent to one follower
   hb     one REPL PING frame (the replication-stream heartbeat that
          carries the leader's latest seqno)
+  wleg   one LEG dispatch (the supervisor shipping a distext leg's
+         slice to a remote build worker); drop = the job never arrives
+         (staleness redispatches), partition = the link dies before
+         dispatch, dup = duplicate delivery to a second worker —
+         first-finisher-wins arbitration must discard the loser
+  wbeat  one worker->supervisor BEAT frame (the wire heartbeat);
+         partition here kills the link mid-leg
+  wart   the worker's artifact return; partition here tears the
+         transfer mid-payload — the crc gate must refuse it and the
+         supervisor redispatch exactly one leg
 
 Kinds model the distinct network failure shapes, each driving a
 DIFFERENT follower recovery path:
@@ -55,7 +66,7 @@ from dataclasses import dataclass, field
 NETFAULT_PLAN_ENV = "SHEEP_SERVE_NETFAULT_PLAN"
 
 KINDS = ("drop", "partition", "slow", "dup")
-SITES = ("repl", "hb", "*")
+SITES = ("repl", "hb", "wleg", "wbeat", "wart", "*")
 
 #: how long a "slow" network fault delays one frame
 SLOW_S = 0.05

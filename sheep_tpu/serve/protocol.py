@@ -120,6 +120,12 @@ ADMIN_VERBS = ("SNAPSHOT", "REPARTITION", "EVICT", "QUIT")
 #: — a configured replica is cluster plumbing, not client load, and
 #: shedding it would turn an overload into a lag spiral
 REPL_VERBS = ("REPL",)
+#: the build-worker wire (ISSUE 16, serve/worker.py): LEG ships a distext
+#: leg's slice to a ``sheep worker`` daemon, BEAT is the worker's wire
+#: heartbeat back.  Spoken only between a distext supervisor and worker
+#: daemons (which also answer PING/METRICS/QUIT in the shared grammar) —
+#: a serve daemon refuses them like any unknown verb
+WORKER_VERBS = ("LEG", "BEAT")
 
 #: protocol line-length cap: a request that does not fit is a bad request,
 #: not an invitation to buffer without bound

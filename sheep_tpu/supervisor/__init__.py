@@ -15,6 +15,9 @@ Four modules, layered bottom-up:
                 per-leg cores budgeting (ISSUE 5)
   status.py     ``sheep supervise --status``: the manifest + heartbeat +
                 budget-headroom operator report (read-only)
+  remote.py     the remote dispatch arm (ISSUE 16): RemoteRunner ships
+                distext legs to ``sheep worker`` daemons over the fleet
+                wire behind the same runner seam
 
 See supervise.py's docstring for the failure model; the acceptance
 property (a fault at EVERY tournament round yields a bit-identical final
@@ -28,6 +31,7 @@ from .heartbeat import HeartbeatWriter, beat, is_stale, last_beat_s
 from .manifest import (Leg, Manifest, load_manifest, manifest_path,
                        plan_distext, plan_tournament, save_manifest,
                        tournament_rounds)
+from .remote import RemoteRunner, wire_status_path
 from .status import render_status, status_rows
 from .supervise import (InlineRunner, SubprocessRunner, SupervisionFailed,
                         SupervisorConfig, TournamentSupervisor, reconcile,
@@ -40,6 +44,7 @@ __all__ = [
     "InlineRunner",
     "Leg",
     "Manifest",
+    "RemoteRunner",
     "SubprocessRunner",
     "SupervisionFailed",
     "SupervisorConfig",
@@ -61,4 +66,5 @@ __all__ = [
     "status_rows",
     "sweep_attempt_debris",
     "tournament_rounds",
+    "wire_status_path",
 ]

@@ -150,6 +150,22 @@ def _ext_progress(manifest: Manifest, leg, state_dir: str | None):
     return snap.rounds, total
 
 
+def _wire_provenance(leg, state_dir: str | None) -> dict | None:
+    """A remote leg's dispatch provenance (supervisor/remote.py writes
+    ``wire-<artifact>.json`` per dispatch) — None for local legs.  The
+    wire-beat age is NOT here: BEAT frames touch the attempt's .hb file,
+    so ``heartbeat_age_s`` already tells that story for remote legs."""
+    if state_dir is None:
+        return None
+    from .remote import wire_status_path
+    try:
+        import json
+        with open(wire_status_path(state_dir, leg.output)) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
 def status_rows(manifest: Manifest, now: float | None = None,
                 state_dir: str | None = None) -> list[dict]:
     """One dict per leg: key/kind/round/state/dispatches/artifact bytes
@@ -157,7 +173,11 @@ def status_rows(manifest: Manifest, now: float | None = None,
     legs (the distributed out-of-core build, ISSUE 13) additionally
     report ``ext_blocks_done``/``ext_blocks_total`` from their own
     block-boundary checkpoint when ``state_dir`` is given — mid-leg
-    progress an operator can read next to the heartbeat age."""
+    progress an operator can read next to the heartbeat age.  Legs
+    dispatched over the worker wire (ISSUE 16) gain ``worker`` (the
+    remote address), ``wire_dispatches``, and ``speculations`` from the
+    dispatch provenance RemoteRunner records; their ``heartbeat_age_s``
+    is the last WIRE beat's age (BEAT frames feed the same .hb file)."""
     now = time.time() if now is None else now
     rows = []
     for leg in manifest.legs:
@@ -172,6 +192,11 @@ def status_rows(manifest: Manifest, now: float | None = None,
         prog = _ext_progress(manifest, leg, state_dir)
         if prog is not None:
             row["ext_blocks_done"], row["ext_blocks_total"] = prog
+        wire = _wire_provenance(leg, state_dir)
+        if wire is not None:
+            row["worker"] = wire.get("worker")
+            row["wire_dispatches"] = wire.get("dispatches")
+            row["speculations"] = wire.get("speculations")
         rows.append(row)
     return rows
 
@@ -234,8 +259,14 @@ def render_status(state_dir: str, integrity: str | None = None,
     done = sum(1 for r in rows if r["state"] == DONE)
     dispatches = sum(r["dispatches"] for r in rows)
 
+    # the remote columns appear only when some leg actually went over
+    # the worker wire (ISSUE 16) — a purely local run's table is
+    # byte-stable across this feature
+    remote = any("worker" in r for r in rows)
     head = f"{'LEG':<8} {'KIND':<7} {'STATE':<8} {'DISP':>4} " \
            f"{'ARTIFACT':>9} {'HEARTBEAT':>9} {'PROGRESS':>9}"
+    if remote:
+        head += f" {'WORKER':<21} {'WDISP':>5} {'SPEC':>4}"
     lines = [
         f"supervised tournament: {manifest.graph}",
         f"state dir: {state_dir}",
@@ -251,11 +282,17 @@ def render_status(state_dir: str, integrity: str | None = None,
         prog = "-"
         if "ext_blocks_done" in r:
             prog = f"{r['ext_blocks_done']}/{r['ext_blocks_total']}blk"
-        lines.append(
+        line = (
             f"{r['key']:<8} {r['kind']:<7} {r['state']:<8} "
             f"{r['dispatches']:>4} "
             f"{_fmt_bytes(r['artifact_bytes']):>9} "
             f"{_fmt_age(r['heartbeat_age_s']):>9} {prog:>9}")
+        if remote:
+            spec = r.get("speculations")
+            line += (f" {r.get('worker') or '-':<21} "
+                     f"{r.get('wire_dispatches') or '-':>5} "
+                     f"{spec if spec is not None else '-':>4}")
+        lines.append(line)
 
     usage = dir_usage(state_dir)
     free = disk_free(state_dir)

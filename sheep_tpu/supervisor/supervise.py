@@ -96,6 +96,19 @@ class SupervisorConfig:
     #: age at which a still-beating attempt gets a speculative twin
     #: (None = speculation off)
     speculate_after_s: float | None = None
+    #: remote build workers (ISSUE 16): (host, port) list the distext
+    #: job may ship hist/distmap legs to (env SHEEP_WORKER_ADDRS);
+    #: empty = single-host dispatch only
+    worker_addrs: list = field(default_factory=list)
+    #: wire heartbeat interval for remote legs (BEAT frames;
+    #: env SHEEP_WORKER_BEAT_S)
+    worker_beat_s: float = 1.0
+    #: wire-beat SILENCE age at which a remote leg gets a speculative
+    #: twin on another worker (env SHEEP_WORKER_SPECULATE_S; None = only
+    #: the generic speculate_after_s straggler rule applies).  Keyed on
+    #: the last beat, not the launch: a worker that streams BEATs for an
+    #: hour then goes mute is the failure shape this knob names.
+    worker_speculate_s: float | None = None
     max_retries: int = 3
     backoff_base_s: float = 0.05
     backoff_cap_s: float = 2.0
@@ -144,6 +157,15 @@ class SupervisorConfig:
         )
         if env.get("SHEEP_SPECULATE_S"):
             kw["speculate_after_s"] = float(env["SHEEP_SPECULATE_S"])
+        if env.get("SHEEP_WORKER_ADDRS"):
+            from ..serve.worker import parse_worker_addrs
+            kw["worker_addrs"] = parse_worker_addrs(
+                env["SHEEP_WORKER_ADDRS"])
+        kw["worker_beat_s"] = float(env.get("SHEEP_WORKER_BEAT_S", "1")
+                                    or 1)
+        if env.get("SHEEP_WORKER_SPECULATE_S"):
+            kw["worker_speculate_s"] = \
+                float(env["SHEEP_WORKER_SPECULATE_S"])
         kw.update(overrides)
         return cls(**kw)
 
@@ -719,17 +741,7 @@ class TournamentSupervisor:
                         att.handle.cancel()
                         self.events.append(("stale", key, att.number))
                         self._failed(att, "heartbeat deadline exceeded")
-                    elif (self.config.speculate_after_s is not None
-                          and now - att.started
-                          > self.config.speculate_after_s
-                          and len(self._running.get(key, [])) == 1
-                          and self._life.get(key, 0)
-                          < self.config.max_dispatches
-                          # the cores budget binds speculation too: a
-                          # twin that would oversubscribe the host only
-                          # slows the straggler it is meant to beat
-                          and (not self._slots()
-                               or self._inflight() < self._slots())):
+                    elif self._should_speculate(att, key, now):
                         self._launch(att.leg, now, speculative=True)
                 elif rc == 0:
                     self._complete(att)
@@ -739,6 +751,31 @@ class TournamentSupervisor:
                         and self.config.chaos.take_stop(att.leg.round,
                                                         att.leg.index):
                     self._die(att.leg)
+
+    def _should_speculate(self, att: _Attempt, key: str,
+                          now: float) -> bool:
+        """Launch a speculative twin for this still-running attempt?
+        Two triggers share the guards: the generic straggler rule
+        (``speculate_after_s`` since launch) and, for REMOTE attempts,
+        the silent-worker rule (``worker_speculate_s`` since the last
+        wire beat — a worker that beats for an hour then goes mute gets
+        its twin without waiting out the whole straggler age)."""
+        if (len(self._running.get(key, [])) != 1
+                or self._life.get(key, 0) >= self.config.max_dispatches
+                # the cores budget binds speculation too: a twin that
+                # would oversubscribe the host only slows the straggler
+                # it is meant to beat
+                or (self._slots()
+                    and self._inflight() >= self._slots())):
+            return False
+        s = self.config.speculate_after_s
+        if s is not None and now - att.started > s:
+            return True
+        ws = self.config.worker_speculate_s
+        if ws is not None and getattr(att.handle, "remote", False):
+            from .heartbeat import last_beat_s
+            return now - last_beat_s(att.hb, att.started) > ws
+        return False
 
     def _attempt_stale(self, att: _Attempt, now: float) -> bool:
         """Is this still-running attempt dead-by-silence?  Default: the

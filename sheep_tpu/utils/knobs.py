@@ -203,6 +203,21 @@ KNOBS: dict[str, Knob] = {k.name: k for k in [
     _K("SHEEP_FAULT_PLAN", "plan", "",
        "supervisor", "deterministic tournament chaos kind@round:leg "
        "(kill/corrupt/hang/stop)"),
+    # -- remote build workers (ISSUE 16) -----------------------------------
+    _K("SHEEP_WORKER_ADDRS", "list", "",
+       "worker", "remote build workers host:port the distext "
+       "supervisor may ship legs to (unset = local legs only)"),
+    _K("SHEEP_WORKER_BEAT_S", "float", "1",
+       "worker", "wire heartbeat interval for remote legs (BEAT "
+       "frames; feeds the same staleness machinery as local .hb "
+       "mtimes)"),
+    _K("SHEEP_WORKER_SPECULATE_S", "float", "",
+       "worker", "silent-wire age (since the last BEAT) at which a "
+       "remote leg gets a speculative twin; first finisher wins "
+       "(unset = generic SHEEP_SPECULATE_S only)"),
+    _K("SHEEP_WORKER_TRANSPORT", "str", "",
+       "worker", "pin the per-leg transport decision: ship / local "
+       "(unset = the planner prices network-ship vs local-disk)"),
     # -- io faults (ISSUE 5) -----------------------------------------------
     _K("SHEEP_IO_FAULT_PLAN", "plan", "",
        "io", "deterministic I/O fault plan kind@site:nth over the "
@@ -256,8 +271,9 @@ KNOBS: dict[str, Knob] = {k.name: k for k in [
        "replicate", "bounded-staleness refusal for follower reads "
        "(0 = serve any lag)"),
     _K("SHEEP_SERVE_NETFAULT_PLAN", "plan", "",
-       "replicate", "replication wire-fault plan "
-       "(drop/partition/slow/dup@repl|hb:nth)"),
+       "replicate", "network fault plan drop/partition/slow/dup at "
+       "the replication sites (repl/hb) and the worker-wire sites "
+       "(wleg/wbeat/wart)"),
     # -- router (ISSUE 11) -------------------------------------------------
     _K("SHEEP_ROUTE_CLUSTERS", "list", "",
        "route", "cluster member lists the router hashes tenants "

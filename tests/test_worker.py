@@ -1,0 +1,562 @@
+"""Multi-host distext (ISSUE 16): remote build workers over the fleet
+wire.  Covered here: the LEG/OK header grammars and their refusals, the
+transport pricer (``plan_transport`` — pin / default / priced both
+ways), the end-to-end remote build (2 in-process worker daemons, no
+shared state dir, tree bit-identical to the in-RAM oracle with every
+dispatch count exactly 1), the torn artifact-return property sweep (the
+worker->supervisor stream cut at EVERY frame boundary plus mid-payload
+offsets — nothing lands without a verified crc), the full worker-wire
+netfault sweep (drop/partition/slow/dup at wleg/wbeat/wart with exact
+dispatch counts), SHEEP_FAULT_PLAN chaos under the remote runner, wire
+BEAT frames feeding the local heartbeat file, silent-wire speculation
+with first-finisher-wins, the ``--status`` remote columns, and the
+worker METRICS scrape through ``sheep top``'s fleet view."""
+
+import json
+import os
+import socket
+import threading
+import time
+import zlib
+
+import pytest
+
+from sheep_tpu.core import build_forest, degree_sequence
+from sheep_tpu.io.edges import write_dat
+from sheep_tpu.io.trefile import write_tree
+from sheep_tpu.ops.distext import run_distext
+from sheep_tpu.plan import PROV_DEFAULT, PROV_FORCED, PROV_PRICED, \
+    plan_transport
+from sheep_tpu.serve import netfaults
+from sheep_tpu.serve.netfaults import NetFault, NetFaultPlan
+from sheep_tpu.serve.protocol import BadRequest, ServeClient
+from sheep_tpu.serve.worker import (WorkerDaemon, parse_leg_header,
+                                    parse_result_header,
+                                    parse_worker_addrs, payload_crc,
+                                    read_worker_addr)
+from sheep_tpu.supervisor import (InlineRunner, RemoteRunner,
+                                  SupervisorConfig, wire_status_path)
+
+pytestmark = pytest.mark.filterwarnings("ignore::UserWarning")
+
+
+@pytest.fixture
+def worker_env(monkeypatch):
+    for k in ("SHEEP_EXT_BLOCK", "SHEEP_EXT_STRATEGY", "SHEEP_MEM_BUDGET",
+              "SHEEP_DISK_BUDGET", "SHEEP_IO_FAULT_PLAN",
+              "SHEEP_FAULT_PLAN", "SHEEP_DISTEXT_LEGS", "SHEEP_LEG_CORES",
+              "SHEEP_WORKERS", "SHEEP_WORKER_ADDRS", "SHEEP_WORKER_BEAT_S",
+              "SHEEP_WORKER_SPECULATE_S", "SHEEP_WORKER_TRANSPORT",
+              "SHEEP_SERVE_NETFAULT_PLAN", "SHEEP_SPECULATE_S"):
+        monkeypatch.delenv(k, raising=False)
+    netfaults.clear_plan()
+    from sheep_tpu.io import faultfs
+    from sheep_tpu.runtime import clear_plan, reset_counters
+    faultfs.clear_plan()
+    clear_plan()
+    reset_counters()
+    yield monkeypatch
+    netfaults.clear_plan()
+    faultfs.clear_plan()
+    clear_plan()
+
+
+@pytest.fixture(scope="module")
+def graph(tmp_path_factory):
+    """One small graph + its oracle tree bytes, shared by the e2e
+    tests (building it is the slow part, not the wire)."""
+    from sheep_tpu.cli.graph2tree import _tree_sig
+    from sheep_tpu.utils.synth import rmat_edges
+    tmp = tmp_path_factory.mktemp("wgraph")
+    log_n = 9
+    tail, head = rmat_edges(log_n, 4 * (1 << log_n), seed=41)
+    path = str(tmp / "g.dat")
+    write_dat(path, tail, head)
+    seq = degree_sequence(tail, head)
+    want = build_forest(tail, head, seq)
+    oracle = str(tmp / "oracle.tre")
+    write_tree(oracle, want.parent, want.pst_weight, sig=_tree_sig(seq))
+    with open(oracle, "rb") as f:
+        return path, f.read()
+
+
+@pytest.fixture
+def workers(tmp_path):
+    """Two in-process worker daemons with separate state dirs — the
+    loopback stand-in for two hosts (nothing shared but the wire)."""
+    pair = [WorkerDaemon(str(tmp_path / f"w{i}")).start() for i in (1, 2)]
+    yield pair
+    for w in pair:
+        w.shutdown()
+
+
+def _remote_config(workers, **kw):
+    kw.setdefault("workers", 2)
+    kw.setdefault("poll_s", 0.01)
+    kw.setdefault("backoff_base_s", 0.0)
+    kw.setdefault("grammar", False)
+    kw.setdefault("worker_addrs", [w.address for w in workers])
+    kw.setdefault("worker_beat_s", 0.05)
+    return SupervisorConfig(**kw)
+
+
+def _run_remote(graph_path, state_dir, workers, **kw):
+    cfg = _remote_config(workers, **kw)
+    m = run_distext(graph_path, str(state_dir), cfg,
+                    runner=InlineRunner(0.05), legs=2)
+    with open(m.final_tree, "rb") as f:
+        return f.read(), m
+
+
+def _counts(manifest):
+    return {leg.key: leg.dispatches for leg in manifest.legs}
+
+
+# ---------------------------------------------------------------------------
+# wire grammars
+# ---------------------------------------------------------------------------
+
+
+def test_parse_worker_addrs():
+    assert parse_worker_addrs("") == []
+    assert parse_worker_addrs("127.0.0.1:7070") == [("127.0.0.1", 7070)]
+    assert parse_worker_addrs(" a:1 ,, b:2 ") == [("a", 1), ("b", 2)]
+    for bad in ("justhost", ":7070", "host:"):
+        with pytest.raises(ValueError):
+            parse_worker_addrs(bad)
+
+
+def test_parse_leg_header_accepts_well_formed():
+    job = parse_leg_header(
+        "LEG key=g00.hist kind=hist start=10 end=20 beat=0.5 "
+        "bytes=120 crc=7 seqbytes=0 seqcrc=0")
+    assert job["key"] == "g00.hist" and job["kind"] == "hist"
+    assert (job["start"], job["end"], job["bytes"]) == (10, 20, 120)
+    assert job["beat"] == 0.5
+
+
+@pytest.mark.parametrize("line", [
+    "PING",
+    "LEG kind=hist start=0 end=1 bytes=12 crc=0",         # no key
+    "LEG key=k kind=sort start=0 end=1 bytes=12 crc=0",   # bad kind
+    "LEG key=k kind=hist start=5 end=2 bytes=12 crc=0",   # bad range
+    "LEG key=k kind=hist start=0 end=2 bytes=12 crc=0",   # bytes != 12*n
+    "LEG key=k kind=hist start=0 end=x bytes=12 crc=0",   # non-numeric
+    "LEG key=k kind=distmap start=0 end=1 bytes=12 crc=0 seqbytes=0",
+])
+def test_parse_leg_header_refuses_garbage(line):
+    with pytest.raises(BadRequest):
+        parse_leg_header(line)
+
+
+def test_parse_result_header_err_is_typed_conn_loss():
+    """A worker's ERR (or stream garbage) funnels into the supervisor's
+    typed connection-loss retry path, not an unhandled parse error."""
+    good = parse_result_header(
+        "OK key=k sumbytes=1 sumcrc=2 bytes=3 crc=4 perfbytes=5 perfcrc=6")
+    assert good["bytes"] == 3 and good["perfcrc"] == 6
+    for bad in ("ERR legfail boom", "garbage", "OK key=k sumbytes=1"):
+        with pytest.raises(ConnectionError):
+            parse_result_header(bad)
+
+
+# ---------------------------------------------------------------------------
+# the transport pricer
+# ---------------------------------------------------------------------------
+
+
+def test_plan_transport_no_workers_defaults_local():
+    d = plan_transport(1 << 20, 4, 0)
+    assert d["transport"] == "local" and d["provenance"] == PROV_DEFAULT
+
+
+def test_plan_transport_pin_is_forced(monkeypatch):
+    for pin in ("ship", "local"):
+        d = plan_transport(1 << 20, 4, 2, pin=pin)
+        assert d["transport"] == pin and d["provenance"] == PROV_FORCED
+    monkeypatch.setenv("SHEEP_WORKER_TRANSPORT", "ship")
+    d = plan_transport(1 << 20, 4, 2)
+    assert d["transport"] == "ship" and d["provenance"] == PROV_FORCED
+    with pytest.raises(ValueError):
+        plan_transport(1 << 20, 4, 2, pin="carrier-pigeon")
+
+
+def test_plan_transport_prices_both_ways():
+    # 1 host core, 4 workers: shipping quarters the wave count and the
+    # saved waves outweigh the one wire crossing -> ship wins (2
+    # workers on 1 core is the exact TIE with these constants — wave
+    # savings equal the crossing — and a tie stays local)
+    tie = plan_transport(1 << 24, 4, 2, host_cores=1)
+    assert tie["transport"] == "local" and tie["ship_s"] == tie["local_s"]
+    d = plan_transport(1 << 24, 4, 4, host_cores=1)
+    assert d["transport"] == "ship" and d["provenance"] == PROV_PRICED
+    assert d["ship_s"] < d["local_s"]
+    # plenty of local cores, 1 worker: same wave count both sides, the
+    # wire crossing is pure overhead -> local wins (strictly-cheaper
+    # rule: a tie must stay local too)
+    d = plan_transport(1 << 24, 4, 1, host_cores=8)
+    assert d["transport"] == "local" and d["provenance"] == PROV_PRICED
+    assert d["ship_s"] >= d["local_s"]
+
+
+# ---------------------------------------------------------------------------
+# end to end over the wire
+# ---------------------------------------------------------------------------
+
+
+def test_remote_build_bit_identical(graph, workers, tmp_path, worker_env):
+    """2 worker daemons, separate state dirs, nothing shared with the
+    supervisor: the final tree is byte-identical to the in-RAM oracle,
+    every leg dispatched exactly once, and each shipped leg's artifact
+    + provenance are where the design says."""
+    path, oracle = graph
+    worker_env.setenv("SHEEP_WORKER_TRANSPORT", "ship")
+    got, m = _run_remote(path, tmp_path / "sup", workers)
+    assert got == oracle
+    assert all(n == 1 for n in _counts(m).values()), _counts(m)
+    # the hist/distmap legs went over the wire: provenance JSON per leg
+    wires = [f for f in os.listdir(tmp_path / "sup")
+             if f.startswith("wire-")]
+    assert len(wires) == 4, wires  # 2 hist + 2 distmap legs
+    for w in workers:
+        made = os.listdir(w.state_dir)
+        assert any(f.endswith(".slice.dat") for f in made), made
+    row = json.load(open(wire_status_path(str(tmp_path / "sup"),
+                                          m.legs[0].output)))
+    assert row["dispatches"] == 1 and row["speculations"] == 0
+    assert row["worker"].startswith("127.0.0.1:")
+
+
+def test_remote_config_from_env(worker_env, workers):
+    h1, p1 = workers[0].address
+    h2, p2 = workers[1].address
+    worker_env.setenv("SHEEP_WORKER_ADDRS", f"{h1}:{p1},{h2}:{p2}")
+    worker_env.setenv("SHEEP_WORKER_BEAT_S", "0.25")
+    worker_env.setenv("SHEEP_WORKER_SPECULATE_S", "3.5")
+    cfg = SupervisorConfig.from_env()
+    assert cfg.worker_addrs == [(h1, p1), (h2, p2)]
+    assert cfg.worker_beat_s == 0.25
+    assert cfg.worker_speculate_s == 3.5
+
+
+def test_transport_pin_local_keeps_legs_local(graph, workers, tmp_path,
+                                              worker_env):
+    """SHEEP_WORKER_TRANSPORT=local with workers configured: the pin
+    wins, no leg touches the wire."""
+    path, oracle = graph
+    worker_env.setenv("SHEEP_WORKER_TRANSPORT", "local")
+    got, m = _run_remote(path, tmp_path / "sup", workers)
+    assert got == oracle
+    assert not [f for f in os.listdir(tmp_path / "sup")
+                if f.startswith("wire-")]
+    for w in workers:
+        assert not [f for f in os.listdir(w.state_dir)
+                    if f.endswith(".slice.dat")]
+
+
+# ---------------------------------------------------------------------------
+# torn artifact return: the property sweep
+# ---------------------------------------------------------------------------
+
+
+def _result_stream(key, sum_bytes, art_bytes, perf_bytes):
+    head = (f"OK key={key} sumbytes={len(sum_bytes)} "
+            f"sumcrc={payload_crc(sum_bytes)} bytes={len(art_bytes)} "
+            f"crc={payload_crc(art_bytes)} perfbytes={len(perf_bytes)} "
+            f"perfcrc={payload_crc(perf_bytes)}\n").encode("ascii")
+    return head, head + sum_bytes + art_bytes + perf_bytes
+
+
+def _fake_handle(tmp_path, spec):
+    """A _RemoteHandle shell wired for _receive alone (no session
+    thread): the unit under test is the admission gate."""
+    from sheep_tpu.supervisor.remote import _RemoteHandle
+
+    class _R:
+        def attempt_done(self, final):
+            pass
+
+    h = _RemoteHandle.__new__(_RemoteHandle)
+    h._runner = _R()
+    h._spec = spec
+    h._hb = str(tmp_path / "a.hb")
+    h._log = str(tmp_path / "a.log")
+    h._rc = None
+    h._lock = threading.Lock()
+    h._socks = []
+    h.cancelled = False
+    h.worker = "test:0"
+    return h
+
+
+def _feed(handle, spec, stream):
+    a, b = socket.socketpair()
+    try:
+        a.sendall(stream)
+        a.shutdown(socket.SHUT_WR)
+        handle._receive(b, spec)
+    finally:
+        a.close()
+        b.close()
+
+
+def test_torn_return_cut_everywhere_admits_nothing(tmp_path):
+    """Cut the worker's result stream at EVERY frame boundary and at
+    offsets inside each payload: no prefix lands a single byte at the
+    attempt temp, every cut is the typed conn-loss failure, and only
+    the complete stream admits — crc-verified, bytes intact."""
+    sum_bytes = b"sheep-sum 1\nalgo crc32\nsize 96\nsum DEADBEEF\n"
+    art_bytes = os.urandom(96)
+    perf_bytes = json.dumps({"perf": {}}).encode()
+    tmp = str(tmp_path / "leg.tre.a1")
+    spec = {"kind": "hist", "graph": "g", "seq": None, "out": tmp,
+            "perf": None, "start": 0, "end": 8, "final": tmp[:-3],
+            "attempt": 1, "key": "leg.tre"}
+    head, stream = _result_stream(spec["key"], sum_bytes, art_bytes,
+                                  perf_bytes)
+    # every frame boundary + offsets inside every span
+    cuts = sorted({0, 1, len(head) - 1, len(head),
+                   len(head) + len(sum_bytes) // 2,
+                   len(head) + len(sum_bytes),
+                   len(head) + len(sum_bytes) + 1,
+                   len(head) + len(sum_bytes) + len(art_bytes) // 2,
+                   len(head) + len(sum_bytes) + len(art_bytes),
+                   len(stream) - 1})
+    for cut in cuts:
+        assert cut < len(stream)
+        h = _fake_handle(tmp_path, spec)
+        with pytest.raises(ConnectionError):
+            _feed(h, spec, stream[:cut])
+        assert h.poll() is None  # the session loop owns the rc
+        assert not os.path.exists(tmp), cut
+        assert not os.path.exists(tmp + ".sum"), cut
+        assert not os.path.exists(tmp + ".fetch"), cut
+    # a complete stream with ONE flipped artifact byte: refused whole
+    flipped = bytearray(stream)
+    flipped[len(head) + len(sum_bytes) + 5] ^= 0xFF
+    h = _fake_handle(tmp_path, spec)
+    with pytest.raises(ConnectionError):
+        _feed(h, spec, bytes(flipped))
+    assert not os.path.exists(tmp)
+    # the complete, untampered stream admits bytes-intact
+    h = _fake_handle(tmp_path, spec)
+    _feed(h, spec, stream)
+    assert h.poll() == 0
+    with open(tmp, "rb") as f:
+        assert f.read() == art_bytes
+    with open(tmp + ".sum", "rb") as f:
+        assert f.read() == sum_bytes
+
+
+def test_torn_return_end_to_end_redispatches_exactly_once(
+        graph, workers, tmp_path, worker_env):
+    """The acceptance property on the REAL wire: tear the first
+    artifact return mid-payload (partition@wart) — the crc gate refuses
+    it, exactly one leg re-dispatches, the final tree is bit-identical."""
+    path, oracle = graph
+    worker_env.setenv("SHEEP_WORKER_TRANSPORT", "ship")
+    netfaults.install_plan(netfaults.parse_netfault_plan(
+        "partition@wart:0"))
+    got, m = _run_remote(path, tmp_path / "sup", workers, deadline_s=5.0)
+    assert got == oracle
+    counts = _counts(m)
+    assert sorted(counts.values()) == [1, 1, 1, 1, 1, 2], counts
+
+
+# ---------------------------------------------------------------------------
+# the worker-wire netfault sweep
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("kind,site,redispatch", [
+    ("drop", "wleg", True),        # job never arrives; staleness fires
+    ("partition", "wleg", True),   # link dies before dispatch
+    ("slow", "wleg", False),       # latency, not loss
+    ("dup", "wleg", False),        # twin delivery; first finisher wins
+    ("partition", "wbeat", True),  # link dies mid-leg
+    ("drop", "wart", True),        # result never sent
+    ("partition", "wart", True),   # torn mid-payload; crc refuses
+    ("slow", "wart", False),
+    ("dup", "wart", False),        # double delivery; second discarded
+])
+def test_netfault_sweep_exact_counts(graph, workers, tmp_path, worker_env,
+                                     kind, site, redispatch):
+    path, oracle = graph
+    worker_env.setenv("SHEEP_WORKER_TRANSPORT", "ship")
+    netfaults.install_plan(netfaults.parse_netfault_plan(
+        f"{kind}@{site}:0"))
+    got, m = _run_remote(path, tmp_path / "sup", workers, deadline_s=1.0)
+    assert got == oracle, (kind, site)
+    counts = _counts(m)
+    want = [1, 1, 1, 1, 1, 2] if redispatch else [1] * 6
+    assert sorted(counts.values()) == want, (kind, site, counts)
+
+
+def test_chaos_plan_applies_to_remote_legs(graph, workers, tmp_path,
+                                           worker_env):
+    """SHEEP_FAULT_PLAN kill/corrupt/hang fire at dispatch sites ahead
+    of the runner seam, so the chaos story is IDENTICAL under remote
+    dispatch: one hurt leg, one re-dispatch, bit-identical tree."""
+    from sheep_tpu.supervisor import parse_fault_plan
+    path, oracle = graph
+    worker_env.setenv("SHEEP_WORKER_TRANSPORT", "ship")
+    for kind in ("kill", "corrupt", "hang"):
+        kw = dict(chaos=parse_fault_plan(f"{kind}@-2:0"),
+                  deadline_s=5.0)
+        if kind == "hang":
+            kw.update(deadline_s=1e9, stale_after_polls=25)
+        got, m = _run_remote(path, tmp_path / f"sup-{kind}", workers, **kw)
+        assert got == oracle, kind
+        counts = _counts(m)
+        assert counts["h.00"] == 2, (kind, counts)
+        assert sorted(counts.values()) == [1, 1, 1, 1, 1, 2], (kind,
+                                                               counts)
+
+
+# ---------------------------------------------------------------------------
+# heartbeats + speculation over the wire
+# ---------------------------------------------------------------------------
+
+
+def test_beat_frames_touch_local_hb(tmp_path):
+    """BEAT frames relay into the attempt's local .hb file — the mtime
+    the existing staleness machinery polls."""
+    sum_bytes = b"s"
+    art_bytes = b"a" * 8
+    tmp = str(tmp_path / "x.hist.a1")
+    spec = {"kind": "hist", "graph": "g", "seq": None, "out": tmp,
+            "perf": None, "start": 0, "end": 1, "final": tmp[:-3],
+            "attempt": 1, "key": "x.hist"}
+    _, stream = _result_stream(spec["key"], sum_bytes, art_bytes, b"")
+    h = _fake_handle(tmp_path, spec)
+    assert not os.path.exists(h._hb)
+    _feed(h, spec, b"BEAT key=x.hist\nBEAT key=x.hist\n" + stream)
+    assert h.poll() == 0
+    assert os.path.exists(h._hb)  # the wire beat became a local mtime
+
+
+def test_silent_wire_speculates_first_finisher_wins(
+        graph, workers, tmp_path, worker_env):
+    """A worker silently wedged mid-leg (the link stays open, no BEAT
+    lands, no result comes) draws a speculative twin after
+    ``worker_speculate_s``; the twin's artifact wins the first-finisher
+    arbitration and the tree is bit-identical."""
+    path, oracle = graph
+    worker_env.setenv("SHEEP_WORKER_TRANSPORT", "ship")
+    # wedge the FIRST leg that lands on worker 1 (it stalls 5s before
+    # executing) and drop every wire beat: from the supervisor's side
+    # that worker is silent but connected — neither the staleness nor
+    # the conn-loss path can see it, only the silent-wire rule
+    wedged = workers[0]
+    orig_run = wedged._run_leg
+    hits = []
+
+    def stall_once(job, slice_bytes, seq_bytes):
+        if not hits:
+            hits.append(job["key"])
+            time.sleep(5.0)
+        return orig_run(job, slice_bytes, seq_bytes)
+
+    wedged._run_leg = stall_once
+    netfaults.install_plan(NetFaultPlan(
+        faults=[NetFault("drop", "wbeat", i) for i in range(500)]))
+    got, m = _run_remote(path, tmp_path / "sup", workers,
+                         deadline_s=1e9, worker_speculate_s=0.3)
+    assert got == oracle
+    counts = _counts(m)
+    assert sorted(counts.values()) == [1, 1, 1, 1, 1, 2], counts
+    hurt = next(k for k, v in counts.items() if v == 2)
+    row = json.load(open(wire_status_path(
+        str(tmp_path / "sup"),
+        next(leg.output for leg in m.legs if leg.key == hurt))))
+    assert row["speculations"] >= 1
+    assert row["dispatches"] == 2
+
+
+# ---------------------------------------------------------------------------
+# observability: --status columns + METRICS / sheep top
+# ---------------------------------------------------------------------------
+
+
+def test_status_shows_remote_legs(graph, workers, tmp_path, worker_env):
+    from sheep_tpu.supervisor.status import render_status, status_rows
+    from sheep_tpu.supervisor.manifest import load_manifest
+    path, oracle = graph
+    worker_env.setenv("SHEEP_WORKER_TRANSPORT", "ship")
+    _, m = _run_remote(path, tmp_path / "sup", workers)
+    state_dir = str(tmp_path / "sup")
+    rows = status_rows(load_manifest(state_dir), state_dir=state_dir)
+    shipped = [r for r in rows if "worker" in r]
+    assert len(shipped) == 4  # 2 hist + 2 distmap legs went remote
+    for r in shipped:
+        assert r["worker"].startswith("127.0.0.1:")
+        assert r["wire_dispatches"] == 1 and r["speculations"] == 0
+    text = render_status(state_dir)
+    assert "WORKER" in text and "WDISP" in text and "SPEC" in text
+    assert shipped[0]["worker"] in text
+    # merge legs stayed local: their wire columns render as dashes
+    merge_row = next(line for line in text.splitlines()
+                     if line.startswith("r1.00"))
+    assert merge_row.rstrip().endswith("-")
+
+
+def test_status_table_unchanged_without_remote_legs(graph, tmp_path,
+                                                    worker_env):
+    """A purely local run's table gains no columns — the feature is
+    invisible until a leg actually ships."""
+    from sheep_tpu.supervisor.status import render_status
+    path, _ = graph
+    cfg = SupervisorConfig(workers=2, poll_s=0.01, backoff_base_s=0.0,
+                           grammar=False)
+    run_distext(path, str(tmp_path / "sup"), cfg,
+                runner=InlineRunner(0.05), legs=2)
+    text = render_status(str(tmp_path / "sup"))
+    assert "WORKER" not in text and "SPEC" not in text
+
+
+def test_worker_metrics_scrape_and_top_view(graph, workers, tmp_path,
+                                            worker_env):
+    """Each worker answers METRICS with sheep_worker_* plus the process
+    gauges, sheep top's fleet view gives them a workers section, and
+    ``top -d <worker-state-dir>`` resolves worker.addr."""
+    from sheep_tpu.cli.top import fleet_view, resolve_addr
+    from sheep_tpu.obs.metrics import parse_prometheus
+    path, _ = graph
+    worker_env.setenv("SHEEP_WORKER_TRANSPORT", "ship")
+    _run_remote(path, tmp_path / "sup", workers)
+    host, port = workers[0].address
+    assert resolve_addr(None, workers[0].state_dir) == (host, port)
+    assert read_worker_addr(workers[0].state_dir) == (host, port)
+    with ServeClient(host, port, timeout_s=5.0) as c:
+        body = c.metrics()
+    samples = parse_prometheus(body)
+    names = {name for name, _, _ in samples}
+    assert {"sheep_worker_legs_inflight", "sheep_worker_legs_done",
+            "sheep_worker_bytes_shipped"} <= names
+    assert "sheep_process_vmrss_bytes" in names
+    view = fleet_view(samples)
+    w = view["workers"]["local"]
+    assert w["legs_done"] >= 1 and w["legs_inflight"] == 0
+    assert w["bytes_shipped"] > 0
+    assert w["vmrss_mb"] > 0
+
+
+def test_remote_runner_requires_addrs():
+    with pytest.raises(ValueError):
+        RemoteRunner([])
+
+
+def test_remote_runner_delegates_non_distext_argv(tmp_path):
+    """merge/copy/histsum argv fall through to the base runner — only
+    hist/map legs are shippable."""
+    calls = []
+
+    class _Base:
+        def start(self, argv, hb, log):
+            calls.append(argv)
+            return "local-handle"
+
+    r = RemoteRunner([("127.0.0.1", 1)], base=_Base())
+    out = r.start(["merge_trees", "a.tre", "b.tre", "-o", "c.tre.a1"],
+                  str(tmp_path / "hb"), str(tmp_path / "log"))
+    assert out == "local-handle" and len(calls) == 1

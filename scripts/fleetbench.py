@@ -1,0 +1,313 @@
+"""FLEETBENCH: live migration + the self-rebalancing fleet (ISSUE 17).
+
+A REAL fleet over real sockets — two single-node clusters behind a
+``bin/route`` process with ``SHEEP_REBALANCE=1`` — hosting a skewed
+tenant mix: one HOT tenant taking the bulk of the traffic, a warm
+tenant sharing its cluster, a cold tenant on the other side.  The
+rebalancer's own verdict (scrape -> fold -> decide -> MIGRATE) moves
+the hot tenant to the cool cluster WHILE sustained insert + read
+traffic runs through the router, and the record proves the cutover
+honest:
+
+  acked_lost                MUST be 0: the writer counts every OK; the
+                            final owner's applied seqno equals the
+                            acked count EXACTLY (an acked batch lost
+                            would read low, a double-applied replay
+                            would read high — equality is both
+                            invariants at once)
+  window_p99_ms             read p99 per 0.5 s window through the whole
+                            run, cutover included — the "bounded p99
+                            through cutover" acceptance column (worst
+                            window asserted under FLEETBENCH_P99_BOUND_MS,
+                            default 2000)
+  migration_s               rebalancer verdict -> phase done, off the
+                            router's own sheep_migrate_* gauges
+  verdicts                  sheep_rebalance_verdicts_total by action —
+                            hysteresis means hold verdicts dominate
+
+The record embeds ``env_capture`` and per-process ``_proc_capture``
+accounting (daemons, router, client loops) like every bench artifact
+since SERVEBENCH_r03, so the record itself proves who ran where.
+
+Usage: python scripts/fleetbench.py [graph] [out.json].  Defaults:
+data/hep-th.dat, FLEETBENCH_r01.json at the repo root.  Knobs:
+FLEETBENCH_RUN_S (traffic floor before the verdict window, default 2),
+FLEETBENCH_DEADLINE_S (migration deadline, default 180).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import statistics
+import subprocess
+import sys
+import threading
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from sheep_tpu.obs.metrics import parse_prometheus  # noqa: E402
+from sheep_tpu.serve.protocol import ServeClient, ServeError, \
+    connect_retry  # noqa: E402
+from sheep_tpu.serve.router import HashRing  # noqa: E402
+from sheep_tpu.utils.envinfo import env_capture  # noqa: E402
+
+
+def _spawn(state_dir, *args, env_extra=None, module="sheep_tpu.cli.serve"):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env.update(env_extra or {})
+    return subprocess.Popen(
+        [sys.executable, "-m", module, "-d", state_dir, *args],
+        stdout=subprocess.DEVNULL, stderr=subprocess.STDOUT, env=env,
+        cwd=REPO)
+
+
+def _proc_capture(pid) -> dict:
+    from sheep_tpu.obs.metrics import proc_status
+    return proc_status(pid)
+
+
+def _router_addr(route_d, timeout=300.0):
+    deadline = time.monotonic() + timeout
+    path = os.path.join(route_d, "router.addr")
+    while time.monotonic() < deadline:
+        try:
+            host, port = open(path).read().split()
+            return host, int(port)
+        except (OSError, ValueError):
+            time.sleep(0.1)
+    raise TimeoutError("router.addr never appeared")
+
+
+def _ring_name(prefix: str, cluster: str) -> str:
+    ring = HashRing(["c0", "c1"])
+    return next(f"{prefix}{i}" for i in range(256)
+                if ring.lookup(f"{prefix}{i}") == cluster)
+
+
+def _scrape_gauges(host, port) -> dict:
+    """One router fan-in scrape folded to the handful of fleet gauges
+    the bench steers by."""
+    out = {"completed": 0, "aborted": 0, "inflight": 0, "verdicts": {}}
+    with ServeClient(host, port, timeout_s=30) as c:
+        samples = parse_prometheus(c.metrics())
+    for name, labels, val in samples:
+        if name == "sheep_migrate_completed":
+            out["completed"] = int(val)
+        elif name == "sheep_migrate_aborted":
+            out["aborted"] = int(val)
+        elif name == "sheep_migrate_inflight":
+            out["inflight"] = int(val)
+        elif name == "sheep_rebalance_verdicts_total":
+            out["verdicts"][labels.get("action", "?")] = int(val)
+    return out
+
+
+def fleetbench(graph: str, out: str) -> int:
+    from sheep_tpu.io.edges import load_edges
+
+    run_floor_s = float(os.environ.get("FLEETBENCH_RUN_S", "2"))
+    deadline_s = float(os.environ.get("FLEETBENCH_DEADLINE_S", "180"))
+    p99_bound_ms = float(os.environ.get("FLEETBENCH_P99_BOUND_MS",
+                                        "2000"))
+    import tempfile
+    work = tempfile.mkdtemp(prefix="fleetbench-")
+    el = load_edges(graph)
+    max_vid = el.max_vid
+    vids = list(range(0, max_vid + 1, max(1, (max_vid + 1) // 2048)))
+
+    ring = HashRing(["c0", "c1"])
+    hot = "hot"
+    src = ring.lookup(hot)
+    dst = "c1" if src == "c0" else "c0"
+    warm = _ring_name("warm", src)   # keeps a remainder on src, so
+    cold = _ring_name("cold", dst)   # moving HOT strictly shrinks
+    placement = {hot: src, warm: src, cold: dst}
+    rec = {"bench": "FLEETBENCH", "round": 1, "graph": graph,
+           "records": el.num_edges, "tenants": placement,
+           "hot_tenant": hot, "src": src, "dst": dst,
+           "env": env_capture()}
+
+    # -- the fleet: 2 standalone clusters + the self-rebalancing router --
+    procs: dict[str, subprocess.Popen] = {}
+    dirs = {}
+    t0 = time.perf_counter()
+    for cid in ("c0", "c1"):
+        d = os.path.join(work, cid)
+        dirs[cid] = d
+        tflags = []
+        for t, c in placement.items():
+            if c == cid:
+                tflags += ["--tenant",
+                           f"{t}={os.path.join(work, cid + '-' + t)}"
+                           f":{graph}:8"]
+        procs[cid] = _spawn(d, "-g", graph, "-k", "8", *tflags)
+    route_d = os.path.join(work, "router")
+    procs["router"] = _spawn(
+        route_d, "--cluster", f"c0@{dirs['c0']}",
+        "--cluster", f"c1@{dirs['c1']}",
+        module="sheep_tpu.cli.route",
+        env_extra={"SHEEP_REBALANCE": "1",
+                   "SHEEP_REBALANCE_INTERVAL_S": "0.5",
+                   "SHEEP_REBALANCE_MIN_QPS": "2",
+                   "SHEEP_REBALANCE_HYSTERESIS": "1.2",
+                   "SHEEP_REBALANCE_COOLDOWN_S": "5"})
+    rh, rp = _router_addr(route_d)
+    c = connect_retry(rh, rp, timeout_s=300)
+    for t in placement:  # every tenant answers through the router
+        deadline = time.monotonic() + 300
+        while time.monotonic() < deadline:
+            try:
+                c.tenant(t)
+                c.kv("STATS")
+                break
+            except ServeError:
+                time.sleep(0.2)
+    rec["fleet_start_s"] = round(time.perf_counter() - t0, 3)
+
+    # -- sustained skewed traffic: writer + reader on HOT, trickles on
+    # the others; the rebalancer must act while this runs -----------------
+    stop = threading.Event()
+    acked = {t: 0 for t in placement}
+    refusals = {"write": 0, "read": 0}
+    read_lat = []  # (t_monotonic, latency_ms)
+
+    def writer(tenant, pause_s):
+        with ServeClient(rh, rp, timeout_s=60) as wc:
+            wc.tenant(tenant)
+            i = 0
+            while not stop.is_set():
+                u = (11 * i) % (max_vid + 1)
+                v = (29 * i + 3) % (max_vid + 1)
+                try:
+                    wc.insert([(u, v)])
+                    acked[tenant] += 1
+                    i += 1
+                except (ServeError, ConnectionError, OSError):
+                    # typed refusal / dead conn = NOT applied; the
+                    # SAME pair retries, so equality stays exact
+                    refusals["write"] += 1
+                    time.sleep(0.02)
+                time.sleep(pause_s)
+
+    def reader():
+        with ServeClient(rh, rp, timeout_s=60) as rc:
+            rc.tenant(hot)
+            i = 0
+            while not stop.is_set():
+                batch = [vids[(i * 16 + j) % len(vids)]
+                         for j in range(16)]
+                t1 = time.perf_counter()
+                try:
+                    rc.part(batch)
+                    read_lat.append((time.monotonic(),
+                                     (time.perf_counter() - t1) * 1000))
+                except (ServeError, ConnectionError, OSError):
+                    refusals["read"] += 1
+                    time.sleep(0.02)
+                i += 1
+
+    threads = [threading.Thread(target=writer, args=(hot, 0.002),
+                                daemon=True),
+               threading.Thread(target=writer, args=(warm, 0.01),
+                                daemon=True),
+               threading.Thread(target=writer, args=(cold, 0.1),
+                                daemon=True),
+               threading.Thread(target=reader, daemon=True)]
+    bench_t0 = time.monotonic()
+    for th in threads:
+        th.start()
+    time.sleep(run_floor_s)
+    rec["procs"] = {name: _proc_capture(p.pid)
+                    for name, p in procs.items()}
+    rec["procs"]["client"] = _proc_capture(os.getpid())
+
+    # -- wait for the rebalancer's OWN migration to complete -------------
+    mig_deadline = time.monotonic() + deadline_s
+    gauges = None
+    while time.monotonic() < mig_deadline:
+        gauges = _scrape_gauges(rh, rp)
+        if gauges["completed"] >= 1:
+            break
+        time.sleep(0.5)
+    assert gauges and gauges["completed"] >= 1, \
+        f"rebalancer never migrated within {deadline_s}s: {gauges}"
+    rec["migration_s"] = round(time.monotonic() - bench_t0, 3)
+    time.sleep(1.0)  # post-cutover traffic through the new home
+    stop.set()
+    for th in threads:
+        th.join(timeout=30)
+    rec["verdicts"] = gauges["verdicts"]
+    rec["migrations_aborted"] = gauges["aborted"]
+    rec["acked_per_tenant"] = dict(acked)
+    rec["refusals"] = dict(refusals)
+    rec["reads_total"] = len(read_lat)
+
+    # -- zero acked loss, EXACT: applied on the final owner == acks ------
+    applied = {}
+    with ServeClient(rh, rp, timeout_s=60) as vc:
+        for t in placement:
+            vc.tenant(t)
+            applied[t] = vc.kv("STATS")["applied_seqno"]
+        router_stats = vc.kv("ROUTER")
+    rec["applied_per_tenant"] = applied
+    rec["acked_lost"] = acked[hot] - applied[hot]
+    assert applied[hot] == acked[hot], \
+        f"cutover broke exactness on {hot}: applied {applied[hot]} " \
+        f"!= acked {acked[hot]} (loss if low, double-apply if high)"
+    assert router_stats.get("migrations_completed", 0) >= 1
+    rec["router_stats"] = {
+        k: router_stats[k] for k in sorted(router_stats)
+        if k in ("requests", "reads", "writes", "retries", "reroutes",
+                 "moved_reroutes", "errors", "migrations_completed",
+                 "migrations_aborted")}
+
+    # -- bounded p99 through cutover: p99 per 0.5 s window ---------------
+    windows: dict[int, list] = {}
+    for at, ms in read_lat:
+        windows.setdefault(int((at - bench_t0) / 0.5), []).append(ms)
+    wp99 = []
+    for w in sorted(windows):
+        lat = sorted(windows[w])
+        wp99.append(round(lat[min(len(lat) - 1,
+                                  int(0.99 * len(lat)))], 3))
+    rec["window_p99_ms"] = wp99
+    rec["worst_window_p99_ms"] = max(wp99) if wp99 else None
+    rec["median_window_p99_ms"] = round(statistics.median(wp99), 3) \
+        if wp99 else None
+    assert wp99 and max(wp99) < p99_bound_ms, \
+        f"read p99 unbounded through cutover: {max(wp99)}ms " \
+        f">= {p99_bound_ms}ms"
+
+    for name, p in procs.items():
+        p.send_signal(signal.SIGTERM)
+    for name, p in procs.items():
+        try:
+            p.wait(timeout=60)
+        except subprocess.TimeoutExpired:
+            p.kill()
+
+    with open(out, "w") as f:
+        json.dump(rec, f, indent=1)
+        f.write("\n")
+    print(json.dumps({k: v for k, v in rec.items()
+                      if k not in ("env", "procs")}, indent=1))
+    print(f"fleetbench: record written to {out}")
+    return 0
+
+
+def main() -> int:
+    args = sys.argv[1:]
+    graph = args[0] if len(args) > 0 \
+        else os.path.join(REPO, "data", "hep-th.dat")
+    out = args[1] if len(args) > 1 \
+        else os.path.join(REPO, "FLEETBENCH_r01.json")
+    return fleetbench(graph, out)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -22,7 +22,11 @@ Options:
                    SHEEP_ROUTE_VNODES)
 
 Env: SHEEP_ROUTE_CLUSTERS (";"-separated clusters of ","-separated
-peers), SHEEP_ROUTE_VNODES.
+peers), SHEEP_ROUTE_VNODES.  SHEEP_REBALANCE=1 additionally starts the
+self-rebalancer (serve/rebalance.py): the router watches its own fleet
+scrape and live-migrates the busiest tenant off a sustained-hot
+cluster — hysteresis, min-qps, one-migration-at-a-time, and a cooldown
+keep it from flapping (SHEEP_REBALANCE_* knobs).
 
 Exit codes: 0 clean shutdown, 1 startup failure, 2 usage error.
 """
@@ -89,7 +93,17 @@ def main(argv: list[str] | None = None) -> int:
     print(f"route: ready clusters={len(clusters)} "
           f"({', '.join(sorted(clusters))})", flush=True)
 
+    from ..serve import rebalance
+    if rebalance.enabled():
+        router.rebalancer = rebalance.Rebalancer(router).start()
+        print(f"route: rebalancer on (interval "
+              f"{router.rebalancer.interval_s:g}s, hysteresis "
+              f"{router.rebalancer.hysteresis:g}x, cooldown "
+              f"{router.rebalancer.cooldown_s:g}s)", flush=True)
+
     def _term(signum, frame):
+        if router.rebalancer is not None:
+            router.rebalancer.stop()
         router.shutdown()
 
     signal.signal(signal.SIGTERM, _term)

@@ -13,8 +13,12 @@ Columns (per tenant): the hosting cluster, current qps (counter delta
 between two scrapes), windowed p99 (the sliding-window gauge — CURRENT
 latency, not since-boot), max replication lag and epoch across the
 instances hosting the tenant, and how many instances hold it resident.
-An ``instances`` footer shows per-instance epoch/lag/RSS from the same
-scrape.
+While a live migration is visible (ISSUE 17) two extra columns appear:
+``MIG`` (snap/delta/cutover on the adopting target, ``moved`` on the
+fenced source) and ``DLAG`` (delta-stream records the target still
+trails by); the footer adds the router's migration tallies and, when
+the rebalancer is on, its go/hold verdict counts.  An ``instances``
+footer shows per-instance epoch/lag/RSS from the same scrape.
 
 ``--json`` takes two scrapes ``-i`` seconds apart (default 1.0; 0 =
 single scrape, qps null) and prints one JSON object — what the tier-1
@@ -79,7 +83,7 @@ def fleet_view(samples) -> dict:
         return tenants.setdefault(
             t, {"instances": [], "resident_on": [], "requests": 0.0,
                 "window_p99_ms": None, "applied_seqno": 0,
-                "cluster": None})
+                "cluster": None, "mig": None, "mig_lag": None})
 
     for name, labels, val in samples:
         inst = labels.get("instance")
@@ -124,6 +128,20 @@ def fleet_view(samples) -> dict:
             if rec is not None:
                 rec["applied_seqno"] = max(rec["applied_seqno"],
                                            int(val))
+        elif name == "sheep_serve_mig_phase" and val >= 1:
+            # migration visibility (ISSUE 17): a member reporting
+            # snap/delta (target adopting in) wins over the source's
+            # "moved" when both show up in one scrape
+            rec = tn(labels)
+            if rec is not None:
+                phase = labels.get("phase", "?")
+                if rec["mig"] is None or phase != "moved":
+                    rec["mig"] = phase
+        elif name in ("sheep_serve_mig_delta_lag_records",
+                      "sheep_migrate_delta_lag_records"):
+            rec = tn(labels)
+            if rec is not None:
+                rec["mig_lag"] = max(rec["mig_lag"] or 0, int(val))
         elif name == "sheep_worker_legs_inflight":
             wk(labels)["legs_inflight"] = int(val)
         elif name == "sheep_worker_legs_done":
@@ -161,6 +179,15 @@ def fleet_view(samples) -> dict:
                 labels.get("cluster", "?")] = int(val)
         elif name == "sheep_fleet_scrape_seconds":
             fleet["scrape_s"] = val
+        elif name == "sheep_migrate_inflight":
+            fleet["migrate_inflight"] = int(val)
+        elif name == "sheep_migrate_completed":
+            fleet["migrate_completed"] = int(val)
+        elif name == "sheep_migrate_aborted":
+            fleet["migrate_aborted"] = int(val)
+        elif name == "sheep_rebalance_verdicts_total":
+            fleet.setdefault("rebalance_verdicts", {})[
+                labels.get("action", "?")] = int(val)
     return {"tenants": tenants, "instances": instances, "fleet": fleet,
             "workers": workers}
 
@@ -174,18 +201,29 @@ def qps_between(prev: dict, cur: dict, dt: float) -> None:
 
 
 def render_table(view: dict, scrape_bytes: int) -> str:
+    # the MIG/DLAG columns only appear while a migration is visible in
+    # the scrape (the remote-worker-columns discipline: byte-stable
+    # output for fleets that never migrate)
+    migrating = any(rec.get("mig") for rec in view["tenants"].values())
     head = (f"{'TENANT':<12} {'CLUSTER':<8} {'QPS':>8} {'P99w':>9} "
             f"{'LAG':>5} {'EPOCH':>5} {'RES':>4} {'APPLIED':>9}")
+    if migrating:
+        head += f" {'MIG':>8} {'DLAG':>6}"
     lines = [head, "-" * len(head)]
     for t, rec in sorted(view["tenants"].items()):
         p99 = rec.get("window_p99_ms")
-        lines.append(
+        row = (
             f"{t:<12} {rec.get('cluster') or '?':<8} "
             f"{rec.get('qps', '-'):>8} "
             f"{(f'{p99:.2f}ms' if p99 is not None else '-'):>9} "
             f"{rec.get('repl_lag', 0):>5} {rec.get('epoch', 0):>5} "
             f"{rec.get('resident', 0):>4} "
             f"{rec.get('applied_seqno', 0):>9}")
+        if migrating:
+            mlag = rec.get("mig_lag")
+            row += (f" {rec.get('mig') or '-':>8} "
+                    f"{(mlag if mlag is not None else '-'):>6}")
+        lines.append(row)
     lines.append("")
     ihead = (f"{'INSTANCE':<22} {'CLUSTER':<8} {'EPOCH':>5} "
              f"{'LAG':>5} {'RSS':>9}")
@@ -216,6 +254,15 @@ def render_table(view: dict, scrape_bytes: int) -> str:
         skews = ", ".join(f"{c}={v}" for c, v in
                           sorted(fleet["epoch_skew"].items()))
         foot.append(f"epoch skew {skews}")
+    if fleet.get("migrate_inflight") or fleet.get("migrate_completed") \
+            or fleet.get("migrate_aborted"):
+        foot.append(f"migrations {fleet.get('migrate_inflight', 0)} "
+                    f"live / {fleet.get('migrate_completed', 0)} done "
+                    f"/ {fleet.get('migrate_aborted', 0)} aborted")
+    if fleet.get("rebalance_verdicts"):
+        rv = fleet["rebalance_verdicts"]
+        foot.append(f"rebalancer {rv.get('migrate', 0)} go / "
+                    f"{rv.get('hold', 0)} hold")
     lines += ["", "  ".join(foot)]
     return "\n".join(lines) + "\n"
 

@@ -204,7 +204,7 @@ class ServeDaemon:
         self.counters = {"requests": 0, "queries": 0, "inserts": 0,
                          "shed": 0, "timeouts": 0, "readonly": 0,
                          "errors": 0, "faults": 0, "notleader": 0,
-                         "stale": 0, "repl_quorum_fails": 0}
+                         "stale": 0, "repl_quorum_fails": 0, "moved": 0}
         # flight-recorder metrics (ISSUE 10): per-daemon registry so
         # in-process test clusters never share counters; exported raw
         # over the METRICS verb and summarized into STATS (per-verb
@@ -322,6 +322,11 @@ class ServeDaemon:
             if t.replicator is not None:
                 t.replicator.stop()
                 t.replicator = None
+            if t.mig is not None:
+                rep = t.mig.get("replicator")
+                if rep is not None:
+                    rep.stop()
+                t.mig = None
             if t.hub is not None:
                 t.hub.stop()
         if self._io_thread is not None:
@@ -374,10 +379,16 @@ class ServeDaemon:
         """One follower stream per hosted tenant, all discovering the
         same leader (the cluster is one unit; tenants are state dirs)."""
         for t in self._tenant_entries():
-            if t.replicator is not None:
+            if t.replicator is not None or t.mig is not None:
+                continue
+            try:
+                core = self.tenants.core_of(t.name)
+            except FileNotFoundError:
+                # adopted-but-empty (kill -9 before the migration's
+                # snapshot landed): the resumed migration re-bootstraps
                 continue
             t.replicator = Replicator(
-                self.tenants.core_of(t.name), self.node_id,
+                core, self.node_id,
                 self._discover_leader, hb_s=self.cluster.hb_s,
                 events=self.config.events, tenant=t.name).start()
 
@@ -421,9 +432,12 @@ class ServeDaemon:
             # when that tenant next streams)
             self.core.advance_epoch(new_epoch)
             for t in self._tenant_entries():
-                if t.name == DEFAULT_TENANT:
+                if t.name == DEFAULT_TENANT or t.mig is not None:
                     continue
-                core = self.tenants.core_of(t.name)
+                try:
+                    core = self.tenants.core_of(t.name)
+                except FileNotFoundError:
+                    continue  # adopted-but-empty (mid-migration adopt)
                 if core.epoch < new_epoch:
                     try:
                         core.advance_epoch(new_epoch)
@@ -856,7 +870,11 @@ class ServeDaemon:
                 hub.on_line(conn, raw.decode("ascii").strip())
             except UnicodeDecodeError:
                 pass
-        hub.attach(conn, node, from_seqno)
+        # a migration delta stream (ISSUE 17) files its APPENDs under
+        # the mdelta netfault site so the migration wire sweeps
+        # independently of ordinary replication
+        site = "mdelta" if kv.get("mig") else "repl"
+        hub.attach(conn, node, from_seqno, site=site)
         self.config.events.append(("repl_attach", f"{node}:{tname}"
                                    if tname != DEFAULT_TENANT else node))
         return True
@@ -1049,6 +1067,19 @@ class ServeDaemon:
             return ok_line("bye"), True
         if verb == "EVICT":
             return self._handle_evict(req), False
+        if verb == "MIG":
+            # migration plumbing names its tenant in args and must work
+            # on fenced tenants (STAT/UNSEAL are how a fence is
+            # inspected and lifted), so it runs before the moved check
+            return self._handle_mig(req), False
+        if tenant.moved_dest is not None:
+            # the cutover fence (ISSUE 17): this tenant lives elsewhere
+            # now.  A typed refusal naming the new home — NEVER a
+            # silent drop, and never a write applied here — is what
+            # lets the router re-resolve and replay in-flight requests
+            # epoch-safely with zero acked-insert loss.
+            self.counters["moved"] += 1
+            return err_line("moved", f"dest={tenant.moved_dest}"), False
         core = self.tenants.core_of(tenant.name)
         if verb in ("PART", "PARENT", "SUBTREE", "ECV"):
             stale = self._check_staleness(tenant)
@@ -1095,6 +1126,15 @@ class ServeDaemon:
             if self.role != "leader":
                 self.counters["notleader"] += 1
                 return err_line("notleader", self.leader_addr()), False
+            if tenant.mig is not None:
+                # a tenant still migrating IN holds the source's epoch;
+                # accepting a write here before the cutover's epoch
+                # advance would dual-own the tenant in the same epoch
+                return err_line(
+                    "unavailable",
+                    f"tenant {tenant.name} is migrating in "
+                    f"(phase={tenant.mig.get('phase', '?')}); writes "
+                    f"open after the epoch-fenced cutover"), False
             vids = parse_vids(req.args, want_pairs=True)
             pairs = [(vids[i], vids[i + 1])
                      for i in range(0, len(vids), 2)]
@@ -1129,6 +1169,57 @@ class ServeDaemon:
                 return err_line("notleader", self.leader_addr()), False
             return ok_kv(**core.repartition()), False
         raise BadRequest(f"unhandled verb {verb!r}")  # unreachable
+
+    def _handle_mig(self, req) -> str:
+        """``MIG <op> <tenant> [k=v...]`` — the daemon-side migration
+        surface (ISSUE 17, serve/migrate.py drives it from the router):
+        ADOPT/CUT/DROP run on the target leader, SEAL/UNSEAL on the
+        source leader, STAT anywhere.  Every op is idempotent — the
+        driver retries through netfaults and kill -9 resumes."""
+        from . import migrate
+        if len(req.args) < 2:
+            raise BadRequest("MIG wants <op> <tenant> [k=v...]")
+        op = req.args[0].upper()
+        name = req.args[1]
+        kv = parse_kv_args(req.args[2:])
+        if op not in ("ADOPT", "SEAL", "UNSEAL", "CUT", "DROP", "STAT"):
+            raise BadRequest(f"unknown MIG op {op!r}")
+        if op != "STAT" and self.role != "leader":
+            self.counters["notleader"] += 1
+            return err_line("notleader", self.leader_addr())
+        try:
+            if op == "ADOPT":
+                try:
+                    host = kv["host"]
+                    port = int(kv["port"])
+                except (KeyError, ValueError):
+                    raise BadRequest(
+                        "MIG ADOPT wants host=<h> port=<p>")
+                return ok_kv(**migrate.target_adopt(self, name, host,
+                                                    port))
+            if op == "SEAL":
+                dest = kv.get("dest")
+                if not dest:
+                    raise BadRequest("MIG SEAL wants dest=<cluster>")
+                return ok_kv(**migrate.source_seal(self, name, dest))
+            if op == "UNSEAL":
+                return ok_kv(**migrate.source_unseal(self, name))
+            if op == "CUT":
+                try:
+                    epoch = int(kv["epoch"])
+                    expect = int(kv["expect"])
+                except (KeyError, ValueError):
+                    raise BadRequest(
+                        "MIG CUT wants epoch=<int> expect=<seqno>")
+                return ok_kv(**migrate.target_cut(self, name, epoch,
+                                                  expect))
+            if op == "DROP":
+                return ok_kv(**migrate.target_drop(self, name))
+            return ok_kv(**migrate.mig_stat(self, name))
+        except UnknownTenant as exc:
+            return err_line("notfound", exc.message)
+        except migrate.MigrationError as exc:
+            return err_line("unavailable", str(exc))
 
     def _handle_evict(self, req) -> str:
         """``EVICT <tenant>``: seal the tenant to a snapshot generation
@@ -1197,6 +1288,14 @@ class ServeDaemon:
                       "cold evictions per tenant")
         rsg = m.gauge("sheep_serve_tenant_restores_total",
                       "lazy restores per tenant")
+        # migration visibility (ISSUE 17): phase as a labeled presence
+        # gauge (snap/delta on a target adopting in, moved on a fenced
+        # source) and the target's delta lag in records — `sheep top`
+        # and the router's rebalancer read these off the fleet scrape
+        mphase = m.gauge("sheep_serve_mig_phase",
+                         "1 = tenant is in this migration phase here")
+        mlag = m.gauge("sheep_serve_mig_delta_lag_records",
+                       "migration delta-stream lag on the target")
         for name in self.tenants.names():
             t = self.tenants.get(name)
             res.labels(tenant=name).set(int(t.resident))
@@ -1204,6 +1303,14 @@ class ServeDaemon:
                 app.labels(tenant=name).set(t.core.applied_seqno)
             evg.labels(tenant=name).set(t.evictions)
             rsg.labels(tenant=name).set(t.restores)
+            if t.mig is not None:
+                mphase.labels(tenant=name,
+                              phase=t.mig.get("phase", "?")).set(1)
+                rep = t.mig.get("replicator")
+                mlag.labels(tenant=name).set(
+                    rep.lag if rep is not None else 0)
+            elif t.moved_dest is not None:
+                mphase.labels(tenant=name, phase="moved").set(1)
         # sliding-window latency gauges (ISSUE 12): what `sheep top`
         # renders as CURRENT p50/p99 — the lifetime histogram series
         # above are untouched for scrapers that integrate them
@@ -1276,6 +1383,24 @@ class ServeDaemon:
             rec["tenant"] = tenant.name
             rec["tenants"] = len(self.tenants)
             rec["tenants_resident"] = len(self.tenants.resident_names())
+        if tenant.moved_dest is not None:
+            rec["moved_dest"] = tenant.moved_dest
+        if tenant.mig is not None:
+            rec["mig_phase"] = tenant.mig.get("phase", "?")
+            rep = tenant.mig.get("replicator")
+            rec["mig_lag"] = rep.lag if rep is not None else 0
+        # daemon-wide migration summary (ISSUE 17): visible from ANY
+        # connection (supervise --status asks without a tenant select),
+        # not just one mid-migration tenant's
+        moving = []
+        for name in self.tenants.names():
+            t = self.tenants.get(name)
+            if t.mig is not None:
+                moving.append(f"{name}:{t.mig.get('phase', '?')}")
+            elif t.moved_dest is not None:
+                moving.append(f"{name}:moved->{t.moved_dest}")
+        if moving:
+            rec["migrating"] = ",".join(sorted(moving))
         # per-verb counts + latency quantiles, derived from the SAME
         # histogram registry the METRICS scrape exports (ISSUE 10) —
         # the wire summary and the scrape cannot disagree
@@ -1322,12 +1447,19 @@ class ServeDaemon:
             out["stream_age_s"] = (rep.stream_age_s()
                                    if rep is not None else None)
         if len(self.tenants) > 1:
-            out["tenants"] = {
-                name: {"resident": int(t.resident),
+            out["tenants"] = {}
+            for name in self.tenants.names():
+                t = self.tenants.get(name)
+                rec = {"resident": int(t.resident),
                        "evictions": t.evictions,
                        "restores": t.restores}
-                for name in self.tenants.names()
-                for t in (self.tenants.get(name),)}
+                if t.moved_dest is not None:
+                    rec["moved_dest"] = t.moved_dest
+                if t.mig is not None:
+                    rec["mig_phase"] = t.mig.get("phase", "?")
+                    rep = t.mig.get("replicator")
+                    rec["mig_lag"] = rep.lag if rep is not None else 0
+                out["tenants"][name] = rec
         return out
 
     def _write_status(self, force: bool = False) -> None:

@@ -14,7 +14,8 @@ deterministically — the same discipline as ``SHEEP_IO_FAULT_PLAN`` and
     SHEEP_SERVE_NETFAULT_PLAN = entry[,entry...]
     entry                     = kind @ site : nth
     kind                      = drop | partition | slow | dup
-    site                      = repl | hb | wleg | wbeat | wart | *
+    site                      = repl | hb | wleg | wbeat | wart
+                              | msnap | mdelta | mcut | *
     nth                       = 0-based index of that site's firing
 
 Sites are outbound frame classes — the replication leader's, plus the
@@ -34,6 +35,21 @@ build-worker wire's (ISSUE 16, serve/worker.py):
   wart   the worker's artifact return; partition here tears the
          transfer mid-payload — the crc gate must refuse it and the
          supervisor redispatch exactly one leg
+  msnap  one migration snapshot fetch (ISSUE 17, serve/migrate.py
+         phase 1: the target leader pulling the tenant's crc-verified
+         snapshot from the source); drop/partition = the fetch dies and
+         the phase retries from scratch (sidecar-first landing means a
+         torn fetch admits nothing), dup = the bootstrap runs twice —
+         idempotent by the tmp+rename landing
+  mdelta one migration delta frame (phase 2: a REPL APPEND sent to the
+         migration-attached follower on the target); the recovery
+         paths are the repl site's — gap-NACK re-stream, idempotent dup
+         drop, reconnect-and-resume — exercised on the migration stream
+         specifically
+  mcut   one cutover RPC (phase 3: the router's MIG SEAL/CUT/remap
+         legs); every cutover verb is idempotent, so drop/partition =
+         the driver retries or aborts cleanly back to the source, dup =
+         the verb lands twice and the second is a no-op
 
 Kinds model the distinct network failure shapes, each driving a
 DIFFERENT follower recovery path:
@@ -66,7 +82,8 @@ from dataclasses import dataclass, field
 NETFAULT_PLAN_ENV = "SHEEP_SERVE_NETFAULT_PLAN"
 
 KINDS = ("drop", "partition", "slow", "dup")
-SITES = ("repl", "hb", "wleg", "wbeat", "wart", "*")
+SITES = ("repl", "hb", "wleg", "wbeat", "wart",
+         "msnap", "mdelta", "mcut", "*")
 
 #: how long a "slow" network fault delays one frame
 SLOW_S = 0.05

@@ -40,6 +40,15 @@ carrying no prefix tokens are byte-identical to the PR-6 grammar.
                             obs/metrics.py; the snapshot-transfer shape:
                             one header line + length-prefixed payload)
     SNAPSHOT             -> OK snap=<filename>
+    MIG OP tenant [k=v]  -> migration plumbing (ISSUE 17; the router's
+                            MIGRATE verb drives these on the two
+                            leaders): ADOPT bootstraps + delta-streams
+                            the tenant here, SEAL fences the source
+                            (``ERR moved dest=``), CUT advances the
+                            target's tenant epoch durably before its
+                            first write, UNSEAL aborts back to source,
+                            DROP discards an adopted copy, STAT reports
+                            phase/lag
     REPARTITION          -> OK parts=<k> baseline=<n>
     PING                 -> OK pong
     QUIT                 -> OK bye (connection closes)
@@ -84,6 +93,12 @@ Errors are ``ERR <code> <message>`` with codes::
     notleader   this node is a follower; the payload is the leader's
                 ``host:port`` (or ``-`` while unknown) — writes redirect
                 there instead of splitting the brain
+    moved       the tenant has been migrated away (ISSUE 17): the
+                payload carries ``dest=<cluster>`` naming the new home.
+                A router re-resolves the tenant's placement and replays
+                the request there — the same retry shape as notleader.
+                Never a silent drop: a fenced source REFUSES so no
+                write can land on a tenant that lives elsewhere
     stale       this follower's replication lag exceeds the configured
                 bound (SHEEP_SERVE_MAX_LAG); reads refuse rather than
                 silently answer from the past
@@ -114,8 +129,10 @@ QUERY_VERBS = ("PART", "PARENT", "SUBTREE", "ECV", "STATS", "METRICS",
 #: verbs that mutate state (admission kind "insert", shed first)
 INSERT_VERBS = ("INSERT",)
 #: operator verbs (admitted as queries; SNAPSHOT/REPARTITION do their own
-#: locking in the core, EVICT seals a cold tenant out of memory)
-ADMIN_VERBS = ("SNAPSHOT", "REPARTITION", "EVICT", "QUIT")
+#: locking in the core, EVICT seals a cold tenant out of memory).  MIG
+#: (ISSUE 17) is the daemon-side migration surface the router's MIGRATE
+#: verb drives: ``MIG ADOPT|SEAL|UNSEAL|CUT|DROP|STAT <tenant> [k=v...]``
+ADMIN_VERBS = ("SNAPSHOT", "REPARTITION", "EVICT", "MIG", "QUIT")
 #: the replication family (serve/replicate.py): handled OUTSIDE admission
 #: — a configured replica is cluster plumbing, not client load, and
 #: shedding it would turn an overload into a lag spiral

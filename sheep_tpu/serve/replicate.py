@@ -86,13 +86,18 @@ def encode_ping(epoch: int, seqno: int) -> str:
 
 
 def encode_hello(node: str, epoch: int, seqno: int, sig: str,
-                 tenant: str | None = None) -> str:
+                 tenant: str | None = None, mig: bool = False) -> str:
     """The stream handshake; ``tenant`` names a non-default tenant's
     stream (ISSUE 11) and is omitted otherwise so the single-tenant
-    handshake stays byte-identical to PR 7."""
+    handshake stays byte-identical to PR 7.  ``mig=1`` (ISSUE 17) marks
+    a MIGRATION delta stream: the leader files its APPENDs under the
+    ``mdelta`` netfault site instead of ``repl`` so the migration wire
+    is chaos-sweepable independently of ordinary replication."""
     line = f"REPL HELLO node={node} epoch={epoch} seqno={seqno} sig={sig}"
     if tenant is not None and tenant != "default":
         line += f" tenant={tenant}"
+    if mig:
+        line += " mig=1"
     return line
 
 
@@ -342,9 +347,10 @@ class ReplApplier:
 
 class _FollowerState:
     __slots__ = ("conn", "node", "acked", "next_send", "last_ack_t",
-                 "attached_at", "alive", "thread")
+                 "attached_at", "alive", "thread", "site")
 
-    def __init__(self, conn, node: str, next_send: int):
+    def __init__(self, conn, node: str, next_send: int,
+                 site: str = "repl"):
         self.conn = conn
         self.node = node
         self.acked = 0
@@ -353,6 +359,7 @@ class _FollowerState:
         self.attached_at = time.monotonic()
         self.alive = True
         self.thread: threading.Thread | None = None
+        self.site = site  # netfault site for APPENDs (mdelta: migration)
 
 
 class ReplicationHub:
@@ -379,12 +386,15 @@ class ReplicationHub:
 
     # -- membership --------------------------------------------------------
 
-    def attach(self, conn, node: str, from_seqno: int) -> None:
+    def attach(self, conn, node: str, from_seqno: int,
+               site: str = "repl") -> None:
         """Register one follower stream starting after ``from_seqno``
         and spawn its sender.  The caller (daemon) already decided
         stream-vs-snapshot; a sender that later finds the WAL moved past
-        its position closes the connection so the follower re-HELLOs."""
-        fs = _FollowerState(conn, node, from_seqno + 1)
+        its position closes the connection so the follower re-HELLOs.
+        ``site`` names the netfault site its APPENDs arm ("mdelta" for a
+        migration delta stream, ISSUE 17)."""
+        fs = _FollowerState(conn, node, from_seqno + 1, site=site)
         fs.acked = from_seqno
         with self._cv:
             self._followers[id(conn)] = fs
@@ -493,7 +503,7 @@ class ReplicationHub:
                     return
                 line = encode_append(self.core.epoch, seqno, payload,
                                      rid=self.core.rid_for(seqno))
-                if not self._transmit(fs, line, "repl"):
+                if not self._transmit(fs, line, fs.site):
                     self.detach(fs.conn)
                     return
                 fs.next_send = seqno + 1
@@ -648,11 +658,13 @@ class Replicator:
 
     def __init__(self, core: ServeCore, node_id: str, discover,
                  hb_s: float = DEFAULT_HB_S, retry_s: float = 0.2,
-                 events: list | None = None, tenant: str | None = None):
+                 events: list | None = None, tenant: str | None = None,
+                 mig: bool = False):
         self.core = core
         self.node_id = node_id
         self.discover = discover
         self.tenant = tenant  # None/"default": the PR-7 handshake bytes
+        self.mig = mig        # migration delta stream (mdelta site)
         self.hb_s = hb_s
         self.retry_s = retry_s
         self.events = events if events is not None else []
@@ -713,7 +725,7 @@ class Replicator:
             rf = sock.makefile("rb")
             hello = encode_hello(self.node_id, self.core.epoch,
                                  self.core.applied_seqno, self.core.sig,
-                                 tenant=self.tenant)
+                                 tenant=self.tenant, mig=self.mig)
             sock.sendall((hello + "\n").encode("ascii"))
             line = rf.readline().decode("ascii").strip()
             toks = line.split()

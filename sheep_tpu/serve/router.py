@@ -30,6 +30,19 @@ existing client (and ``nc``) works through the router unchanged:
                with instance/cluster labels + derived fleet gauges
                (:meth:`Router.fleet_metrics`).
   ROUTER       answered by the router itself: per-router counters.
+  MIGRATE      answered by the router itself (ISSUE 17): ``MIGRATE
+               <tenant> <cluster> [wait=<s>]`` starts the live
+               migration driver (serve/migrate.py) — snapshot
+               bootstrap, delta stream, epoch-fenced cutover — and
+               remaps the tenant when the cutover lands.
+
+**Placement overrides** (ISSUE 17): a completed migration pins the
+tenant to its new cluster in ``tenant-map.json`` (durable, tmp+fsync+
+rename) — consulted before the ring, survives router restarts, and is
+also learned reactively: a member answering ``ERR moved dest=<cid>``
+teaches the router the new placement and the request is replayed there
+(the fence refused it BEFORE applying, so the replay is epoch-safe —
+first apply, not double apply).
 
 **Trace context** (ISSUE 12): forwarded requests carry a ``RID=<hex>``
 prefix token (adaptive — see :data:`RID_ENV`) so every process the
@@ -50,6 +63,7 @@ responses re-resolve the leader before the next request.
 from __future__ import annotations
 
 import hashlib
+import json
 import os
 import socket
 import threading
@@ -76,6 +90,8 @@ VNODES_ENV = "SHEEP_ROUTE_VNODES"
 RID_ENV = "SHEEP_ROUTE_RID"
 
 ADDR_FILE = "router.addr"
+#: durable tenant->cluster placement overrides (migration landings)
+TENANT_MAP_FILE = "tenant-map.json"
 
 #: reads that spread across every cluster member
 SPREAD_VERBS = ("PART", "PARENT", "SUBTREE", "ECV", "PING")
@@ -246,10 +262,19 @@ class Router:
         self.counters = {"conns": 0, "requests": 0, "reads": 0,
                          "writes": 0, "retries": 0, "reroutes": 0,
                          "errors": 0, "insert_unknown": 0,
-                         "scrapes": 0, "scrape_errors": 0}
+                         "scrapes": 0, "scrape_errors": 0,
+                         "moved_reroutes": 0}
         # the router's own registry (ISSUE 12): its counters + process
         # self-accounting ride the fleet scrape like any member's
         self.metrics = Registry()
+        # live migration state (ISSUE 17): placement overrides beat the
+        # ring, one driver per tenant, completion/abort tallies
+        self._overrides: dict[str, str] = self._load_overrides()
+        self._migrations: dict[str, object] = {}
+        self.mig_completed = 0
+        self.mig_aborted = 0
+        # set by cli/route.py when SHEEP_REBALANCE=1
+        self.rebalancer = None
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -271,6 +296,7 @@ class Router:
         self._accept_thread = threading.Thread(
             target=self._accept_loop, daemon=True, name="route-accept")
         self._accept_thread.start()
+        self.resume_migrations()
         return self
 
     def run_forever(self) -> None:
@@ -299,8 +325,114 @@ class Router:
 
     # -- placement ---------------------------------------------------------
 
+    def placement_of(self, tenant: str) -> str:
+        """The cluster id owning ``tenant``: a migration override if one
+        landed, the hash ring otherwise.  An override naming a cluster
+        no longer in the map falls back to the ring (never KeyErrors a
+        request)."""
+        with self._lock:
+            cid = self._overrides.get(tenant)
+        if cid is not None and cid in self.clusters:
+            return cid
+        return self.ring.lookup(tenant)
+
     def cluster_for(self, tenant: str) -> _Cluster:
-        return self.clusters[self.ring.lookup(tenant)]
+        return self.clusters[self.placement_of(tenant)]
+
+    def cluster_by_id(self, cid: str) -> _Cluster | None:
+        return self.clusters.get(cid)
+
+    def remap(self, tenant: str, cid: str) -> None:
+        """Atomically repoint ``tenant`` at ``cid`` — durable FIRST
+        (tmp+fsync+rename of tenant-map.json), then the in-memory swap,
+        so a kill -9 between the two re-reads the new placement instead
+        of reviving the old one."""
+        with self._lock:
+            nxt = dict(self._overrides)
+            nxt[tenant] = cid
+            self._save_overrides(nxt)
+            self._overrides = nxt
+
+    def _overrides_path(self) -> str | None:
+        if not self.state_dir:
+            return None
+        return os.path.join(self.state_dir, TENANT_MAP_FILE)
+
+    def _load_overrides(self) -> dict[str, str]:
+        path = self._overrides_path()
+        if path is None:
+            return {}
+        try:
+            with open(path) as f:
+                rec = json.load(f)
+            return {str(k): str(v) for k, v in rec.items()} \
+                if isinstance(rec, dict) else {}
+        except (OSError, ValueError):
+            return {}
+
+    def _save_overrides(self, recs: dict[str, str]) -> None:
+        path = self._overrides_path()
+        if path is None:
+            return
+        tmp = path + ".tmp"
+        try:
+            with open(tmp, "w") as f:
+                json.dump(recs, f, sort_keys=True)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, path)
+        except OSError:
+            pass  # non-durable router: the remap still holds in memory
+
+    # -- live migration (ISSUE 17) -----------------------------------------
+
+    def start_migration(self, tenant: str, dest: str):
+        """Start (or report) the one in-flight migration for ``tenant``.
+        Returns the Migration driver; raises ValueError on an unknown
+        destination or a no-op (tenant already lives there)."""
+        from .migrate import Migration
+        if dest not in self.clusters:
+            raise ValueError(f"unknown cluster {dest!r} (have: "
+                             f"{'/'.join(sorted(self.clusters))})")
+        with self._lock:
+            cur = self._migrations.get(tenant)
+            if cur is not None and not cur.done.is_set():
+                return cur
+        if self.placement_of(tenant) == dest:
+            raise ValueError(f"tenant {tenant!r} already lives on "
+                             f"{dest}")
+        mig = Migration(self, tenant, dest)
+        with self._lock:
+            self._migrations[tenant] = mig
+        return mig.start()
+
+    def migration_finished(self, mig) -> None:
+        """Driver completion callback (any terminal phase)."""
+        with self._lock:
+            if mig.phase == "done":
+                self.mig_completed += 1
+            elif mig.phase == "aborted":
+                self.mig_aborted += 1
+
+    def resume_migrations(self) -> list:
+        """Restart every persisted, unfinished migration manifest —
+        the kill -9'd router picks up where it stopped (every daemon-
+        side MIG op is idempotent, so resuming is re-issuing)."""
+        from .migrate import Migration, load_manifests
+        out = []
+        if not self.state_dir:
+            return out
+        for rec in load_manifests(self.state_dir):
+            if rec.get("phase") in ("done", "aborted"):
+                continue
+            tenant, dest = rec["tenant"], rec.get("dest")
+            if dest not in self.clusters:
+                continue
+            mig = Migration(self, tenant, dest, resume=rec)
+            with self._lock:
+                self._migrations[tenant] = mig
+            out.append(mig.start())
+        return out
 
     # -- one client connection ---------------------------------------------
 
@@ -345,6 +477,11 @@ class Router:
                     continue
                 if verb == "ROUTER":
                     sock.sendall((self._router_stats(tenant) + "\n")
+                                 .encode("ascii"))
+                    continue
+                if verb == "MIGRATE":
+                    args = toks[vi + 1:] if vi + 1 <= len(toks) else []
+                    sock.sendall((self._handle_migrate(args) + "\n")
                                  .encode("ascii"))
                     continue
                 if verb == "METRICS":
@@ -401,13 +538,49 @@ class Router:
             return tenant, ok_kv(tenant=tenant)
         name = args[0]
         return name, ok_kv(tenant=name,
-                           cluster=self.ring.lookup(name))
+                           cluster=self.placement_of(name))
 
     def _router_stats(self, tenant: str) -> str:
         rec = dict(self.counters)
         rec["clusters"] = len(self.clusters)
         rec["tenant"] = tenant
-        rec["cluster"] = self.ring.lookup(tenant)
+        rec["cluster"] = self.placement_of(tenant)
+        rec["migrations_completed"] = self.mig_completed
+        rec["migrations_aborted"] = self.mig_aborted
+        return ok_kv(**rec)
+
+    def _handle_migrate(self, args) -> str:
+        """``MIGRATE <tenant> <cluster> [wait=<s>]`` — kick the live
+        migration driver.  Async by default (poll ROUTER / METRICS /
+        MIG STAT); ``wait=`` blocks up to that many seconds and reports
+        the phase it saw."""
+        kv = {}
+        pos = []
+        for a in args:
+            k, sep, v = a.partition("=")
+            if sep:
+                kv[k] = v
+            else:
+                pos.append(a)
+        if len(pos) != 2:
+            return err_line("badreq",
+                            "MIGRATE wants <tenant> <cluster> "
+                            "[wait=<s>]")
+        tenant, dest = pos
+        try:
+            mig = self.start_migration(tenant, dest)
+        except ValueError as exc:
+            return err_line("badreq", str(exc))
+        try:
+            wait_s = float(kv.get("wait", "0") or 0)
+        except ValueError:
+            wait_s = 0.0
+        if wait_s > 0:
+            mig.done.wait(wait_s)
+        rec = {"tenant": tenant, "src": mig.src, "dest": mig.dest,
+               "phase": mig.phase}
+        if mig.error:
+            rec["error"] = mig.error.replace(" ", "_")[:120]
         return ok_kv(**rec)
 
     # -- the fleet scrape (ISSUE 12) ---------------------------------------
@@ -497,6 +670,35 @@ class Router:
                  "instances holding the tenant resident in memory")
         for tn, n in sorted(tenant_res.items()):
             tres.labels(tenant=tn).set(n)
+        # live migration telemetry (ISSUE 17)
+        with self._lock:
+            migs = list(self._migrations.values())
+            completed, aborted = self.mig_completed, self.mig_aborted
+        inflight = [x for x in migs if not x.done.is_set()]
+        g("sheep_migrate_inflight",
+          "migrations currently in flight through this router").set(
+            len(inflight))
+        g("sheep_migrate_completed",
+          "migrations that finished the epoch-fenced cutover").set(
+            completed)
+        g("sheep_migrate_aborted",
+          "migrations aborted cleanly back to their source").set(
+            aborted)
+        dlag = g("sheep_migrate_delta_lag_records",
+                 "records the migration target still trails its "
+                 "source by (phase 2/3 drain)")
+        for x in inflight:
+            if x.last_lag is not None:
+                dlag.labels(tenant=x.tenant).set(x.last_lag)
+        rb = self.rebalancer
+        if rb is not None:
+            verd = g("sheep_rebalance_verdicts_total",
+                     "rebalancer verdicts by action")
+            for action, n in sorted(rb.verdict_counts.items()):
+                verd.labels(action=action).set(n)
+            g("sheep_rebalance_migrations_started",
+              "migrations the rebalancer kicked off").set(
+                rb.migrations_started)
         set_process_gauges(m, self.started_at)
         g("sheep_fleet_scrape_seconds",
           "wall cost of this fan-in scrape").set(
@@ -531,11 +733,13 @@ class Router:
                  upstreams) -> tuple[str, bytes]:
         """Route one request line; returns (response line, extra payload
         bytes) — the payload is only ever the METRICS scrape body."""
-        cluster = self.cluster_for(tenant)
         is_read = verb in SPREAD_VERBS
         self.counters["reads" if is_read else "writes"] += 1
         last_err = "no reachable cluster member"
         for attempt in range(self.retries + 1):
+            # re-resolved per attempt: an ``ERR moved`` mid-loop remaps
+            # the tenant, and the replay must chase the new home
+            cluster = self.cluster_for(tenant)
             if attempt:
                 self.counters["retries"] += 1
             if is_read:
@@ -595,6 +799,23 @@ class Router:
                         cluster.forget_leader()
                     last_err = "notleader"
                     break  # next attempt re-resolves
+                if resp.startswith("ERR moved"):
+                    # the cutover fence (ISSUE 17): this tenant lives on
+                    # another cluster now.  The fence refused BEFORE
+                    # applying, so replaying the request — a write
+                    # included — at the new home is a first apply, never
+                    # a double one (the notleader retry shape).
+                    self.counters["moved_reroutes"] += 1
+                    dest = None
+                    for tok in resp.split():
+                        if tok.startswith("dest="):
+                            dest = tok[5:]
+                    if dest and dest in self.clusters \
+                            and dest != cluster.cid:
+                        self.remap(tenant, dest)
+                        last_err = f"moved to {dest}"
+                        break  # next attempt re-resolves the cluster
+                    return resp, b""  # dest unknown: typed passthrough
                 if resp.startswith("ERR stale") and is_read:
                     last_err = "stale replica"
                     continue  # typed, unanswered: next replica

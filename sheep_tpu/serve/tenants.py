@@ -35,6 +35,7 @@ evicts (it IS the daemon's published identity).
 
 from __future__ import annotations
 
+import json
 import os
 import threading
 import time
@@ -47,6 +48,52 @@ TENANTS_ENV = "SHEEP_SERVE_TENANTS"
 MAX_RESIDENT_ENV = "SHEEP_SERVE_MAX_RESIDENT"
 
 DEFAULT_TENANT = "default"
+
+#: the migration fence marker (ISSUE 17): a tenant state dir holding
+#: this file has been MOVED — its daemon refuses every client verb with
+#: ``ERR moved dest=<cluster>`` even across restarts, so a kill -9'd
+#: source can never resurrect as a second owner of a migrated tenant
+MOVED_MARKER = "tenant.moved"
+
+#: adopted-tenant registry (ISSUE 17): migration targets persist the
+#: tenants they adopted (they are not in SHEEP_SERVE_TENANTS) so a
+#: kill -9 mid-migration leaves the tenant registered after restart
+ADOPTED_FILE = "tenants.adopted.json"
+
+
+def moved_marker_path(state_dir: str) -> str:
+    return os.path.join(state_dir, MOVED_MARKER)
+
+
+def read_moved_marker(state_dir: str) -> str | None:
+    """The destination cluster named by a tenant dir's fence marker, or
+    None when the tenant was never migrated away."""
+    try:
+        with open(moved_marker_path(state_dir)) as f:
+            rec = json.load(f)
+        return str(rec["dest"])
+    except (OSError, ValueError, KeyError):
+        return None
+
+
+def write_moved_marker(state_dir: str, dest: str) -> None:
+    """Durably fence a tenant dir: tmp + fsync + rename, so the fence
+    either fully exists or does not — a torn fence is no fence, and the
+    cutover driver retries until the marker reads back."""
+    path = moved_marker_path(state_dir)
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump({"dest": dest, "at": time.time()}, f)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
+def clear_moved_marker(state_dir: str) -> None:
+    try:
+        os.unlink(moved_marker_path(state_dir))
+    except OSError:
+        pass
 
 
 @dataclass
@@ -107,7 +154,7 @@ class Tenant:
 
     __slots__ = ("name", "state_dir", "graph", "num_parts", "core",
                  "admission", "hub", "replicator", "last_touch",
-                 "evictions", "restores")
+                 "evictions", "restores", "moved_dest", "mig")
 
     def __init__(self, name: str, state_dir: str, graph: str | None,
                  num_parts: int, core: ServeCore | None):
@@ -122,6 +169,24 @@ class Tenant:
         self.last_touch = time.monotonic()
         self.evictions = 0
         self.restores = 0
+        # migration state (ISSUE 17): moved_dest is the fence — set =
+        # every client verb refuses ``ERR moved dest=<cluster>``; it is
+        # re-read from the durable marker so restarts stay fenced.  mig
+        # is the TARGET side's live migration record (phase / source /
+        # delta puller) while an adoption is in flight, None otherwise.
+        self.moved_dest = read_moved_marker(state_dir)
+        self.mig = None
+
+    def fence_moved(self, dest: str) -> None:
+        """Durably fence this tenant as moved to ``dest`` (idempotent)."""
+        write_moved_marker(self.state_dir, dest)
+        self.moved_dest = dest
+
+    def unfence_moved(self) -> None:
+        """Abort path: lift the fence — legal ONLY while the target has
+        not advanced the tenant epoch (the cutover driver's invariant)."""
+        clear_moved_marker(self.state_dir)
+        self.moved_dest = None
 
     @property
     def resident(self) -> bool:
@@ -132,7 +197,7 @@ class Tenant:
         machinery would be stranded by dropping the core."""
         if self.name == DEFAULT_TENANT or self.core is None:
             return False
-        if self.replicator is not None:
+        if self.replicator is not None or self.mig is not None:
             return False
         return self.hub is None or self.hub.follower_count() == 0
 
@@ -170,6 +235,18 @@ class TenantManager:
             self._tenants[spec.name] = Tenant(
                 spec.name, spec.state_dir, spec.graph, spec.num_parts,
                 None)
+        # re-register tenants a previous incarnation adopted mid-
+        # migration (ISSUE 17): spec'd names win — an operator adding
+        # the tenant to SHEEP_SERVE_TENANTS after the move is the
+        # steady-state ending of a migration story
+        self._adopted_path = os.path.join(default_core.state_dir,
+                                          ADOPTED_FILE)
+        for rec in self._load_adopted():
+            name = rec.get("name")
+            if name and name not in self._tenants:
+                self._tenants[name] = Tenant(
+                    name, rec["state_dir"], rec.get("graph"),
+                    int(rec.get("num_parts", 2)), None)
 
     @classmethod
     def from_env(cls, default_core: ServeCore, extra_specs=None,
@@ -181,6 +258,67 @@ class TenantManager:
             specs += [s for s in parse_tenant_specs(env)
                       if s.name not in names]
         return cls(default_core, specs, **kw)
+
+    # -- adoption (migration targets, ISSUE 17) ----------------------------
+
+    def _load_adopted(self) -> list[dict]:
+        try:
+            with open(self._adopted_path) as f:
+                recs = json.load(f)
+            return recs if isinstance(recs, list) else []
+        except (OSError, ValueError):
+            return []
+
+    def _save_adopted(self, recs: list[dict]) -> None:
+        tmp = self._adopted_path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(recs, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self._adopted_path)
+
+    def adopt(self, name: str, state_dir: str, graph: str | None = None,
+              num_parts: int = 2) -> Tenant:
+        """Dynamically register ``name`` (a migration target adopting an
+        inbound tenant).  Durable BEFORE the tenant exists in memory —
+        kill -9 between the registry write and the snapshot landing
+        leaves a registered-but-empty tenant the resumed migration
+        re-bootstraps, never an unregistered state dir.  Idempotent:
+        re-adopting an already-registered tenant returns the entry."""
+        if name == DEFAULT_TENANT:
+            raise ValueError("cannot adopt the default tenant")
+        with self._lock:
+            t = self._tenants.get(name)
+            if t is not None:
+                return t
+            recs = [r for r in self._load_adopted()
+                    if r.get("name") != name]
+            recs.append({"name": name, "state_dir": state_dir,
+                         "graph": graph, "num_parts": num_parts})
+            self._save_adopted(recs)
+            t = Tenant(name, state_dir, graph, num_parts, None)
+            self._tenants[name] = t
+            return t
+
+    def drop(self, name: str) -> bool:
+        """Unregister an ADOPTED tenant (migration abort: the target
+        discards its partial copy).  Spec'd/default tenants refuse —
+        only what adopt() added can be dropped.  The state dir is left
+        on disk for the driver to discard; False when not adopted."""
+        with self._lock:
+            t = self._tenants.get(name)
+            if t is None:
+                return False
+            recs = self._load_adopted()
+            if not any(r.get("name") == name for r in recs):
+                return False
+            if t.core is not None:
+                t.core.close()
+                t.core = None
+            self._save_adopted([r for r in recs
+                                if r.get("name") != name])
+            del self._tenants[name]
+            return True
 
     # -- lookups -----------------------------------------------------------
 
@@ -237,8 +375,16 @@ class TenantManager:
         """Eagerly open/bootstrap every tenant (daemon start on a leader
         or standalone: followers must be able to HELLO immediately).
         The start-time open is not a "restore" — that counter tracks
-        evict/lazy-restore cycles."""
+        evict/lazy-restore cycles.  An adopted-but-empty tenant (kill -9
+        landed between the adoption registry write and the snapshot
+        fetch) stays cold — the resumed migration re-bootstraps it."""
         for name in self.names():
+            with self._lock:
+                t = self.get(name)
+                if t.core is None and t.graph is None \
+                        and not (os.path.isdir(t.state_dir)
+                                 and snap_paths(t.state_dir)):
+                    continue
             self.core_of(name, _count_restore=False)
 
     # -- eviction ----------------------------------------------------------

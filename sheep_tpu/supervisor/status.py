@@ -350,7 +350,8 @@ def serve_status_json(state_dir: str) -> dict:
                 out["stats"] = c.kv("STATS")
                 out["alive"] = True
                 for key in ("role", "epoch", "applied_seqno", "repl_lag",
-                            "followers", "node", "leader"):
+                            "followers", "node", "leader", "moved_dest",
+                            "mig_phase", "mig_lag", "migrating"):
                     if key in out["stats"]:
                         out[key] = out["stats"][key]
         except Exception:
@@ -390,7 +391,8 @@ def render_serve_status(state_dir: str) -> str:
              f"alive: {'yes' if rec['alive'] else 'NO (daemon down)'}"
              f"  heartbeat {_fmt_age(rec.get('heartbeat_age_s'))}"]
     for key in ("node", "role", "epoch", "applied_seqno", "leader",
-                "repl_lag", "followers", "addr", "newest_snapshot"):
+                "repl_lag", "followers", "addr", "newest_snapshot",
+                "moved_dest", "mig_phase", "mig_lag", "migrating"):
         if key in rec and rec[key] is not None:
             lines.append(f"{key}: {rec[key]}")
     st = rec.get("stats", {})

@@ -1,0 +1,220 @@
+"""DRIFTBENCH: does quality survive sustained insert load? (ISSUE 18)
+
+Three arms ride the SAME seeded power-law insert stream over a serve
+core bootstrapped from hep-th, and after every batch each arm's exact
+ECV(down) is compared against the fresh-rebuild oracle at that point
+(full re-sequence + rebuild + repartition over the whole edge set —
+the best any policy could do):
+
+  pst-only      inserts fold through the PST path, nothing else — the
+                sequence AND the partition both go stale
+  repart-only   background repartition fires on cut drift (the pre-18
+                daemon): the partition refreshes but the SEQUENCE is
+                frozen at bootstrap, so quality still decays
+  reseq         the crash-safe incremental re-sequence fires on the
+                sequence-drift detector (serve/reseq.py), rebuilding
+                order + tree + partition from the durable edge set
+
+The stream is adversarial on purpose: a zipf-weighted set of brand-new
+hub vertices soaks up edges, exactly the degree-rank movement a frozen
+degree order mis-handles.  The record stores per-batch
+``{inserted, ecv_down, oracle_ecv, ratio, actions}`` per arm plus the
+acceptance booleans computed IN the record:
+
+  reseq_bounded_decay   the reseq arm's final oracle-ratio is at or
+                        below its own peak (a re-sequence recovered
+                        quality) AND below every other arm's final
+                        ratio
+  others_decay_monotone pst-only's ratio never improves batch over
+                        batch (the no-action control decays monotonely)
+  accept                both of the above
+
+Usage: python scripts/driftbench.py [graph] [out.json]
+Defaults: data/hep-th.dat, DRIFTBENCH_r01.json at the repo root.
+Env: DRIFTBENCH_BATCHES (default 6), DRIFTBENCH_BATCH (default 1500),
+DRIFTBENCH_SEED (default 7).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import numpy as np  # noqa: E402
+
+from sheep_tpu.core.forest import build_forest  # noqa: E402
+from sheep_tpu.core.sequence import (degree_sequence_from_degrees,  # noqa: E402
+                                     host_degree_histogram,
+                                     sequence_positions)
+from sheep_tpu.io.edges import load_edges, write_dat  # noqa: E402
+from sheep_tpu.partition.tree_partition import (TreePartitionOptions,  # noqa: E402
+                                                partition_forest)
+from sheep_tpu.serve.reseq import run_reseq  # noqa: E402
+from sheep_tpu.serve.state import ServeCore, ecv_down  # noqa: E402
+from sheep_tpu.utils.envinfo import env_capture  # noqa: E402
+
+NUM_PARTS = 4
+BALANCE = 1.03
+
+
+def power_law_stream(tail, head, total, seed):
+    """A seeded insert stream, half of it growing NEW zipf-weighted hub
+    vertices (sequence drift: the bootstrap degree ranks go wrong and
+    the frozen order cannot even PLACE the hubs) and half new
+    existing-to-existing edges (cut drift: what a repartition CAN fix).
+    Degree-proportional endpoints via edge-endpoint sampling."""
+    rng = np.random.default_rng(seed)
+    n0 = int(max(tail.max(), head.max())) + 1
+    hubs = np.arange(n0, n0 + 32, dtype=np.uint32)
+    w = 1.0 / np.arange(1, len(hubs) + 1) ** 1.2
+    w /= w.sum()
+    hub_pick = rng.choice(hubs, size=total, p=w)
+    old_a = np.asarray(tail, np.uint32)[
+        rng.integers(0, len(tail), size=total)]
+    old_b = np.asarray(head, np.uint32)[
+        rng.integers(0, len(head), size=total)]
+    u = np.where(rng.random(total) < 0.5, hub_pick, old_a)
+    return np.stack([u, old_b], axis=1).astype(np.uint32)
+
+
+def unserved_edges(core, t, h):
+    """Inserted edges with an endpoint the CURRENT sequence cannot
+    place (no jnid -> no part): invisible to ecv_down but very visible
+    to the application — counted as worst-case cut in the quality
+    metric."""
+    inv = np.uint32(0xFFFFFFFF)
+    pos = np.asarray(core.pos)
+    n = len(pos)
+    pt = np.where(t < n, pos[np.minimum(t, n - 1)], inv)
+    ph = np.where(h < n, pos[np.minimum(h, n - 1)], inv)
+    return int(((pt == inv) | (ph == inv)).sum())
+
+
+def oracle_ecv(tail, head, ins_t, ins_h):
+    """The fresh-rebuild oracle: re-sequence + rebuild + repartition
+    over the full current edge set — what a cold offline run would
+    serve."""
+    at = np.concatenate([tail, ins_t])
+    ah = np.concatenate([head, ins_h])
+    n = int(max(at.max(), ah.max())) + 1
+    seq = degree_sequence_from_degrees(host_degree_histogram(at, ah, n))
+    forest = build_forest(at, ah, seq, max_vid=n - 1)
+    jparts = partition_forest(forest, NUM_PARTS,
+                              TreePartitionOptions(balance_factor=BALANCE))
+    pos = sequence_positions(seq, n - 1)
+    return int(ecv_down(_vid_parts(jparts, seq, n), at, ah, pos))
+
+
+def _vid_parts(jparts, seq, n):
+    from sheep_tpu import INVALID_PART
+    pos = sequence_positions(seq, n - 1)
+    out = np.full(n, INVALID_PART, dtype=jparts.dtype)
+    ok = pos != np.uint32(0xFFFFFFFF)
+    out[ok] = np.asarray(jparts)[pos[ok]]
+    return out
+
+
+def run_arm(arm, graph, stream, batches, batch, workdir):
+    sd = os.path.join(workdir, f"arm-{arm}")
+    core = ServeCore.bootstrap(
+        sd, graph_path=graph, num_parts=NUM_PARTS, balance=BALANCE,
+        # the detectors, tuned so the bench exercises them: repartition
+        # on cut drift as the daemon would, reseq on sequence drift
+        drift_min_cut=64, drift_frac=0.10,
+        reseq_min=min(256, batch), reseq_frac=0.10)
+    tail = core.edges_tail.copy()
+    head = core.edges_head.copy()
+    series = []
+    t0 = time.monotonic()
+    for b in range(batches):
+        rows = stream[b * batch:(b + 1) * batch]
+        for row in rows:
+            core.insert(row.reshape(1, 2))
+        actions = []
+        if arm == "repart-only" and core.drift_exceeded():
+            core.repartition()
+            actions.append("repartition")
+        elif arm == "reseq" and core.seq_drift_exceeded():
+            res = run_reseq(core, force=True)
+            actions.append(f"reseq->gen{res.get('seq_gen')}")
+        cur = core.ecv()["ecv_down"]
+        k = (b + 1) * batch
+        uns = unserved_edges(core, stream[:k, 0], stream[:k, 1])
+        quality = cur + uns
+        orc = oracle_ecv(tail, head, stream[:k, 0].copy(),
+                         stream[:k, 1].copy())
+        series.append({"inserted": k, "ecv_down": int(cur),
+                       "unserved_edges": uns, "quality": int(quality),
+                       "oracle_ecv": int(orc),
+                       "ratio": round(quality / max(orc, 1), 4),
+                       "actions": actions})
+        print(f"  [{arm}] batch {b + 1}/{batches}: ecv={cur} "
+              f"unserved={uns} oracle={orc} "
+              f"ratio={quality / max(orc, 1):.3f} "
+              f"{' '.join(actions)}", flush=True)
+    out = {"series": series, "seq_gen": core.seq_gen,
+           "reseqs": core.reseqs,
+           "wall_s": round(time.monotonic() - t0, 2)}
+    core.close()
+    return out
+
+
+def main(argv):
+    graph = argv[1] if len(argv) > 1 else os.path.join(REPO, "data",
+                                                       "hep-th.dat")
+    out_path = argv[2] if len(argv) > 2 else os.path.join(
+        REPO, "DRIFTBENCH_r01.json")
+    batches = int(os.environ.get("DRIFTBENCH_BATCHES", "6"))
+    batch = int(os.environ.get("DRIFTBENCH_BATCH", "1500"))
+    seed = int(os.environ.get("DRIFTBENCH_SEED", "7"))
+
+    el = load_edges(graph)
+    tail = np.asarray(el.tail, np.uint32)
+    head = np.asarray(el.head, np.uint32)
+    stream = power_law_stream(tail, head, batches * batch, seed)
+    workdir = tempfile.mkdtemp(prefix="driftbench-")
+    print(f"DRIFTBENCH: {graph} ({len(tail)} edges) + {batches}x{batch} "
+          f"power-law inserts (seed {seed})", flush=True)
+    arms = {}
+    try:
+        for arm in ("pst-only", "repart-only", "reseq"):
+            arms[arm] = run_arm(arm, graph, stream, batches, batch,
+                                workdir)
+    finally:
+        shutil.rmtree(workdir, ignore_errors=True)
+
+    rs = [p["ratio"] for p in arms["reseq"]["series"]]
+    finals = {a: arms[a]["series"][-1]["ratio"] for a in arms}
+    reseq_bounded = (rs[-1] <= max(rs) + 1e-9
+                     and all(finals["reseq"] < finals[a]
+                             for a in ("pst-only", "repart-only")))
+    pst = [p["ratio"] for p in arms["pst-only"]["series"]]
+    others_monotone = all(b >= a - 1e-6 for a, b in zip(pst, pst[1:]))
+    record = {
+        "bench": "DRIFTBENCH", "rev": "r01", "graph": graph,
+        "edges": int(len(tail)), "batches": batches, "batch": batch,
+        "seed": seed, "num_parts": NUM_PARTS,
+        "arms": arms, "final_ratios": finals,
+        "reseq_bounded_decay": bool(reseq_bounded),
+        "others_decay_monotone": bool(others_monotone),
+        "accept": bool(reseq_bounded and others_monotone),
+        "env_capture": env_capture(),
+    }
+    with open(out_path, "w") as f:
+        json.dump(record, f, indent=2, sort_keys=True)
+        f.write("\n")
+    print(f"DRIFTBENCH: final ratios {finals} "
+          f"reseq_bounded={reseq_bounded} "
+          f"others_monotone={others_monotone} -> {out_path}", flush=True)
+    return 0 if record["accept"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))

@@ -153,7 +153,10 @@ def main(argv: list[str] | None = None) -> int:
         return 2
     core_kw = dict(snap_every=config.snap_every,
                    drift_frac=config.drift_frac,
-                   drift_min_cut=config.drift_min_cut)
+                   drift_min_cut=config.drift_min_cut,
+                   reseq_frac=config.reseq_frac,
+                   reseq_min=config.reseq_min,
+                   reseq_rank=config.reseq_rank)
     try:
         bootstrap = not snap_paths(state_dir) if os.path.isdir(state_dir) \
             else True

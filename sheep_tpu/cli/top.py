@@ -17,7 +17,10 @@ While a live migration is visible (ISSUE 17) two extra columns appear:
 ``MIG`` (snap/delta/cutover on the adopting target, ``moved`` on the
 fenced source) and ``DLAG`` (delta-stream records the target still
 trails by); the footer adds the router's migration tallies and, when
-the rebalancer is on, its go/hold verdict counts.  An ``instances``
+the rebalancer is on, its go/hold verdict counts.  Likewise once a
+tenant reports sequence drift or a completed re-sequence (ISSUE 18)
+the ``SDRIFT`` (out-of-sequence inserts since the last cut) and
+``RESEQ`` (completed re-sequence generations) columns appear.  An ``instances``
 footer shows per-instance epoch/lag/RSS from the same scrape.
 
 ``--json`` takes two scrapes ``-i`` seconds apart (default 1.0; 0 =
@@ -83,7 +86,8 @@ def fleet_view(samples) -> dict:
         return tenants.setdefault(
             t, {"instances": [], "resident_on": [], "requests": 0.0,
                 "window_p99_ms": None, "applied_seqno": 0,
-                "cluster": None, "mig": None, "mig_lag": None})
+                "cluster": None, "mig": None, "mig_lag": None,
+                "seq_drift": None, "reseqs": None})
 
     for name, labels, val in samples:
         inst = labels.get("instance")
@@ -142,6 +146,14 @@ def fleet_view(samples) -> dict:
             rec = tn(labels)
             if rec is not None:
                 rec["mig_lag"] = max(rec["mig_lag"] or 0, int(val))
+        elif name == "sheep_serve_seq_drift":
+            rec = tn(labels)
+            if rec is not None:
+                rec["seq_drift"] = max(rec["seq_drift"] or 0, int(val))
+        elif name == "sheep_serve_reseqs_total":
+            rec = tn(labels)
+            if rec is not None:
+                rec["reseqs"] = max(rec["reseqs"] or 0, int(val))
         elif name == "sheep_worker_legs_inflight":
             wk(labels)["legs_inflight"] = int(val)
         elif name == "sheep_worker_legs_done":
@@ -205,10 +217,16 @@ def render_table(view: dict, scrape_bytes: int) -> str:
     # the scrape (the remote-worker-columns discipline: byte-stable
     # output for fleets that never migrate)
     migrating = any(rec.get("mig") for rec in view["tenants"].values())
+    # same discipline for the re-sequence columns (ISSUE 18): they only
+    # appear once a tenant reports sequence drift or a completed reseq
+    reseqing = any(rec.get("reseqs") or rec.get("seq_drift")
+                   for rec in view["tenants"].values())
     head = (f"{'TENANT':<12} {'CLUSTER':<8} {'QPS':>8} {'P99w':>9} "
             f"{'LAG':>5} {'EPOCH':>5} {'RES':>4} {'APPLIED':>9}")
     if migrating:
         head += f" {'MIG':>8} {'DLAG':>6}"
+    if reseqing:
+        head += f" {'SDRIFT':>6} {'RESEQ':>5}"
     lines = [head, "-" * len(head)]
     for t, rec in sorted(view["tenants"].items()):
         p99 = rec.get("window_p99_ms")
@@ -223,6 +241,11 @@ def render_table(view: dict, scrape_bytes: int) -> str:
             mlag = rec.get("mig_lag")
             row += (f" {rec.get('mig') or '-':>8} "
                     f"{(mlag if mlag is not None else '-'):>6}")
+        if reseqing:
+            sd = rec.get("seq_drift")
+            rq = rec.get("reseqs")
+            row += (f" {(sd if sd is not None else '-'):>6} "
+                    f"{(rq if rq is not None else '-'):>5}")
         lines.append(row)
     lines.append("")
     ihead = (f"{'INSTANCE':<22} {'CLUSTER':<8} {'EPOCH':>5} "

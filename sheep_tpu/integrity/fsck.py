@@ -105,11 +105,36 @@ def _fsck_wal(path: str, mode: str) -> str:
     if best is not None:
         snap_path, snap = best
         if snap.sig != sig:
-            raise MalformedArtifact(
-                f"{path}: WAL signature {sig[:12]}... does not match "
-                f"snapshot {os.path.basename(snap_path)} "
-                f"({snap.sig[:12]}...) — log and snapshot are not one "
-                f"recovery chain")
+            # a sig mismatch is corruption UNLESS the reseq manifest
+            # sanctions it (ISSUE 18): the crash window between the
+            # re-sequence seal and the WAL swap leaves the old-sig log
+            # beside the new-generation snapshot, and ServeCore.open
+            # heals exactly that — provided no log record lies past the
+            # snapshot boundary.  Records past it are the torn mid-swap
+            # state: refused strict, reported truncatable in repair.
+            from ..serve.reseq import sanctions_sig_change
+            if not sanctions_sig_change(here, sig, snap.sig):
+                raise MalformedArtifact(
+                    f"{path}: WAL signature {sig[:12]}... does not match "
+                    f"snapshot {os.path.basename(snap_path)} "
+                    f"({snap.sig[:12]}...) — log and snapshot are not one "
+                    f"recovery chain")
+            beyond = sum(1 for s, _ in records
+                         if s > snap.applied_seqno)
+            if beyond:
+                if mode != "repair":
+                    raise MalformedArtifact(
+                        f"{path}: torn mid-re-sequence swap — the "
+                        f"old-generation log holds {beyond} record(s) "
+                        f"past the re-sequenced snapshot boundary "
+                        f"{snap.applied_seqno} "
+                        f"({os.path.basename(snap_path)}); they were "
+                        f"applied to a tree that no longer exists and "
+                        f"can only be truncated (repair mode)")
+                detail += (f" reseq_heal=pending "
+                           f"torn_records={beyond} truncatable")
+            else:
+                detail += " reseq_heal=pending"
         if epoch < snap.epoch and records and last > snap.applied_seqno:
             raise MalformedArtifact(
                 f"{path}: cross-epoch seqno overlap — the epoch-{epoch} "
@@ -140,10 +165,16 @@ def _fsck_wal(path: str, mode: str) -> str:
         except (IntegrityError, OSError):
             continue  # the sibling fails on its own fsck line
         if o_sig != sig:
-            raise MalformedArtifact(
-                f"{path}: sibling log {os.path.basename(other)} names a "
-                f"different build input ({o_sig[:12]}... vs "
-                f"{sig[:12]}...) — one state dir, two histories")
+            # sibling logs across a sanctioned re-sequence (an archived
+            # pre-reseq log beside the new-generation live one) are one
+            # history in two generations, not two histories
+            from ..serve.reseq import sanctions_sig_change
+            if not (sanctions_sig_change(here, o_sig, sig)
+                    or sanctions_sig_change(here, sig, o_sig)):
+                raise MalformedArtifact(
+                    f"{path}: sibling log {os.path.basename(other)} "
+                    f"names a different build input ({o_sig[:12]}... vs "
+                    f"{sig[:12]}...) — one state dir, two histories")
         if o_epoch == epoch or not records or not o_records:
             continue
         o_first, o_last = o_records[0][0], o_records[-1][0]
@@ -165,9 +196,12 @@ def _fsck_snap(path: str, mode: str) -> str:
     snap = load_serve_snapshot(path, integrity=mode)
     from .. import INVALID_JNID
     links = int((snap.parent != INVALID_JNID).sum())
-    return (f"n={len(snap.seq)} links={links} "
-            f"applied={snap.applied_seqno} epoch={snap.epoch} "
-            f"inserted={len(snap.ins_tail)} parts={snap.num_parts}")
+    detail = (f"n={len(snap.seq)} links={links} "
+              f"applied={snap.applied_seqno} epoch={snap.epoch} "
+              f"inserted={len(snap.ins_tail)} parts={snap.num_parts}")
+    if snap.seq_gen:
+        detail += f" seq_gen={snap.seq_gen}"
+    return detail
 
 
 def _fsck_trace(path: str, mode: str) -> str:
@@ -365,7 +399,8 @@ def fsck_paths(paths, mode: str | None = None):
     for root in paths:
         targets = collect_artifacts(root)
         chain = _manifest_chain_result(root, mode)
-        if not targets and chain is None:
+        reseq_chain = _reseq_chain_result(root, mode)
+        if not targets and chain is None and reseq_chain is None:
             results.append((root, False, "no artifacts found"))
             continue
         for path in targets:
@@ -376,8 +411,85 @@ def fsck_paths(paths, mode: str | None = None):
                 results.append((path, False, str(exc)))
         if chain is not None:
             results.append(chain)
+        if reseq_chain is not None:
+            results.append(reseq_chain)
     failures = [r for r in results if not r[1]]
     return results, failures
+
+
+def _reseq_chain_result(root: str, mode: str):
+    """The re-sequence generation-chain line for a serve state dir
+    (ISSUE 18), or None when the root is a file / never re-sequenced
+    AND its snapshots are all generation 0.
+
+    What it refuses: a snapshot claiming a sequence generation its
+    reseq manifest never sanctioned (silent tampering or a foreign
+    snapshot dropped into the dir), an unparseable manifest, and — in
+    strict mode — an in-flight manifest whose durable inputs are gone
+    (phase ``swap`` with neither a pending artifact nor fold
+    checkpoints, on a dir whose snapshot is still the OLD generation:
+    resumable only by a full refold, which repair-mode reports and
+    strict refuses to vouch for)."""
+    from ..serve import reseq as reseq_mod
+    from ..serve.state import load_serve_snapshot, snap_paths
+    if not os.path.isdir(root):
+        return None
+    mpath = reseq_mod.manifest_path(root)
+    has_manifest = os.path.exists(mpath)
+    # newest loadable snapshot's (gen, sig) is what the chain must vouch
+    best = None
+    for snap_path in snap_paths(root):
+        try:
+            snap = load_serve_snapshot(snap_path, integrity="trust")
+        except (IntegrityError, OSError):
+            continue
+        if best is None or ((snap.epoch, snap.applied_seqno)
+                            > (best[1].epoch, best[1].applied_seqno)):
+            best = (snap_path, snap)
+    if not has_manifest:
+        if best is not None and best[1].seq_gen:
+            return (mpath, False,
+                    f"{os.path.basename(best[0])} claims sequence "
+                    f"generation {best[1].seq_gen} but no reseq manifest "
+                    f"exists to sanction it — not one recovery chain")
+        return None
+    try:
+        man = reseq_mod.load_manifest(root)
+    except (IntegrityError, OSError) as exc:
+        return (mpath, False, str(exc))
+    phase = man.get("phase", "?")
+    chain = [(int(c.get("gen", -1)), c.get("sig", ""))
+             for c in man.get("chain", []) if isinstance(c, dict)]
+    detail = f"phase={phase} generations={len(chain)}"
+    if best is not None:
+        snap_path, snap = best
+        sanctioned = dict(chain)
+        if phase in ("swap", "adopt", "done"):
+            sanctioned.setdefault(int(man.get("new_gen", -1)),
+                                  man.get("new_sig", ""))
+        if sanctioned.get(snap.seq_gen) != snap.sig:
+            return (mpath, False,
+                    f"{os.path.basename(snap_path)} serves sequence "
+                    f"generation {snap.seq_gen} (sig "
+                    f"{snap.sig[:12]}...) which the reseq manifest "
+                    f"chain never sanctioned — torn or foreign swap")
+        detail += f" snap_gen={snap.seq_gen} chain-ok"
+    if phase not in reseq_mod.DONE_PHASES:
+        resumable = (os.path.exists(reseq_mod.pending_path(root))
+                     or os.path.isdir(reseq_mod.ckpt_dir(root)))
+        if phase == "swap" and not resumable \
+                and best is not None \
+                and best[1].seq_gen < int(man.get("new_gen", 0)):
+            if mode != "repair":
+                return (mpath, False,
+                        f"in-flight re-sequence at phase=swap lost its "
+                        f"pending artifact and checkpoints — resumable "
+                        f"only by a full refold (repair mode reports, "
+                        f"strict refuses)")
+            detail += " in_flight=refold-required"
+        else:
+            detail += " in_flight=resumable"
+    return (mpath, True, detail)
 
 
 def _manifest_chain_result(root: str, mode: str):

@@ -295,7 +295,8 @@ def build_forest_extmem(path: str, block_edges: int | None = None,
                         events: list | None = None,
                         perf: dict | None = None,
                         start_edge: int = 0,
-                        end_edge: int | None = None):
+                        end_edge: int | None = None,
+                        tail_edges=None):
     """The external-memory build: ``(seq uint32 [m], Forest over m)``,
     bit-identical to ``build_forest`` over the loaded file, with peak
     resident memory O(n + block) beyond the interpreter — the edge list
@@ -321,6 +322,15 @@ def build_forest_extmem(path: str, block_edges: int | None = None,
     tournament already carries); the slice is folded into the checkpoint
     identity so a leg's checkpoint can never resume under a different
     shard map.
+    ``tail_edges`` — an optional ``(tail, head)`` uint32 pair folded as
+    one final in-memory block AFTER the stream (ISSUE 18: the serve
+    tier's WAL'd inserts riding the same fold as the ``.dat`` records —
+    the re-sequence rebuild is "the offline build over .dat + log").
+    The tail is folded into the checkpoint identity (count + crc), so a
+    checkpoint can never resume under a different insert cut; the tail
+    block itself is never checkpointed — a crash inside it resumes from
+    the last STREAM boundary and refolds it, bit-identically by the
+    associative-merge property.
     """
     t_start = time.perf_counter()
     events = events if events is not None else []
@@ -351,6 +361,12 @@ def build_forest_extmem(path: str, block_edges: int | None = None,
     sig = input_signature(n, seq) + f"|ext:b{block}"
     if (start_edge, end_edge) != (0, None):
         sig += f"|range:{start_edge}:{end_edge}"
+    if tail_edges is not None:
+        import zlib
+        t_t = np.ascontiguousarray(tail_edges[0], dtype=np.uint32)
+        t_h = np.ascontiguousarray(tail_edges[1], dtype=np.uint32)
+        tcrc = zlib.crc32(t_h.tobytes(), zlib.crc32(t_t.tobytes()))
+        sig += f"|tail:{len(t_t)}:{tcrc:08x}"
     ckpt = Checkpointer(checkpoint_dir, checkpoint_every, governor=gov) \
         if checkpoint_dir else None
     fold = _ExtFold(n, sequence_positions(seq))
@@ -404,6 +420,15 @@ def build_forest_extmem(path: str, block_edges: int | None = None,
             policy.sleep(policy.backoff(attempt))
             attempt += 1
     done = progress["done"]
+    if tail_edges is not None and len(t_t):
+        # the WAL'd tail, folded through the SAME carry-fold machinery
+        # as the stream blocks (one more partial graph in the
+        # associative merge); runs after every stream block so a resume
+        # never double-folds it
+        with obs.timed("ext.fold", out=stats["fold_series"],
+                       block="tail", records=len(t_t)):
+            strat = fold.fold_block(t_t, t_h)
+        events.append(("ext-tail", len(t_t), strat))
     pst32 = fold.pst.astype(np.uint32)
     forest = Forest(fold.parent.copy(), pst32)
     if ckpt is not None:

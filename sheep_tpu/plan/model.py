@@ -551,3 +551,63 @@ def plan_migration(records: int, tenant_qps: float, src_qps: float,
                reason=f"imbalance {before:.1f} -> {after:.1f} qps for a "
                       f"{cost_s:.2f}s transfer")
     return out
+
+
+#: pin the re-sequence decision: "go" | "stay" | "" (priced)
+RESEQ_PIN_ENV = "SHEEP_RESEQ_PIN"
+#: amortization horizon for the rebuild (seconds)
+RESEQ_HORIZON_ENV = "SHEEP_RESEQ_HORIZON_S"
+#: assumed carry-fold throughput of the streamed rebuild — deliberately
+#: coarse (same discipline as TRANSPORT_*): the decision only has to be
+#: right about the SHAPE (a rebuild is seconds, not hours), and
+#: SHEEP_RESEQ_PIN is the operator's word when it is not
+RESEQ_FOLD_BPS = 64 << 20
+
+
+def plan_reseq(records: int, inserted: int, seq_drift: int,
+               pin: str | None = None,
+               horizon_s: float | None = None) -> dict:
+    """Price a full re-sequence rebuild for the serve tier (ISSUE 18,
+    serve/reseq.py): the detector already fired — is the streamed fold
+    over ``.dat + log`` worth running NOW?
+
+    The model: the rebuild streams ``(records + inserted) * 12`` bytes
+    off local disk and folds them (``bytes/DISK + bytes/FOLD``); the
+    counting-sort sequence pass and the partition sweep are noise beside
+    the fold.  GO when the rebuild amortizes inside ``horizon_s`` AND
+    there is real drift to recover (``seq_drift > 0``) — a drift-free
+    forced rebuild is the operator's call (``SHEEP_RESEQ_PIN=go`` or the
+    RESEQ verb's force), not the planner's.  The daemon's own detector
+    gates (SHEEP_RESEQ_DRIFT / _DRIFT_MIN) run BEFORE this pricing,
+    exactly like the rebalancer's hysteresis."""
+    if pin is None:
+        pin = os.environ.get(RESEQ_PIN_ENV, "")
+    if horizon_s is None:
+        horizon_s = float(os.environ.get(RESEQ_HORIZON_ENV, "") or 60.0)
+    blob = (max(0, int(records)) + max(0, int(inserted))) * 12
+    out = {"blob_bytes": blob, "records": max(0, int(records)),
+           "inserted": max(0, int(inserted)),
+           "seq_drift": max(0, int(seq_drift)),
+           "cost_s": None, "reason": ""}
+    if pin in ("go", "stay"):
+        out.update(decision=pin, provenance=PROV_FORCED,
+                   reason=f"pinned by {RESEQ_PIN_ENV}")
+        return out
+    if pin:
+        raise ValueError(f"{RESEQ_PIN_ENV}={pin!r} must be "
+                         f"'go' or 'stay'")
+    cost_s = blob / TRANSPORT_DISK_BPS + blob / RESEQ_FOLD_BPS
+    out["cost_s"] = round(cost_s, 6)
+    if seq_drift <= 0:
+        out.update(decision="stay", provenance=PROV_DEFAULT,
+                   reason="no sequence drift to recover")
+        return out
+    if cost_s > horizon_s:
+        out.update(decision="stay", provenance=PROV_PRICED,
+                   reason=f"rebuild ({cost_s:.1f}s) does not amortize "
+                          f"inside the {horizon_s:g}s horizon")
+        return out
+    out.update(decision="go", provenance=PROV_PRICED,
+               reason=f"{seq_drift} drifted insert(s) recovered for a "
+                      f"{cost_s:.2f}s streamed rebuild")
+    return out

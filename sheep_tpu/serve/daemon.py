@@ -88,6 +88,13 @@ MAX_INFLIGHT_ENV = "SHEEP_SERVE_MAX_INFLIGHT"
 SNAP_EVERY_ENV = "SHEEP_SERVE_SNAP_EVERY"
 DRIFT_ENV = "SHEEP_SERVE_DRIFT"
 DRIFT_MIN_ENV = "SHEEP_SERVE_DRIFT_MIN"
+#: the re-sequence family (ISSUE 18): master switch, sequence-drift
+#: fraction, minimum inserts before the detector may fire, and the
+#: degree-rank movement that counts an in-sequence insert as drifted
+RESEQ_ENV = "SHEEP_RESEQ"
+RESEQ_DRIFT_ENV = "SHEEP_RESEQ_DRIFT"
+RESEQ_DRIFT_MIN_ENV = "SHEEP_RESEQ_DRIFT_MIN"
+RESEQ_RANK_ENV = "SHEEP_RESEQ_RANK"
 
 #: a connection whose un-flushed responses exceed this is a slow
 #: consumer and is closed (replication peers get snapshot-sized room)
@@ -106,6 +113,12 @@ class ServeConfig:
     snap_every: int = 256
     drift_frac: float = 0.1
     drift_min_cut: int = 64
+    #: re-sequencing (ISSUE 18): the detector thresholds travel to the
+    #: core (cli/serve.py's core_kw); ``reseq`` gates the background job
+    reseq: bool = True
+    reseq_frac: float = 0.25
+    reseq_min: int = 256
+    reseq_rank: int = 8
     read_only: bool = False
     #: ceiling on how long an injected hang may stall a handler
     hang_cap_s: float = 2.0
@@ -124,6 +137,14 @@ class ServeConfig:
             kw["drift_frac"] = float(os.environ[DRIFT_ENV])
         if os.environ.get(DRIFT_MIN_ENV):
             kw["drift_min_cut"] = int(os.environ[DRIFT_MIN_ENV])
+        if os.environ.get(RESEQ_ENV):
+            kw["reseq"] = os.environ[RESEQ_ENV] not in ("0", "no", "off")
+        if os.environ.get(RESEQ_DRIFT_ENV):
+            kw["reseq_frac"] = float(os.environ[RESEQ_DRIFT_ENV])
+        if os.environ.get(RESEQ_DRIFT_MIN_ENV):
+            kw["reseq_min"] = int(os.environ[RESEQ_DRIFT_MIN_ENV])
+        if os.environ.get(RESEQ_RANK_ENV):
+            kw["reseq_rank"] = int(os.environ[RESEQ_RANK_ENV])
         kw.update(overrides)
         return cls(**kw)
 
@@ -198,6 +219,7 @@ class ServeDaemon:
         self._hb: HeartbeatWriter | None = None
         self._env_hb = None
         self._repartitioning = threading.Lock()
+        self._resequencing = threading.Lock()
         self._role_lock = threading.RLock()
         self.started_at = time.monotonic()
         self._status_written = 0.0
@@ -305,6 +327,9 @@ class ServeDaemon:
             if self.role == "follower":
                 self._start_replicators()
             self.watcher = FailoverWatcher(self, self.cluster).start()
+        # a kill -9 mid-re-sequence left a durable manifest: resume (or
+        # cleanly abort) it now, in the background (ISSUE 18)
+        self._resume_pending_reseqs()
         self._write_status(force=True)
         return self
 
@@ -821,12 +846,21 @@ class ServeDaemon:
             return False
         hub = self._hub_for(tenant)
         core = hub.core
+        reseq_behind = False
         if sig != "-" and sig != core.sig:
-            self._send_async(conn, (err_line(
-                "badrepl", f"replica belongs to a different build input "
-                f"(sig {sig[:12]}..., ours {core.sig[:12]}...)")
-                + "\n").encode("ascii"))
-            return False
+            # a sig the reseq manifest chains is a follower one or more
+            # sequence generations BEHIND us (ISSUE 18) — it adopts our
+            # snapshot; a sig the chain has never seen is a foreign
+            # build input and is refused exactly as before
+            from .reseq import chain_has_sig
+            if core.state_dir and chain_has_sig(core.state_dir, sig):
+                reseq_behind = True
+            else:
+                self._send_async(conn, (err_line(
+                    "badrepl", f"replica belongs to a different build "
+                    f"input (sig {sig[:12]}..., ours {core.sig[:12]}...)")
+                    + "\n").encode("ascii"))
+                return False
         if epoch > core.epoch:
             # the caller lives in a later term than we do: we are the
             # stale one.  Refuse typed and let the fence check demote us.
@@ -837,7 +871,8 @@ class ServeDaemon:
         # stream iff the replica's position is inside our retention
         # window AND (same epoch, or at/before the promotion boundary —
         # past it an old-epoch replica may carry a divergent tail)
-        can_stream = (core.records_from(seqno) is not None
+        can_stream = (not reseq_behind
+                      and core.records_from(seqno) is not None
                       and seqno <= core.applied_seqno
                       and (epoch == core.epoch
                            or seqno <= core.epoch_base))
@@ -1158,6 +1193,7 @@ class ServeDaemon:
                         f"and will replicate, but is NOT acknowledged"), \
                         False
             self._maybe_background_repartition(core)
+            self._maybe_background_reseq(core, self._hub_for(tenant))
             self.tenants.maybe_evict_cold()
             return ok_kv(seq=seqno, applied=len(pairs)), False
         if verb == "SNAPSHOT":
@@ -1168,6 +1204,26 @@ class ServeDaemon:
                 self.counters["notleader"] += 1
                 return err_line("notleader", self.leader_addr()), False
             return ok_kv(**core.repartition()), False
+        if verb == "RESEQ":
+            # the operator's forced re-sequence (ISSUE 18): runs the
+            # full crash-safe job inline — pricing skipped (force), swap
+            # announced to followers.  One at a time daemon-wide, same
+            # rationing as REPARTITION's background trigger.
+            if self.role != "leader":
+                self.counters["notleader"] += 1
+                return err_line("notleader", self.leader_addr()), False
+            if not self._resequencing.acquire(blocking=False):
+                return err_line("unavailable",
+                                "a re-sequence is already running"), False
+            try:
+                from .reseq import run_reseq
+                res = run_reseq(core, force=True,
+                                hub=self._hub_for(tenant),
+                                events=self.config.events)
+            finally:
+                self._resequencing.release()
+            res.pop("plan", None)  # kv lines carry scalars only
+            return ok_kv(**res), False
         raise BadRequest(f"unhandled verb {verb!r}")  # unreachable
 
     def _handle_mig(self, req) -> str:
@@ -1296,11 +1352,25 @@ class ServeDaemon:
                          "1 = tenant is in this migration phase here")
         mlag = m.gauge("sheep_serve_mig_delta_lag_records",
                        "migration delta-stream lag on the target")
+        # sequence-drift visibility (ISSUE 18): the quality-decay gauges
+        # an operator watches to see the re-sequence detector approach
+        # its threshold, plus the generation the tenant serves
+        sdrift = m.gauge("sheep_serve_seq_drift",
+                         "inserts the current sequence mis-handles "
+                         "(out-of-sequence or rank-moved) since the "
+                         "last re-sequence cut")
+        rsq = m.gauge("sheep_serve_reseqs_total",
+                      "completed re-sequence swaps per tenant")
+        sgen = m.gauge("sheep_serve_seq_gen",
+                       "sequence generation currently served")
         for name in self.tenants.names():
             t = self.tenants.get(name)
             res.labels(tenant=name).set(int(t.resident))
             if t.core is not None:
                 app.labels(tenant=name).set(t.core.applied_seqno)
+                sdrift.labels(tenant=name).set(t.core.seq_drift)
+                rsq.labels(tenant=name).set(t.core.reseqs)
+                sgen.labels(tenant=name).set(t.core.seq_gen)
             evg.labels(tenant=name).set(t.evictions)
             rsg.labels(tenant=name).set(t.restores)
             if t.mig is not None:
@@ -1498,3 +1568,71 @@ class ServeDaemon:
         t = threading.Thread(target=work, daemon=True,
                              name="serve-repartition")
         t.start()
+
+    def _maybe_background_reseq(self, core: ServeCore, hub) -> None:
+        """Kick the sequence-drift-triggered re-sequence (ISSUE 18)
+        exactly once at a time, daemon-wide — the streamed fold is the
+        expensive thing being rationed.  Queries serve the stale (but
+        consistent) generation until the ticket-guarded swap; the run
+        itself is priced by plan_reseq and may still decline."""
+        if not self.config.reseq or self.role != "leader":
+            return
+        if not core.seq_drift_exceeded():
+            return
+        if not self._resequencing.acquire(blocking=False):
+            return  # one already running
+
+        def work():
+            try:
+                from .reseq import run_reseq
+                res = run_reseq(core, hub=hub,
+                                events=self.config.events)
+                self.config.events.append(
+                    ("reseq", core.reseqs, res.get("reason", "")))
+            except Exception as exc:
+                # the old generation keeps serving; the detector will
+                # re-fire and retry off the durable manifest
+                self.config.events.append(("reseq_error", str(exc)))
+            finally:
+                self._resequencing.release()
+
+        t = threading.Thread(target=work, daemon=True,
+                             name="serve-reseq")
+        t.start()
+
+    def _resume_pending_reseqs(self) -> None:
+        """Startup sweep (leader only): any tenant whose state dir holds
+        an in-flight reseq manifest — a kill -9 mid-rebuild — resumes
+        (or cleanly aborts) it in the background, off the manifest's
+        durable phase."""
+        if not self.config.reseq or self.role != "leader":
+            return
+        from .reseq import active, resume_reseq
+        pending = []
+        for name in self.tenants.names():
+            t = self.tenants.get(name)
+            if t.core is not None and t.core.state_dir \
+                    and active(t.core.state_dir):
+                pending.append(t)
+        if not pending:
+            return
+        if not self._resequencing.acquire(blocking=False):
+            return
+
+        def work():
+            try:
+                for t in pending:
+                    try:
+                        res = resume_reseq(t.core, hub=self._hub_for(t),
+                                           events=self.config.events)
+                        if res is not None:
+                            self.config.events.append(
+                                ("reseq_resume", t.name, res))
+                    except Exception as exc:
+                        self.config.events.append(
+                            ("reseq_error", f"{t.name}: {exc}"))
+            finally:
+                self._resequencing.release()
+
+        threading.Thread(target=work, daemon=True,
+                         name="serve-reseq-resume").start()

@@ -30,6 +30,22 @@ The sites are the boundaries of one request's lifecycle:
           here must change nothing on replay (the record is already
           applied; replay must be idempotent by seqno)
 
+The re-sequence job (ISSUE 18, serve/reseq.py) adds its four phase
+boundaries — each one a point where kill -9 must resume or abort
+cleanly off the durable reseq manifest (mid-FOLD kills are the extmem
+checkpoint boundaries: ``SHEEP_FAULT_PLAN``'s ``ext-boundary`` site):
+
+  reseq-hist  before the histogram/counting-sort sequence rebuild
+              (manifest just durable at phase "hist")
+  reseq-fold  before the streamed fold over .dat + WAL'd inserts
+              (manifest at phase "fold", new sig pinned)
+  reseq-swap  before the in-memory swap (pending tree artifact + phase
+              "swap" durable; a kill here must redo the swap from the
+              pending artifact, bit-identically)
+  reseq-seal  after the swap, before the sealing snapshot — the
+              in-memory state is new, the disk is old: a kill here
+              restarts on the OLD generation and resumes the rebuild
+
 Kinds:
 
   kill    the daemon dies instantly (``os._exit(137)`` — no atexit, no
@@ -54,7 +70,8 @@ from dataclasses import dataclass, field
 SERVE_FAULT_PLAN_ENV = "SHEEP_SERVE_FAULT_PLAN"
 
 KINDS = ("kill", "hang", "slow")
-SITES = ("req", "query", "insert", "wal", "apply", "*")
+SITES = ("req", "query", "insert", "wal", "apply",
+         "reseq-hist", "reseq-fold", "reseq-swap", "reseq-seal", "*")
 
 #: how long a "slow" fault stalls while holding its slot
 SLOW_S = 0.25

@@ -50,6 +50,14 @@ build-worker wire's (ISSUE 16, serve/worker.py):
          legs); every cutover verb is idempotent, so drop/partition =
          the driver retries or aborts cleanly back to the source, dup =
          the verb lands twice and the second is a no-op
+  reseq  one replicated re-sequence announce (ISSUE 18: the REPL RESEQ
+         frame broadcasting the swap); drop = the follower trips the
+         ``gen=`` stamp on the next APPEND instead and snapshot-adopts
+         then, partition = reconnect re-HELLOs into the sig-mismatch
+         snapshot answer, dup = the second frame finds the follower
+         already on the announced generation and ACKs idempotently —
+         every arm converges on whole-generation adoption, never a
+         half-swapped tree
 
 Kinds model the distinct network failure shapes, each driving a
 DIFFERENT follower recovery path:
@@ -83,7 +91,7 @@ NETFAULT_PLAN_ENV = "SHEEP_SERVE_NETFAULT_PLAN"
 
 KINDS = ("drop", "partition", "slow", "dup")
 SITES = ("repl", "hb", "wleg", "wbeat", "wart",
-         "msnap", "mdelta", "mcut", "*")
+         "msnap", "mdelta", "mcut", "reseq", "*")
 
 #: how long a "slow" network fault delays one frame
 SLOW_S = 0.05

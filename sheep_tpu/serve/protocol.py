@@ -131,8 +131,10 @@ INSERT_VERBS = ("INSERT",)
 #: operator verbs (admitted as queries; SNAPSHOT/REPARTITION do their own
 #: locking in the core, EVICT seals a cold tenant out of memory).  MIG
 #: (ISSUE 17) is the daemon-side migration surface the router's MIGRATE
-#: verb drives: ``MIG ADOPT|SEAL|UNSEAL|CUT|DROP|STAT <tenant> [k=v...]``
-ADMIN_VERBS = ("SNAPSHOT", "REPARTITION", "EVICT", "MIG", "QUIT")
+#: verb drives: ``MIG ADOPT|SEAL|UNSEAL|CUT|DROP|STAT <tenant> [k=v...]``.
+#: RESEQ (ISSUE 18) forces the crash-safe re-sequence rebuild the
+#: sequence-drift detector would otherwise trigger on its own
+ADMIN_VERBS = ("SNAPSHOT", "REPARTITION", "RESEQ", "EVICT", "MIG", "QUIT")
 #: the replication family (serve/replicate.py): handled OUTSIDE admission
 #: — a configured replica is cluster plumbing, not client load, and
 #: shedding it would turn an overload into a lag spiral

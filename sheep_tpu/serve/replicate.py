@@ -59,6 +59,15 @@ class ReplProtocolError(RuntimeError):
     """A replication frame this node cannot honor (maps to badrepl)."""
 
 
+class ResyncRequired(ReplProtocolError):
+    """The leader's stream moved to a sequence generation this follower
+    does not have (a RESEQ frame, or an APPEND carrying a foreign
+    ``gen=``): the stream cannot continue record-by-record — the
+    follower must re-HELLO and adopt the leader's re-sequenced snapshot
+    as one unit (ISSUE 18).  Subclasses ReplProtocolError so every
+    existing reconnect path already handles it."""
+
+
 # -- frame codec ------------------------------------------------------------
 
 
@@ -67,18 +76,34 @@ def payload_crc(payload: bytes) -> int:
 
 
 def encode_append(epoch: int, seqno: int, payload: bytes,
-                  rid: str | None = None) -> str:
+                  rid: str | None = None, gen: int = 0) -> str:
     """One WAL record -> one APPEND frame line (no trailing newline).
     ``rid`` (ISSUE 12) forwards the originating request's trace-context
     id so the follower's WAL fsync is attributable to it; the token is
     omitted when absent, and old daemons ignore it either way (kv-token
-    grammar — unknown keys pass through parse_kv_args untouched)."""
+    grammar — unknown keys pass through parse_kv_args untouched).
+    ``gen`` (ISSUE 18) stamps the record with the leader's sequence
+    generation; omitted at generation 0 so a never-re-sequenced stream
+    stays byte-identical to PR 7.  A follower on a different generation
+    trips :class:`ResyncRequired` — this is the belt under the RESEQ
+    frame's suspenders: even if the announce is lost on the wire, the
+    very next record forces the re-sync."""
     data = base64.b64encode(payload).decode("ascii")
     head = f"REPL APPEND epoch={epoch} seqno={seqno} " \
            f"crc={payload_crc(payload)}"
+    if gen:
+        head += f" gen={gen}"
     if rid is not None:
         head += f" rid={rid}"
     return f"{head} data={data}"
+
+
+def encode_reseq(epoch: int, seqno: int, gen: int, sig: str) -> str:
+    """The re-sequence announce (ISSUE 18): "everything after ``seqno``
+    is generation ``gen`` under input signature ``sig``" — a sequenced
+    barrier in the stream, never a partial apply.  A follower that is
+    not already at ``gen`` must adopt the leader's snapshot."""
+    return f"REPL RESEQ epoch={epoch} seqno={seqno} gen={gen} sig={sig}"
 
 
 def encode_ping(epoch: int, seqno: int) -> str:
@@ -175,11 +200,15 @@ def parse_frame(line: str) -> ReplFrame:
     elif kind == "NACK":
         if "expect" not in kv:
             raise ReplProtocolError("NACK frame missing expect=")
+    elif kind == "RESEQ":
+        for field in ("epoch", "seqno", "gen", "sig"):
+            if field not in kv:
+                raise ReplProtocolError(f"RESEQ frame missing {field}=")
     elif kind in ("HELLO", "FENCED", "SNAPSHOT"):
         pass
     else:
         raise ReplProtocolError(f"unknown replication frame {kind!r}")
-    for field in ("epoch", "seqno", "expect"):
+    for field in ("epoch", "seqno", "expect", "gen"):
         if field in kv:
             try:
                 if int(kv[field]) < 0:
@@ -219,6 +248,7 @@ class ReplApplier:
         self.dups = 0
         self.gaps = 0
         self.frame_errors = 0
+        self.resyncs_required = 0  # generation breaks (ISSUE 18)
         self.bursts = 0  # sealed APPEND bursts (one fsync + one ACK each)
         self._unsynced = False  # applied-but-unsynced records in the WAL
         self._ack_due = False   # an APPEND landed since the last ACK
@@ -296,7 +326,7 @@ class ReplApplier:
             self.frame_errors += 1
             self._send(encode_nack(self.core.applied_seqno + 1))
             return
-        if frame.kind not in ("APPEND", "PING"):
+        if frame.kind not in ("APPEND", "PING", "RESEQ"):
             return  # HELLO responses etc. are the Replicator's business
         epoch = frame.epoch()
         if epoch < self.core.epoch:
@@ -309,7 +339,33 @@ class ReplApplier:
             self._seal_burst()  # the old epoch's tail seals under it
             self._on_epoch(epoch)
         self.leader_seqno = max(self.leader_seqno, frame.seqno())
+        if frame.kind == "RESEQ":
+            # the swap arrives as a sequenced unit: either we are
+            # already on the announced generation (we adopted it via an
+            # earlier snapshot re-sync) or the stream cannot continue —
+            # a record-by-record replay across a re-sequence would be
+            # exactly the half-swapped tree this frame exists to forbid
+            self._seal_burst()
+            gen = int(frame.kv["gen"])
+            if self.core.seq_gen >= gen:
+                self._send(encode_ack(self.core.applied_seqno))
+                return
+            self.resyncs_required += 1
+            raise ResyncRequired(
+                f"leader re-sequenced to generation {gen} (sig "
+                f"{frame.kv['sig'][:12]}...); this follower is at "
+                f"{self.core.seq_gen} — snapshot adoption required")
         if frame.kind == "APPEND":
+            gen = int(frame.kv.get("gen", 0))
+            if gen != self.core.seq_gen:
+                # the RESEQ announce was lost (netfault drop / attach
+                # race): the record's generation stamp is the backstop
+                self._seal_burst()
+                self.resyncs_required += 1
+                raise ResyncRequired(
+                    f"APPEND seqno {frame.seqno()} carries generation "
+                    f"{gen}; this follower is at {self.core.seq_gen} — "
+                    f"snapshot adoption required")
             rid = frame.kv.get("rid")
             try:
                 # rid scope (ISSUE 12): the apply's WAL append — and, on
@@ -502,7 +558,8 @@ class ReplicationHub:
                 if not fs.alive or self._stopped:
                     return
                 line = encode_append(self.core.epoch, seqno, payload,
-                                     rid=self.core.rid_for(seqno))
+                                     rid=self.core.rid_for(seqno),
+                                     gen=self.core.seq_gen)
                 if not self._transmit(fs, line, fs.site):
                     self.detach(fs.conn)
                     return
@@ -525,6 +582,28 @@ class ReplicationHub:
                     self.detach(fs.conn)
                     return
                 last_sent_t = time.monotonic()
+
+    def announce_reseq(self) -> int:
+        """Broadcast the leader's re-sequence to every attached follower
+        as one RESEQ frame (netfault site "reseq" — the chaos sweep's
+        arm on the replicated swap).  Best-effort by design: a follower
+        that misses the frame trips the ``gen=`` stamp on the next
+        APPEND, and one that was attached to the pre-reseq WAL hits the
+        sealed-WAL snapshot path on its next drain — every road leads to
+        snapshot adoption.  Returns the number of followers reached."""
+        line = encode_reseq(self.core.epoch, self.core.applied_seqno,
+                            self.core.seq_gen, self.core.sig)
+        with self._cv:
+            targets = list(self._followers.values())
+        reached = 0
+        for fs in targets:
+            if not fs.alive:
+                continue
+            if self._transmit(fs, line, "reseq"):
+                reached += 1
+            else:
+                self.detach(fs.conn)
+        return reached
 
     # -- queries -----------------------------------------------------------
 
@@ -744,7 +823,25 @@ class Replicator:
                     f.write(blob)
                 try:
                     snap = load_serve_snapshot(tmp, integrity="trust")
-                    self.core.reset_from_snapshot(snap)
+                    if (snap.sig != self.core.sig
+                            and snap.seq_gen > self.core.seq_gen):
+                        # the leader re-sequenced (ISSUE 18): adopt the
+                        # new generation as one unit, sanctioned by a
+                        # durable adopt manifest FIRST so a kill inside
+                        # reset_from_snapshot (old WAL beside a new-sig
+                        # snapshot) heals on restart instead of refusing
+                        from . import reseq as reseq_mod
+                        reseq_mod.write_adoption(
+                            self.core.state_dir, self.core.sig,
+                            self.core.seq_gen, snap.sig, snap.seq_gen)
+                        self.core.reset_from_snapshot(
+                            snap, allow_sig_change=True)
+                        reseq_mod.finish_adoption(
+                            self.core.state_dir, snap.sig, snap.seq_gen)
+                        self.events.append(("repl_reseq_adopt",
+                                            snap.seq_gen))
+                    else:
+                        self.core.reset_from_snapshot(snap)
                 finally:
                     try:
                         os.unlink(tmp)

@@ -50,7 +50,7 @@ import numpy as np
 
 from .. import INVALID_JNID, INVALID_PART
 from ..core.forest import Forest
-from ..core.sequence import sequence_positions
+from ..core.sequence import (host_degree_histogram, sequence_positions)
 from ..integrity.errors import IntegrityError, MalformedArtifact
 from ..integrity.sidecar import resolve_policy, sealed_write, sidecar_path
 from ..partition.tree_partition import (TreePartitionOptions,
@@ -130,7 +130,9 @@ class ServeSnapshot:
 
     def __init__(self, seq, parent, pst, parts, num_parts, applied_seqno,
                  ins_tail, ins_head, drift_cut, baseline_ecv, graph_path,
-                 sig, balance, epoch=0, epoch_base=0):
+                 sig, balance, epoch=0, epoch_base=0, deg=None,
+                 deg_base=None, seq_drift=0, reseqs=0, seq_gen=0,
+                 ins_base=0):
         self.seq = seq
         self.parent = parent
         self.pst = pst
@@ -150,6 +152,25 @@ class ServeSnapshot:
         #: record prefix and may stream; past it, it may have a
         #: divergent tail and must snapshot-resync
         self.epoch_base = int(epoch_base)
+        #: incremental degree histogram (ISSUE 18): vid-indexed int64
+        #: counts over (graph + inserted) edges, maintained as two +1s
+        #: per insert; None in a pre-reseq snapshot (recounted on load)
+        self.deg = deg
+        #: the histogram at the moment the CURRENT sequence was
+        #: established (bootstrap or last re-sequence) — degree-rank
+        #: movement is measured against it
+        self.deg_base = deg_base
+        self.seq_drift = int(seq_drift)
+        self.reseqs = int(reseqs)
+        #: sequence generation: bumped by every re-sequence swap; the
+        #: reseq manifest chains (gen, sig) pairs so fsck and the
+        #: replication handshake can tell a planned sequence change
+        #: from corruption
+        self.seq_gen = int(seq_gen)
+        #: how many inserted edges the current sequence already covers
+        #: (the re-sequence cut); the drift fraction is measured over
+        #: inserts past it
+        self.ins_base = int(ins_base)
 
     def validate(self) -> None:
         problems = []
@@ -178,6 +199,19 @@ class ServeSnapshot:
                 f"tails vs {len(self.ins_head)} heads")
         if self.applied_seqno < 0 or self.drift_cut < 0:
             problems.append("negative counters")
+        if (self.seq_drift < 0 or self.reseqs < 0 or self.seq_gen < 0
+                or self.ins_base < 0):
+            problems.append("negative re-sequence counters")
+        if self.ins_base > len(self.ins_tail):
+            problems.append(
+                f"re-sequence cut {self.ins_base} past the "
+                f"{len(self.ins_tail)} inserted edges")
+        if self.deg is not None and (
+                len(self.deg) != len(self.parts)
+                or self.deg_base is None
+                or len(self.deg_base) != len(self.parts)):
+            problems.append("degree histogram disagrees with the "
+                            "vid tables")
         if self.num_parts < 1:
             problems.append(f"num_parts {self.num_parts} < 1")
         if problems:
@@ -185,9 +219,11 @@ class ServeSnapshot:
                 "corrupt serve snapshot — " + "; ".join(problems))
 
     def nbytes_estimate(self) -> int:
+        deg = 0 if self.deg is None \
+            else self.deg.nbytes + self.deg_base.nbytes
         return (self.seq.nbytes + self.parent.nbytes + self.pst.nbytes
                 + self.parts.nbytes + self.ins_tail.nbytes
-                + self.ins_head.nbytes + 4096)
+                + self.ins_head.nbytes + deg + 4096)
 
 
 def save_serve_snapshot(path: str, snap: ServeSnapshot,
@@ -200,6 +236,10 @@ def save_serve_snapshot(path: str, snap: ServeSnapshot,
     gov = governor if governor is not None else ResourceGovernor.from_env()
     gov.check_dir_budget(os.path.dirname(os.path.abspath(path)) or ".",
                          est, "serve snapshot")
+    fields = {}
+    if snap.deg is not None:
+        fields["deg"] = np.asarray(snap.deg, dtype=np.int64)
+        fields["deg_base"] = np.asarray(snap.deg_base, dtype=np.int64)
     with sealed_write(path, "wb", expect_bytes=est) as f:
         np.savez(
             f,
@@ -219,6 +259,11 @@ def save_serve_snapshot(path: str, snap: ServeSnapshot,
             balance=np.float64(snap.balance),
             epoch=np.int64(snap.epoch),
             epoch_base=np.int64(snap.epoch_base),
+            seq_drift=np.int64(snap.seq_drift),
+            reseqs=np.int64(snap.reseqs),
+            seq_gen=np.int64(snap.seq_gen),
+            ins_base=np.int64(snap.ins_base),
+            **fields,
         )
 
 
@@ -252,7 +297,18 @@ def load_serve_snapshot(path: str,
                 # pre-replication snapshots predate epochs: term 0
                 epoch=int(z["epoch"]) if "epoch" in z.files else 0,
                 epoch_base=(int(z["epoch_base"])
-                            if "epoch_base" in z.files else 0))
+                            if "epoch_base" in z.files else 0),
+                # pre-reseq snapshots predate the incremental degree
+                # histogram: None makes the core recount on load
+                deg=z["deg"].copy() if "deg" in z.files else None,
+                deg_base=(z["deg_base"].copy()
+                          if "deg_base" in z.files else None),
+                seq_drift=(int(z["seq_drift"])
+                           if "seq_drift" in z.files else 0),
+                reseqs=int(z["reseqs"]) if "reseqs" in z.files else 0,
+                seq_gen=int(z["seq_gen"]) if "seq_gen" in z.files else 0,
+                ins_base=(int(z["ins_base"])
+                          if "ins_base" in z.files else 0))
     except IntegrityError:
         raise
     except Exception as exc:  # BadZipFile / KeyError / OSError / ValueError
@@ -342,13 +398,24 @@ class ServeCore:
                  governor: ResourceGovernor | None = None,
                  snap_every: int = 256,
                  drift_frac: float = 0.1,
-                 drift_min_cut: int = 64):
+                 drift_min_cut: int = 64,
+                 reseq_frac: float = 0.25,
+                 reseq_min: int = 256,
+                 reseq_rank: int = 8):
         self.state_dir = state_dir
         self.governor = governor if governor is not None \
             else ResourceGovernor.from_env()
         self.snap_every = max(1, int(snap_every))
         self.drift_frac = float(drift_frac)
         self.drift_min_cut = max(1, int(drift_min_cut))
+        # sequence-drift detector (ISSUE 18): an insert counts as
+        # sequence drift when an endpoint is outside the sequence or its
+        # degree rank moved >= reseq_rank since the sequence was fixed;
+        # the detector fires at reseq_frac of the inserts past the cut,
+        # floored at reseq_min
+        self.reseq_frac = float(reseq_frac)
+        self.reseq_min = max(1, int(reseq_min))
+        self.reseq_rank = max(1, int(reseq_rank))
         self._lock = threading.RLock()
         self._wal = appender
         #: replication hook (serve/replicate.py): called with no args,
@@ -366,6 +433,10 @@ class ServeCore:
         # late (the background thread racing a forced REPARTITION)
         self._repart_ticket = 0
         self._repart_applied = -1
+        # same ordering discipline for re-sequences: a later-started
+        # rebuild (fresher cut) must win over an earlier one landing late
+        self._reseq_ticket = 0
+        self._reseq_applied = -1
         self._load_snapshot(snap)
 
     def _load_snapshot(self, snap: ServeSnapshot) -> None:
@@ -420,6 +491,27 @@ class ServeCore:
                     f"serve: graph {self.graph_path} unavailable ({exc}); "
                     f"ECV queries and drift baselines are disabled")
                 self.graph_path = None
+
+        # the incremental degree histogram (ISSUE 18): adopt the
+        # snapshot's when it carries one (and still matches the vid
+        # domain), else recount from the resident edge set — the one-off
+        # upgrade path for pre-reseq snapshots
+        self.seq_gen = snap.seq_gen
+        self.seq_drift = snap.seq_drift
+        self.reseqs = snap.reseqs
+        self.ins_base = min(snap.ins_base, len(self.ins_tail))
+        n_v = len(self.parts)
+        if snap.deg is not None and len(snap.deg) == n_v:
+            self.deg = np.asarray(snap.deg, dtype=np.int64).copy()
+            self.deg_base = np.asarray(snap.deg_base,
+                                       dtype=np.int64).copy()
+        else:
+            tail, head = self._all_edges()
+            self.deg = host_degree_histogram(tail, head, n_v)
+            ins_deg = host_degree_histogram(
+                np.asarray(self.ins_tail, dtype=np.uint32),
+                np.asarray(self.ins_head, dtype=np.uint32), n_v)
+            self.deg_base = self.deg - ins_deg
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -553,10 +645,29 @@ class ServeCore:
                               f"off {wpath}")
         wal_sig, wal_epoch, records, _, _ = read_wal(wpath, mode)
         if wal_sig != snap.sig:
-            raise IntegrityError(
-                f"{wpath}: WAL belongs to a different build input "
-                f"(log sig {wal_sig[:12]}..., snapshot "
-                f"{snap.sig[:12]}...) — refusing to replay")
+            # a re-sequence changes the input signature ON PURPOSE; the
+            # crash window between the new-generation snapshot seal and
+            # the WAL swap leaves an old-sig log whose every record is
+            # already in the snapshot.  The durable reseq manifest is
+            # the sanction: without it (or with records past the
+            # snapshot boundary) this is the torn mid-swap state fsck
+            # refuses.
+            from .reseq import sanctions_sig_change
+            sanctioned = (
+                sanctions_sig_change(state_dir, wal_sig, snap.sig)
+                and (not records
+                     or records[-1][0] <= snap.applied_seqno))
+            if not sanctioned:
+                raise IntegrityError(
+                    f"{wpath}: WAL belongs to a different build input "
+                    f"(log sig {wal_sig[:12]}..., snapshot "
+                    f"{snap.sig[:12]}...) — refusing to replay")
+            warnings.warn(
+                f"serve: {wpath} predates the sealed re-sequence "
+                f"(manifest-sanctioned sig change); swapping in a fresh "
+                f"generation-{snap.seq_gen} log")
+            create_wal(wpath, snap.sig, epoch=snap.epoch)
+            wal_sig, wal_epoch, records = snap.sig, snap.epoch, []
         if wal_epoch > snap.epoch:
             # only reachable when repair mode fell back a snapshot
             # generation ACROSS a promotion: the epoch-E log starts after
@@ -753,6 +864,8 @@ class ServeCore:
             val = ecv_down(self.parts, tail, head, self.pos)
             return {"ecv_down": val, "baseline": self.baseline_ecv,
                     "drift_cut": self.drift_cut,
+                    "seq_drift": self.seq_drift,
+                    "reseqs": self.reseqs,
                     "parts": int(self.parts.max(initial=0)) + 1}
 
     def stats(self) -> dict:
@@ -766,6 +879,9 @@ class ServeCore:
                 "applied_seqno": self.applied_seqno,
                 "inserted": len(self.ins_tail),
                 "drift_cut": self.drift_cut,
+                "seq_drift": self.seq_drift,
+                "reseqs": self.reseqs,
+                "seq_gen": self.seq_gen,
                 "baseline_ecv": self.baseline_ecv,
                 "repartitions": self.repartitions,
                 "snap_failures": self.snap_failures,
@@ -899,18 +1015,41 @@ class ServeCore:
             self._ensure_vid(max(u, v))
             self.ins_tail.append(u)
             self.ins_head.append(v)
-            pu = int(self.pos[u])
-            pv = int(self.pos[v])
-            if pu == pv:
-                continue  # self-loop or both endpoints absent: inert
-            lo, hi = min(pu, pv), max(pu, pv)
-            self.pst[lo] += 1  # pst counts at the present earlier endpoint
-            if hi != INVALID_JNID and hi < len(self.parent):
-                insert_link(self.parent, lo, hi)
-                # drift: a cut insert raises ECV(down) by at most one
-                part_u, part_v = int(self.parts[u]), int(self.parts[v])
-                if part_u != part_v:
-                    self.drift_cut += 1
+            # the incremental degree histogram: each record is two +1s
+            # (a self-loop +2 at one vid) — exactly the bincount
+            # semantics of core.sequence.host_degree_histogram, so the
+            # counting-sort rebuild never needs a recount pass
+            self.deg[u] += 1
+            self.deg[v] += 1
+            self._fold_edge(u, v)
+
+    def _fold_edge(self, u: int, v: int) -> None:
+        """The incremental transform for ONE edge already counted into
+        ``deg`` and the ins lists: position mapping, pst, tree link, and
+        both drift detectors.  Shared by the live insert/replay path and
+        the post-cut replay of :meth:`reseq_swap` — the determinism of
+        this fold is what makes a resumed re-sequence bit-identical."""
+        pu = int(self.pos[u])
+        pv = int(self.pos[v])
+        if u != v:
+            # sequence drift (distinct from cut drift): the edge landed
+            # outside the fixed sequence, or an endpoint's degree rank
+            # moved far enough that the fixed order is now lying
+            if pu == INVALID_JNID or pv == INVALID_JNID:
+                self.seq_drift += 1
+            elif (self.deg[u] - self.deg_base[u] >= self.reseq_rank
+                  or self.deg[v] - self.deg_base[v] >= self.reseq_rank):
+                self.seq_drift += 1
+        if pu == pv:
+            return  # self-loop or both endpoints absent: inert
+        lo, hi = min(pu, pv), max(pu, pv)
+        self.pst[lo] += 1  # pst counts at the present earlier endpoint
+        if hi != INVALID_JNID and hi < len(self.parent):
+            insert_link(self.parent, lo, hi)
+            # drift: a cut insert raises ECV(down) by at most one
+            part_u, part_v = int(self.parts[u]), int(self.parts[v])
+            if part_u != part_v:
+                self.drift_cut += 1
 
     def _ensure_vid(self, vid: int) -> None:
         """Grow the vid-indexed tables over a never-seen vertex (absent
@@ -922,6 +1061,9 @@ class ServeCore:
             [self.parts, np.full(grow, INVALID_PART, dtype=np.int64)])
         self.pos = np.concatenate(
             [self.pos, np.full(grow, INVALID_JNID, dtype=np.uint32)])
+        zeros = np.zeros(grow, dtype=np.int64)
+        self.deg = np.concatenate([self.deg, zeros])
+        self.deg_base = np.concatenate([self.deg_base, zeros])
 
     # -- snapshots ---------------------------------------------------------
 
@@ -939,7 +1081,10 @@ class ServeCore:
                 drift_cut=self.drift_cut, baseline_ecv=self.baseline_ecv,
                 graph_path=self.graph_path or "", sig=self.sig,
                 balance=self.balance, epoch=self.epoch,
-                epoch_base=self.epoch_base)
+                epoch_base=self.epoch_base, deg=self.deg,
+                deg_base=self.deg_base, seq_drift=self.seq_drift,
+                reseqs=self.reseqs, seq_gen=self.seq_gen,
+                ins_base=self.ins_base)
             path = os.path.join(self.state_dir,
                                 snap_name(self.applied_seqno))
             save_serve_snapshot(path, snap, self.governor)
@@ -1020,7 +1165,8 @@ class ServeCore:
                 self.epoch_base = old_base
                 raise
 
-    def reset_from_snapshot(self, snap: ServeSnapshot) -> None:
+    def reset_from_snapshot(self, snap: ServeSnapshot,
+                            allow_sig_change: bool = False) -> None:
         """Follower full re-sync: discard the local chain and adopt a
         snapshot shipped by the leader (the stream could not be resumed
         — the follower lagged past the leader's WAL, or carries a fenced
@@ -1028,10 +1174,17 @@ class ServeCore:
         re-opens consistently: the local log is emptied FIRST (the local
         history is being discarded either way), the adopted snapshot is
         sealed under its own epoch, and only then is the stale chain
-        removed — :meth:`open` prefers the higher epoch throughout."""
+        removed — :meth:`open` prefers the higher epoch throughout.
+
+        ``allow_sig_change`` — the leader re-sequenced (ISSUE 18): the
+        adopted snapshot carries a LATER sequence generation under a new
+        input signature.  The caller must have written the local reseq
+        manifest sanctioning old->new first, or a crash mid-adoption
+        leaves a sig mismatch :meth:`open` correctly refuses."""
         snap.validate()
         with self._lock:
-            if snap.sig != self.sig:
+            if snap.sig != self.sig and not (
+                    allow_sig_change and snap.seq_gen > self.seq_gen):
                 raise IntegrityError(
                     f"replication snapshot belongs to a different build "
                     f"input (sig {snap.sig[:12]}..., ours "
@@ -1059,11 +1212,13 @@ class ServeCore:
             path = os.path.join(self.state_dir,
                                 snap_name(snap.applied_seqno))
             save_serve_snapshot(path, snap, self.governor)
-            # 3. fresh log for the adopted epoch, then drop stale chain
-            create_wal(wal_path(self.state_dir), self.sig,
+            # 3. fresh log for the adopted epoch (and, after a leader
+            #    re-sequence, the adopted SIGNATURE), then drop the
+            #    stale chain
+            create_wal(wal_path(self.state_dir), snap.sig,
                        epoch=snap.epoch)
             self._wal = WalAppender(wal_path(self.state_dir),
-                                    expect_sig=self.sig)
+                                    expect_sig=snap.sig)
             self._wal.next_seqno = snap.applied_seqno + 1
             for p in old_snaps:
                 if p != path:
@@ -1135,3 +1290,123 @@ class ServeCore:
             self.maybe_seal()
             return {"parts": int(vparts.max(initial=0)) + 1,
                     "baseline_ecv": self.baseline_ecv}
+
+    # -- re-sequence (ISSUE 18) --------------------------------------------
+    #
+    # Repartition re-bins the EXISTING tree; it cannot recover quality
+    # lost to inserts that landed outside the bootstrap-fixed sequence
+    # (pst-only vertices never enter the tree).  The re-sequence path
+    # rebuilds sequence + tree + partition from the durable edge set
+    # (graph .dat + WAL'd inserts) under a degree order that reflects
+    # the churn, and swaps it in under the same ticket discipline.  The
+    # heavy fold runs in serve/reseq.py (durable manifest, extmem fold,
+    # kill-safe phases); the core owns only the bookkeeping and the
+    # atomic swap.
+
+    def recount_degrees(self) -> np.ndarray:
+        """Full recount of the degree histogram over the RESIDENT edge
+        set — the parity oracle for the incremental counters (only
+        meaningful while the graph edges are resident or the core never
+        had a graph)."""
+        with self._lock:
+            tail, head = self._all_edges()
+            return host_degree_histogram(tail, head, len(self.parts))
+
+    def degree_parity(self) -> bool:
+        """Does the incrementally maintained histogram equal a full
+        recount?  Asserted by the reseq driver before trusting the
+        incremental counts for a sequence rebuild."""
+        with self._lock:
+            return bool(np.array_equal(self.deg, self.recount_degrees()))
+
+    def seq_drift_exceeded(self) -> bool:
+        """Has SEQUENCE drift (inserts the fixed order mis-handles)
+        crossed the re-sequence threshold?  ``reseq_frac`` of the
+        inserts past the current cut, with at least ``reseq_min``
+        inserts observed first."""
+        with self._lock:
+            since = len(self.ins_tail) - self.ins_base
+            if since < self.reseq_min:
+                return False
+            return self.seq_drift >= max(1, int(self.reseq_frac * since))
+
+    def reseq_begin(self) -> dict:
+        """Capture the inputs of one re-sequence attempt under the lock:
+        the ticket (later-started wins, exactly the repartition rule)
+        and the CUT — how many inserted edges the rebuild will cover.
+        (durable edges + cut) fully determine the rebuilt state, which
+        is what makes a crash-resumed rebuild bit-identical."""
+        with self._lock:
+            ticket = self._reseq_ticket
+            self._reseq_ticket += 1
+            return {
+                "ticket": ticket,
+                "cut": len(self.ins_tail),
+                "num_parts": self.num_parts,
+                "balance": self.balance,
+                "graph_path": self.graph_path,
+                "old_sig": self.sig,
+                "seq_gen": self.seq_gen,
+                "epoch": self.epoch,
+                "applied_seqno": self.applied_seqno,
+                "seq_drift": self.seq_drift,
+                "deg": self.deg.copy(),
+            }
+
+    def ins_slice(self, cut: int):
+        """The first ``cut`` WAL'd inserts as uint32 arrays (copies)."""
+        with self._lock:
+            return (np.asarray(self.ins_tail[:cut], dtype=np.uint32),
+                    np.asarray(self.ins_head[:cut], dtype=np.uint32))
+
+    def reseq_swap(self, ticket: int, cut: int, new_seq: np.ndarray,
+                   parent: np.ndarray, pst: np.ndarray,
+                   jparts: np.ndarray, new_sig: str, gen: int) -> dict:
+        """Swap a rebuilt (sequence, tree, partition) in atomically.
+        The rebuild covers the durable edge set up to ``cut``; inserts
+        that arrived DURING the rebuild are replayed through the
+        incremental transform under the lock, so queries go from one
+        consistent state to the other with no torn window.  Stale
+        tickets (a later-started rebuild already swapped) are refused.
+        NOT durable by itself — the driver seals right after (its own
+        kill boundary)."""
+        with self._lock:
+            if ticket <= self._reseq_applied:
+                return {"stale": 1}
+            self._reseq_applied = ticket
+            # any in-flight repartition was computed over the old jnid
+            # space: its result must not land on the new tree
+            self._repart_applied = self._repart_ticket - 1
+            n_v = len(self.parts)
+            self.seq = np.asarray(new_seq, dtype=np.uint32)
+            self.parent = np.asarray(parent, dtype=np.uint32)
+            self.pst = np.asarray(pst, dtype=np.uint32)
+            self.pos = sequence_positions(self.seq, max(n_v - 1, 0))
+            vparts = np.full(n_v, INVALID_PART, dtype=np.int64)
+            vparts[self.seq] = np.asarray(jparts, dtype=np.int64)
+            self.parts = vparts
+            self.sig = str(new_sig)
+            self.seq_gen = int(gen)
+            # the new sequence was established at the cut: rank drift is
+            # measured against the histogram AS OF the cut
+            post_t = np.asarray(self.ins_tail[cut:], dtype=np.uint32)
+            post_h = np.asarray(self.ins_head[cut:], dtype=np.uint32)
+            self.deg_base = self.deg - host_degree_histogram(
+                post_t, post_h, n_v)
+            self.ins_base = int(cut)
+            self.seq_drift = 0
+            self.drift_cut = 0
+            self._subtree_cache = None
+            self._part_lut = None
+            for u, v in zip(post_t.tolist(), post_h.tolist()):
+                self._fold_edge(int(u), int(v))
+            if self.edges_tail is not None:
+                tail, head = self._all_edges()
+                self.baseline_ecv = ecv_down(self.parts, tail, head,
+                                             self.pos)
+            self.reseqs += 1
+            return {"n": len(self.seq),
+                    "parts": int(self.parts.max(initial=0)) + 1,
+                    "baseline_ecv": self.baseline_ecv,
+                    "seq_gen": self.seq_gen,
+                    "replayed": len(post_t)}

@@ -194,11 +194,17 @@ class Tenant:
 
     def evictable(self) -> bool:
         """Cold-evictable: resident, not the default, and no replication
-        machinery would be stranded by dropping the core."""
+        machinery would be stranded by dropping the core.  A tenant with
+        an in-flight re-sequence (ISSUE 18) is pinned too: sealing it
+        out of memory would orphan the rebuild mid-phase."""
         if self.name == DEFAULT_TENANT or self.core is None:
             return False
         if self.replicator is not None or self.mig is not None:
             return False
+        if self.core.state_dir:
+            from .reseq import active
+            if active(self.core.state_dir):
+                return False
         return self.hub is None or self.hub.follower_count() == 0
 
     def priced_nbytes(self) -> int:

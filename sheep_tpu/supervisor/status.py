@@ -351,7 +351,8 @@ def serve_status_json(state_dir: str) -> dict:
                 out["alive"] = True
                 for key in ("role", "epoch", "applied_seqno", "repl_lag",
                             "followers", "node", "leader", "moved_dest",
-                            "mig_phase", "mig_lag", "migrating"):
+                            "mig_phase", "mig_lag", "migrating",
+                            "seq_drift", "reseqs", "seq_gen"):
                     if key in out["stats"]:
                         out[key] = out["stats"][key]
         except Exception:
@@ -381,6 +382,16 @@ def serve_status_json(state_dir: str) -> dict:
                     out["applied_seqno"] = snap.applied_seqno
                 except Exception:
                     pass
+    # an in-flight re-sequence manifest (ISSUE 18) is visible whether or
+    # not the daemon answers — a down node mid-rebuild is exactly when
+    # the operator needs to see it
+    try:
+        from ..serve import reseq as reseq_mod
+        man = reseq_mod.load_manifest(state_dir)
+        if man is not None and man.get("phase") not in reseq_mod.DONE_PHASES:
+            out["reseq_phase"] = man.get("phase")
+    except Exception:
+        pass
     out["trace"] = newest_trace_rollup(state_dir)
     return out
 
@@ -392,7 +403,8 @@ def render_serve_status(state_dir: str) -> str:
              f"  heartbeat {_fmt_age(rec.get('heartbeat_age_s'))}"]
     for key in ("node", "role", "epoch", "applied_seqno", "leader",
                 "repl_lag", "followers", "addr", "newest_snapshot",
-                "moved_dest", "mig_phase", "mig_lag", "migrating"):
+                "moved_dest", "mig_phase", "mig_lag", "migrating",
+                "seq_drift", "reseqs", "seq_gen", "reseq_phase"):
         if key in rec and rec[key] is not None:
             lines.append(f"{key}: {rec[key]}")
     st = rec.get("stats", {})

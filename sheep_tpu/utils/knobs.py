@@ -245,7 +245,8 @@ KNOBS: dict[str, Knob] = {k.name: k for k in [
        "serve", "minimum cut inserts before drift can trigger"),
     _K("SHEEP_SERVE_FAULT_PLAN", "plan", "",
        "serve", "serve-layer fault plan kind@site:nth "
-       "(kill/hang/slow at req/query/insert/wal/apply)"),
+       "(kill/hang/slow at req/query/insert/wal/apply and the "
+       "reseq-hist/fold/swap/seal phase boundaries)"),
     _K("SHEEP_SERVE_TENANTS", "list", "",
        "serve", "tenant specs name=dir[:graph[:k]] behind one daemon"),
     _K("SHEEP_SERVE_MAX_RESIDENT", "int", "0",
@@ -273,8 +274,8 @@ KNOBS: dict[str, Knob] = {k.name: k for k in [
     _K("SHEEP_SERVE_NETFAULT_PLAN", "plan", "",
        "replicate", "network fault plan drop/partition/slow/dup at "
        "the replication sites (repl/hb), the worker-wire sites "
-       "(wleg/wbeat/wart), and the migration sites "
-       "(msnap/mdelta/mcut)"),
+       "(wleg/wbeat/wart), the migration sites (msnap/mdelta/mcut), "
+       "and the re-sequence swap announcement (reseq)"),
     # -- router (ISSUE 11) -------------------------------------------------
     _K("SHEEP_ROUTE_CLUSTERS", "list", "",
        "route", "cluster member lists the router hashes tenants "
@@ -315,6 +316,26 @@ KNOBS: dict[str, Knob] = {k.name: k for k in [
     _K("SHEEP_REBALANCE_PIN", "str", "",
        "migrate", "pin the rebalancer's pricing verdict: go / stay "
        "(unset = plan_migration prices the move)"),
+    # -- re-sequencing (ISSUE 18) ------------------------------------------
+    _K("SHEEP_RESEQ", "flag", "1",
+       "reseq", "background crash-safe re-sequence when the "
+       "sequence-drift detector fires (0 = repartition-only drift "
+       "handling)"),
+    _K("SHEEP_RESEQ_DRIFT", "float", "0.25",
+       "reseq", "fraction of post-cut inserts that are out-of-sequence "
+       "(or degree-rank-moved) before a re-sequence triggers"),
+    _K("SHEEP_RESEQ_DRIFT_MIN", "int", "256",
+       "reseq", "minimum post-cut inserts before sequence drift can "
+       "trigger"),
+    _K("SHEEP_RESEQ_RANK", "int", "8",
+       "reseq", "degree-rank displacement (in histogram buckets) past "
+       "which an insert counts as sequence drift"),
+    _K("SHEEP_RESEQ_PIN", "str", "",
+       "reseq", "pin the re-sequence pricing verdict: go / stay "
+       "(unset = plan_reseq prices the rebuild)"),
+    _K("SHEEP_RESEQ_HORIZON_S", "float", "60",
+       "reseq", "priced rebuild cost above this horizon stays (drift "
+       "keeps accruing until forced or cheaper)"),
     # -- multi-process / dist CLI ------------------------------------------
     _K("SHEEP_COORDINATOR", "str", "",
        "dist", "jax.distributed coordinator address"),

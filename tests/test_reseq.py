@@ -1,0 +1,439 @@
+"""Crash-safe incremental re-sequencing tests (ISSUE 18): the
+incremental degree-histogram parity property (across snapshot/restore
+and WAL replay), the sequence-drift detector, kill-at-every-phase-
+boundary resume with bit-identical trees, mid-re-sequence failover with
+zero acked-insert loss, the replicated swap frame under network faults,
+and the fsck generation-chain checks."""
+
+import os
+import shutil
+import time
+
+import numpy as np
+import pytest
+
+from sheep_tpu.core.forest import build_forest
+from sheep_tpu.core.sequence import host_degree_histogram
+from sheep_tpu.integrity.errors import IntegrityError, MalformedArtifact
+from sheep_tpu.integrity.fsck import fsck_paths
+from sheep_tpu.io import faultfs
+from sheep_tpu.io.edges import write_dat
+from sheep_tpu.serve import faults as serve_faults
+from sheep_tpu.serve import netfaults, reseq
+from sheep_tpu.serve.cluster import ClusterConfig
+from sheep_tpu.serve.daemon import ServeConfig, ServeDaemon
+from sheep_tpu.serve.faults import ServeKilled, parse_serve_fault_plan
+from sheep_tpu.serve.netfaults import parse_netfault_plan
+from sheep_tpu.serve.protocol import ServeClient, ServeError
+from sheep_tpu.serve.replicate import bootstrap_state_dir
+from sheep_tpu.serve.reseq import resume_reseq, run_reseq
+from sheep_tpu.serve.state import ServeCore
+from sheep_tpu.serve.wal import WalAppender, create_wal, wal_path
+from sheep_tpu.utils.synth import rmat_edges
+
+
+@pytest.fixture(autouse=True)
+def _clean_fault_plans():
+    faultfs.clear_plan()
+    serve_faults.clear_plan()
+    netfaults.clear_plan()
+    yield
+    faultfs.clear_plan()
+    serve_faults.clear_plan()
+    netfaults.clear_plan()
+
+
+def _wait_until(cond, timeout_s=15.0, poll_s=0.02, what="condition"):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if cond():
+            return
+        time.sleep(poll_s)
+    raise TimeoutError(f"{what} not reached in {timeout_s}s")
+
+
+def _state(tmp_path, name="state", seed=3, log2=7, parts=3, **kw):
+    tail, head = rmat_edges(log2, 4 << log2, seed=seed)
+    g = str(tmp_path / f"{name}.dat")
+    write_dat(g, tail, head)
+    sd = str(tmp_path / name)
+    core = ServeCore.bootstrap(sd, graph_path=g, num_parts=parts, **kw)
+    return core, sd, tail, head
+
+
+def _skewed_inserts(k, lo=200, span=6, seed=9):
+    """An insert stream concentrated on a few fresh vertices — the
+    power-law hot spot that moves degree ranks and builds sequence
+    drift fast."""
+    rng = np.random.default_rng(seed)
+    hub = lo + rng.integers(0, span, size=k)
+    other = rng.integers(0, lo, size=k)
+    return np.stack([hub, other], axis=1).astype(np.uint32)
+
+
+# ---------------------------------------------------------------------------
+# the incremental degree histogram (tentpole part 1)
+# ---------------------------------------------------------------------------
+
+
+def test_degree_histogram_parity_property(tmp_path):
+    """The incrementally-maintained histogram equals a full recount
+    after every random insert batch — and the property survives both
+    recovery paths: snapshot restore and WAL replay."""
+    core, sd, tail, head = _state(tmp_path, snap_every=10)
+    rng = np.random.default_rng(21)
+    for batch in range(6):
+        k = int(rng.integers(1, 9))
+        rows = rng.integers(0, 200, size=(k, 2)).astype(np.uint32)
+        for row in rows:
+            core.insert(row.reshape(1, 2))
+        assert core.degree_parity(), f"diverged after batch {batch}"
+    # the recount oracle really is the full durable edge set
+    at = np.concatenate([tail, np.asarray(core.ins_tail, np.uint32)])
+    ah = np.concatenate([head, np.asarray(core.ins_head, np.uint32)])
+    n = int(max(at.max(), ah.max())) + 1
+    want = host_degree_histogram(at, ah, n)
+    np.testing.assert_array_equal(core.recount_degrees()[:n], want)
+    applied = core.applied_seqno
+    core.close()
+
+    # snapshot restore (snap_every=10 sealed at least once mid-stream)
+    # + WAL replay of the unsealed tail: parity must hold again
+    revived = ServeCore.open(sd)
+    assert revived.applied_seqno == applied
+    assert revived.degree_parity()
+    revived.close()
+
+
+def test_seq_drift_detector_and_wire_fields(tmp_path):
+    """Sequence drift is its own detector, distinct from cut drift: a
+    skewed stream trips it, and the accounting rides STATS/ECV."""
+    core, sd, _, _ = _state(tmp_path, reseq_min=8, reseq_frac=0.25)
+    assert not core.seq_drift_exceeded()
+    for row in _skewed_inserts(24):
+        core.insert(row.reshape(1, 2))
+    assert core.seq_drift > 0
+    assert core.seq_drift_exceeded()
+    st = core.stats()
+    assert st["seq_drift"] == core.seq_drift
+    assert st["reseqs"] == 0 and st["seq_gen"] == 0
+    ev = core.ecv()
+    assert ev["seq_drift"] == core.seq_drift and ev["reseqs"] == 0
+    core.close()
+
+
+# ---------------------------------------------------------------------------
+# kill at every phase boundary -> bit-identical resume (tentpole part 4)
+# ---------------------------------------------------------------------------
+
+
+def test_kill_at_every_reseq_boundary_resumes_bit_identical(tmp_path,
+                                                            monkeypatch):
+    """Kill the re-sequence at EVERY phase boundary (hist, mid-fold
+    checkpoint block, swap, seal), reopen from disk, resume: the final
+    serving state must be bit-identical (state_crc) to the
+    uninterrupted rebuild, and the manifest chain must close."""
+    from sheep_tpu.runtime import BuildKilled, FaultPlan
+    from sheep_tpu.runtime import clear_plan as rt_clear
+    from sheep_tpu.runtime import install_plan as rt_install
+    from sheep_tpu.runtime import reset_counters as rt_reset
+    monkeypatch.setenv("SHEEP_EXT_BLOCK", "128")  # several fold blocks
+
+    core, sd, _, _ = _state(tmp_path, name="ref")
+    ins = _skewed_inserts(20)
+    for row in ins:
+        core.insert(row.reshape(1, 2))
+    core.close()
+    base = str(tmp_path / "base")
+    shutil.copytree(sd, base)
+
+    control = ServeCore.open(sd)
+    res = run_reseq(control, force=True)
+    assert res["seq_gen"] == 1 and res["sealed"] == 1
+    want_crc = control.state_crc()
+    want_ecv = control.ecv()["ecv_down"]
+    control.close()
+
+    serve_sites = ("reseq-hist", "reseq-fold", "reseq-swap", "reseq-seal")
+    for site in serve_sites + ("ext-boundary",):
+        sd_n = str(tmp_path / f"kill-{site}")
+        shutil.copytree(base, sd_n)
+        victim = ServeCore.open(sd_n)
+        if site == "ext-boundary":
+            rt_reset()
+            rt_install(FaultPlan(site="ext-boundary", at=1, kind="kill"))
+            with pytest.raises(BuildKilled):
+                run_reseq(victim, force=True)
+            rt_clear()
+            rt_reset()
+        else:
+            serve_faults.install_plan(parse_serve_fault_plan(
+                f"kill@{site}:0", kill_mode="raise"))
+            with pytest.raises(ServeKilled):
+                run_reseq(victim, force=True)
+            serve_faults.clear_plan()
+        victim.close()  # the "process" is dead; durable state only
+
+        revived = ServeCore.open(sd_n)
+        out = resume_reseq(revived)
+        assert out is not None and not out.get("stale"), (site, out)
+        assert revived.seq_gen == 1, site
+        assert revived.state_crc() == want_crc, site
+        assert revived.ecv()["ecv_down"] == want_ecv, site
+        man = reseq.load_manifest(sd_n)
+        assert man["phase"] == "done", site
+        assert not os.path.exists(reseq.pending_path(sd_n)), site
+        # the resumed dir passes fsck including the generation chain
+        _, failures = fsck_paths([sd_n], mode="strict")
+        assert not failures, (site, failures)
+        revived.close()
+
+
+def test_kill_after_seal_resume_finalizes_bookkeeping(tmp_path):
+    """A crash AFTER the new generation sealed but before the manifest
+    closed (phase still ``swap``) must finalize on resume, not rebuild:
+    the durable snapshot already IS the new generation."""
+    core, sd, _, _ = _state(tmp_path)
+    for row in _skewed_inserts(12):
+        core.insert(row.reshape(1, 2))
+    res = run_reseq(core, force=True)
+    assert res["seq_gen"] == 1
+    # wind the manifest back to the swap phase, as if the process died
+    # between seal_snapshot() and save_manifest(phase=done)
+    man = reseq.load_manifest(sd)
+    man["phase"] = "swap"
+    man["chain"] = man["chain"][:1]
+    reseq.save_manifest(sd, man)
+    core.close()
+    revived = ServeCore.open(sd)
+    assert revived.seq_gen == 1
+    out = resume_reseq(revived)
+    assert out == {"resumed": "finalize", "seq_gen": 1}
+    assert reseq.load_manifest(sd)["phase"] == "done"
+    _, failures = fsck_paths([sd], mode="strict")
+    assert not failures, failures
+    revived.close()
+
+
+# ---------------------------------------------------------------------------
+# replication: swap frame, failover, netfaults (tentpole part 5)
+# ---------------------------------------------------------------------------
+
+
+def _spawn_pair(tmp_path, **cfg_kw):
+    lcore, lsd, tail, head = _state(tmp_path, "lead")
+    fsd = str(tmp_path / "fol")
+    lead = ServeDaemon(
+        lcore, ServeConfig(**cfg_kw),
+        cluster=ClusterConfig(node_id="L", role="leader", peers=[fsd],
+                              hb_s=0.05, failover_s=0.6,
+                              poll_timeout_s=1.0)).start()
+    lh, lp = lead.address
+    bootstrap_state_dir(fsd, lh, lp)
+    fol = ServeDaemon(
+        ServeCore.open(fsd), ServeConfig(**cfg_kw),
+        cluster=ClusterConfig(node_id="F", role="follower", peers=[lsd],
+                              hb_s=0.05, failover_s=0.6,
+                              poll_timeout_s=1.0)).start()
+    _wait_until(lambda: lead.hub.follower_count() == 1,
+                what="follower attached")
+    return lead, fol, (tail, head)
+
+
+def test_replicated_swap_is_a_sequenced_unit(tmp_path):
+    """A forced RESEQ on the leader reaches the follower as one
+    sequenced swap: the follower adopts the whole new generation
+    (snapshot-boundary re-sync) and converges bit-identical — never a
+    half-swapped tree."""
+    lead, fol, _ = _spawn_pair(tmp_path)
+    lh, lp = lead.address
+    acked = []
+    with ServeClient(lh, lp) as c:
+        for row in _skewed_inserts(16):
+            c.insert([(int(row[0]), int(row[1]))])
+            acked.append((int(row[0]), int(row[1])))
+        res = c.kv("RESEQ")
+        assert res["seq_gen"] == 1 and res.get("stale", 0) == 0
+        st = c.kv("STATS")
+        assert st["seq_gen"] == 1 and st["reseqs"] == 1
+        assert st["seq_drift"] == 0  # the swap reset the detector
+    _wait_until(lambda: fol.core.seq_gen == 1, what="follower adoption")
+    _wait_until(lambda: fol.core.applied_seqno == len(acked),
+                what="follower caught up")
+    np.testing.assert_array_equal(fol.core.parent, lead.core.parent)
+    np.testing.assert_array_equal(fol.core.seq, lead.core.seq)
+    assert fol.core.sig == lead.core.sig
+    # post-swap writes keep replicating on the new generation
+    with ServeClient(lh, lp) as c:
+        c.insert([(3, 141)])
+    _wait_until(lambda: fol.core.applied_seqno == len(acked) + 1,
+                what="post-swap insert replicated")
+    # both manifests sanction the generation change for fsck
+    for d in (lead.core.state_dir, fol.core.state_dir):
+        assert reseq.chain_has_sig(d, lead.core.sig), d
+    lead.shutdown()
+    fol.shutdown()
+
+
+def test_mid_reseq_failover_loses_no_acked_insert(tmp_path):
+    """Kill the leader mid-re-sequence (after the fold, inside the
+    swap): the follower — still on the old generation — promotes and
+    serves EVERY acked insert; the dead leader's half-done rebuild
+    stays its own private manifest state."""
+    lead, fol, (tail, head) = _spawn_pair(tmp_path)
+    lh, lp = lead.address
+    acked = []
+    with ServeClient(lh, lp) as c:
+        for row in _skewed_inserts(14):
+            c.insert([(int(row[0]), int(row[1]))])
+            acked.append((int(row[0]), int(row[1])))
+    serve_faults.install_plan(parse_serve_fault_plan(
+        "kill@reseq-swap:0", kill_mode="raise"))
+    with ServeClient(lh, lp, timeout_s=3.0) as c:
+        # the killed worker never answers: connection error or timeout
+        with pytest.raises((ServeError, OSError)):
+            c.kv("RESEQ")
+    serve_faults.clear_plan()
+    assert reseq.active(lead.core.state_dir)  # manifest mid-flight
+    # abrupt leader death, follower promotes with zero acked loss
+    lead._stop.set()
+    lead._wake()
+    if lead.watcher is not None:
+        lead.watcher.stop()
+    lead.hub.stop()
+    try:
+        lead._listener.close()
+    except OSError:
+        pass
+    for conn in list(lead._conns.values()):
+        try:
+            conn.sock.close()
+        except OSError:
+            pass
+    if lead._hb is not None:
+        lead._hb.stop()
+    try:
+        os.unlink(os.path.join(lead.core.state_dir, "serve.addr"))
+    except OSError:
+        pass
+    _wait_until(lambda: fol.role == "leader", what="promotion")
+    assert fol.core.applied_seqno == len(acked)
+    assert fol.core.seq_gen == 0  # the old generation keeps serving
+    at = np.concatenate([tail, np.array([u for u, _ in acked],
+                                        np.uint32)])
+    ah = np.concatenate([head, np.array([v for _, v in acked],
+                                        np.uint32)])
+    want = build_forest(at, ah, fol.core.seq,
+                        max_vid=len(fol.core.parts) - 1)
+    np.testing.assert_array_equal(fol.core.parent, want.parent)
+    fol.shutdown()
+
+
+def test_netfaults_on_replicated_swap_frame(tmp_path):
+    """Deterministic wire chaos on the swap announcement: a DROPPED
+    RESEQ frame still converges (the gen= stamp on the next APPEND
+    forces the snapshot re-sync), and a DUPLICATED frame applies once
+    (the second copy finds the follower already on the announced
+    generation and ACKs idempotently)."""
+    lead, fol, _ = _spawn_pair(tmp_path)
+    lh, lp = lead.address
+    with ServeClient(lh, lp) as c:
+        for row in _skewed_inserts(12):
+            c.insert([(int(row[0]), int(row[1]))])
+    applied0 = lead.core.applied_seqno
+
+    netfaults.install_plan(parse_netfault_plan("drop@reseq:0"))
+    with ServeClient(lh, lp) as c:
+        assert c.kv("RESEQ")["seq_gen"] == 1
+        # the announce was dropped; the next APPEND carries gen=1, the
+        # follower raises ResyncRequired and adopts over a snapshot
+        c._ok(f"DEADLINE=20 INSERT 5 77")
+    netfaults.clear_plan()
+    _wait_until(lambda: fol.core.seq_gen == 1, what="drop-heal adoption")
+    _wait_until(lambda: fol.core.applied_seqno == applied0 + 1,
+                what="post-drop insert replicated")
+    np.testing.assert_array_equal(fol.core.parent, lead.core.parent)
+
+    with ServeClient(lh, lp) as c:
+        for row in _skewed_inserts(12, seed=17):
+            c.insert([(int(row[0]), int(row[1]))])
+    netfaults.install_plan(parse_netfault_plan("dup@reseq:0"))
+    with ServeClient(lh, lp) as c:
+        assert c.kv("RESEQ")["seq_gen"] == 2
+    netfaults.clear_plan()
+    _wait_until(lambda: fol.core.seq_gen == 2, what="dup-frame adoption")
+    np.testing.assert_array_equal(fol.core.parent, lead.core.parent)
+    assert fol.core.sig == lead.core.sig
+    lead.shutdown()
+    fol.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# fsck: the generation chain (satellite b)
+# ---------------------------------------------------------------------------
+
+
+def test_fsck_reseq_chain_sanctions_and_torn_swap(tmp_path):
+    """fsck knows the re-sequence chain: a sealed generation must be
+    sanctioned by its manifest; an unsanctioned generation fails; a
+    torn mid-swap dir (old-generation WAL records past the
+    re-sequenced snapshot boundary) is refused strict and reported
+    truncatable in repair."""
+    core, sd, _, _ = _state(tmp_path)
+    old_sig = core.sig
+    for row in _skewed_inserts(10):
+        core.insert(row.reshape(1, 2))
+    res = run_reseq(core, force=True)
+    assert res["seq_gen"] == 1
+    snap_applied = core.applied_seqno
+    core.close()
+    _, failures = fsck_paths([sd], mode="strict")
+    assert not failures, failures
+
+    # unsanctioned generation: strip gen 1 from the chain
+    man = reseq.load_manifest(sd)
+    saved_chain = man["chain"]
+    man["chain"] = [c for c in saved_chain if c["gen"] == 0]
+    man["phase"] = "hist"
+    reseq.save_manifest(sd, man)
+    _, failures = fsck_paths([sd], mode="strict")
+    assert failures and "never sanctioned" in failures[0][2]
+    man["chain"] = saved_chain
+    man["phase"] = "done"
+    reseq.save_manifest(sd, man)
+
+    # torn mid-swap: an OLD-sig WAL holding a record past the
+    # re-sequenced snapshot boundary (the crash window between seal
+    # and WAL rotation)
+    w = wal_path(sd)
+    os.unlink(w)
+    create_wal(w, old_sig)
+    from sheep_tpu.serve.state import encode_inserts
+    with WalAppender(w) as app:
+        app.append_at(snap_applied + 1,
+                      encode_inserts(np.array([[1, 2]], np.uint32)))
+    with pytest.raises(MalformedArtifact) as ei:
+        fsck_file = __import__("sheep_tpu.integrity.fsck",
+                               fromlist=["fsck_file"]).fsck_file
+        fsck_file(w, "strict")
+    assert "torn mid-re-sequence swap" in str(ei.value)
+    detail = fsck_file(w, "repair")
+    assert "torn_records=1" in detail and "truncatable" in detail
+
+
+def test_reseq_pins_tenant_eviction(tmp_path):
+    """A tenant with an in-flight re-sequence manifest refuses
+    eviction — evicting would orphan the rebuild mid-phase."""
+    from sheep_tpu.serve.tenants import Tenant
+    core, sd, _, _ = _state(tmp_path)
+    t = Tenant("t", sd, None, 3, core)
+    assert t.evictable() in (True, False)  # baseline callable
+    man = {"version": reseq.MANIFEST_VERSION, "phase": "fold",
+           "cut": 0, "block": 0, "old_sig": core.sig, "new_sig": "",
+           "old_gen": 0, "new_gen": 1, "applied_seqno": 0, "plan": {},
+           "chain": [{"gen": 0, "sig": core.sig}]}
+    reseq.save_manifest(sd, man)
+    assert t.evictable() is False
+    man["phase"] = "done"
+    reseq.save_manifest(sd, man)
+    core.close()

@@ -1233,4 +1233,114 @@ then
 fi
 # -------------------------------------------------------------------------
 
+# --- group-commit smoke (amortized write path, ISSUE 19) -----------------
+# A real bin/serve daemon under 4 concurrent writer threads with an armed
+# kill -9 at the gc-unsynced boundary — after the deferred WAL append +
+# in-memory apply, BEFORE the shared group fsync, the worst spot: the
+# in-flight group is torn on disk and never acknowledged.  The restarted
+# daemon must recover EVERY acknowledged insert (acked = covered by a
+# group fsync, so applied >= acked exactly), reach applied == durable,
+# and a post-restart concurrent burst must show the amortization itself
+# (one shared fsync sealing multi-record groups).  Seconds of work; a
+# regression in the group-commit durability contract fails the gate
+# before pytest even runs.
+if ! python - <<'EOF'
+import os, signal, subprocess, sys, tempfile, threading, time
+REPO = os.getcwd()
+sys.path.insert(0, REPO)
+from sheep_tpu.io.edges import write_dat
+from sheep_tpu.serve.protocol import ServeClient, connect_retry
+from sheep_tpu.utils.synth import rmat_edges
+
+work = tempfile.mkdtemp()
+tail, head = rmat_edges(7, 4 << 7, seed=43)
+write_dat(work + "/g.dat", tail, head)
+mv = int(max(tail.max(), head.max()))
+sd = work + "/state"
+env = dict(os.environ)
+env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+env["SHEEP_RESEQ"] = "0"                    # keep the smoke single-path
+env["SHEEP_SERVE_DRIFT_MIN"] = "1000000000"
+
+def addr(timeout=60.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        try:
+            host, port = open(sd + "/serve.addr").read().split()
+            return host, int(port)
+        except (OSError, ValueError):
+            time.sleep(0.05)
+    raise SystemExit("serve.addr never appeared")
+
+def spawn(*args, fault=None):
+    e = dict(env)
+    if fault:
+        e["SHEEP_SERVE_FAULT_PLAN"] = fault
+    return subprocess.Popen(
+        [sys.executable, "-m", "sheep_tpu.cli.serve", "-d", sd, *args],
+        env=e, cwd=REPO)
+
+p = spawn("-g", work + "/g.dat", "-k", "3", fault="kill@gc-unsynced:25")
+connect_retry(*addr(), timeout_s=60).close()
+lock = threading.Lock()
+acked = [0]
+
+def writer(w):
+    try:
+        with ServeClient(*addr(), timeout_s=60) as wc:
+            for i in range(400):
+                u = (7 * i + w * 911) % (mv + 1)
+                v = (13 * i + w * 577 + 1) % (mv + 1)
+                wc.insert([(u, v)])
+                with lock:  # only counted once the group fsync acked it
+                    acked[0] += 1
+    except Exception:
+        pass  # the daemon died mid-request: exactly the point
+
+threads = [threading.Thread(target=writer, args=(w,), daemon=True)
+           for w in range(4)]
+for t in threads:
+    t.start()
+p.wait(timeout=90)
+assert p.returncode == 137, f"want kill -9 exit, got {p.returncode}"
+for t in threads:
+    t.join(timeout=30)
+
+os.unlink(sd + "/serve.addr")  # kill -9 left the stale address behind
+p = spawn()
+c = connect_retry(*addr(), timeout_s=60)
+st = c.kv("STATS")
+assert st["applied_seqno"] >= acked[0], ("acked insert lost across the "
+                                         "mid-group kill -9", acked[0], st)
+assert st["applied_seqno"] == st["durable_seqno"], st
+
+def burst(w):
+    with ServeClient(*addr(), timeout_s=60) as wc:
+        for i in range(40):
+            wc.insert([((3 * i + w) % (mv + 1), (5 * i + w + 1) % (mv + 1))])
+
+threads = [threading.Thread(target=burst, args=(w,), daemon=True)
+           for w in range(4)]
+for t in threads:
+    t.start()
+for t in threads:
+    t.join(timeout=60)
+st = c.kv("STATS")
+assert st["applied_seqno"] == st["durable_seqno"] >= acked[0] + 160, st
+assert 1 <= st["gc_fsyncs"] <= st["gc_records"], st
+assert len(c.part(list(range(50)))) == 50  # the seqlock read path answers
+c.request("QUIT")
+c.close()
+p.send_signal(signal.SIGTERM)
+p.wait(timeout=60)
+print("group-commit smoke ok: kill -9 at gc-unsynced lost nothing acked "
+      "(%d acked, %d recovered)" % (acked[0], st["applied_seqno"]))
+EOF
+then
+  echo "GROUP-COMMIT SMOKE FAILED: kill -9 mid-group lost an acknowledged" \
+       "insert or the shared fsync never amortized" >&2
+  exit 1
+fi
+# -------------------------------------------------------------------------
+
 set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=${PIPESTATUS[0]}; echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c); exit $rc

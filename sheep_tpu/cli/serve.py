@@ -52,6 +52,7 @@ Options:
 
 Env: SHEEP_SERVE_DEADLINE_S, SHEEP_SERVE_MAX_INFLIGHT,
 SHEEP_SERVE_SNAP_EVERY, SHEEP_SERVE_DRIFT, SHEEP_SERVE_DRIFT_MIN,
+SHEEP_SERVE_GROUP_COMMIT_MAX / _DELAY_S (leader group-commit window),
 SHEEP_SERVE_TENANTS (comma list of name=dir[:graph[:k]]),
 SHEEP_SERVE_MAX_RESIDENT (resident-tenant cap; cold ones evict),
 SHEEP_TRACE_SAMPLE (1/N per-request serve.req span sampling),
@@ -156,7 +157,9 @@ def main(argv: list[str] | None = None) -> int:
                    drift_min_cut=config.drift_min_cut,
                    reseq_frac=config.reseq_frac,
                    reseq_min=config.reseq_min,
-                   reseq_rank=config.reseq_rank)
+                   reseq_rank=config.reseq_rank,
+                   group_commit_max=config.group_commit_max,
+                   group_commit_delay_s=config.group_commit_delay_s)
     try:
         bootstrap = not snap_paths(state_dir) if os.path.isdir(state_dir) \
             else True

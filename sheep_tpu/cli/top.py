@@ -20,8 +20,13 @@ trails by); the footer adds the router's migration tallies and, when
 the rebalancer is on, its go/hold verdict counts.  Likewise once a
 tenant reports sequence drift or a completed re-sequence (ISSUE 18)
 the ``SDRIFT`` (out-of-sequence inserts since the last cut) and
-``RESEQ`` (completed re-sequence generations) columns appear.  An ``instances``
-footer shows per-instance epoch/lag/RSS from the same scrape.
+``RESEQ`` (completed re-sequence generations) columns appear.  Once a
+tenant has paid a group-commit fsync or a lock-free read has retried
+(ISSUE 19) the write-path columns appear: ``FSYN/s`` (shared WAL
+fsyncs per second — the amortization the group commit buys), ``GC50``
+/ ``GC99`` (records per shared fsync, p50/p99) and ``SLRT`` (seqlock
+read retries).  An ``instances`` footer shows per-instance
+epoch/lag/RSS from the same scrape.
 
 ``--json`` takes two scrapes ``-i`` seconds apart (default 1.0; 0 =
 single scrape, qps null) and prints one JSON object — what the tier-1
@@ -87,7 +92,9 @@ def fleet_view(samples) -> dict:
             t, {"instances": [], "resident_on": [], "requests": 0.0,
                 "window_p99_ms": None, "applied_seqno": 0,
                 "cluster": None, "mig": None, "mig_lag": None,
-                "seq_drift": None, "reseqs": None})
+                "seq_drift": None, "reseqs": None,
+                "gc_fsyncs": 0.0, "gc_p50": None, "gc_p99": None,
+                "seqlock_retries": None})
 
     for name, labels, val in samples:
         inst = labels.get("instance")
@@ -154,6 +161,23 @@ def fleet_view(samples) -> dict:
             rec = tn(labels)
             if rec is not None:
                 rec["reseqs"] = max(rec["reseqs"] or 0, int(val))
+        elif name == "sheep_serve_group_commit_fsyncs_total":
+            rec = tn(labels)
+            if rec is not None:
+                rec["gc_fsyncs"] += val
+        elif name == "sheep_serve_group_commit_size_p50":
+            rec = tn(labels)
+            if rec is not None:
+                rec["gc_p50"] = max(rec["gc_p50"] or 0, int(val))
+        elif name == "sheep_serve_group_commit_size_p99":
+            rec = tn(labels)
+            if rec is not None:
+                rec["gc_p99"] = max(rec["gc_p99"] or 0, int(val))
+        elif name == "sheep_serve_read_seqlock_retries_total":
+            rec = tn(labels)
+            if rec is not None:
+                rec["seqlock_retries"] = (rec["seqlock_retries"] or 0) \
+                    + int(val)
         elif name == "sheep_worker_legs_inflight":
             wk(labels)["legs_inflight"] = int(val)
         elif name == "sheep_worker_legs_done":
@@ -205,11 +229,15 @@ def fleet_view(samples) -> dict:
 
 
 def qps_between(prev: dict, cur: dict, dt: float) -> None:
-    """Stamp per-tenant qps from two views' request-counter deltas."""
+    """Stamp per-tenant qps (and group-commit fsyncs/s) from two views'
+    counter deltas."""
     for t, rec in cur["tenants"].items():
         before = prev["tenants"].get(t, {}).get("requests", 0.0)
         rec["qps"] = round(max(0.0, rec["requests"] - before)
                            / max(dt, 1e-9), 1)
+        gc0 = prev["tenants"].get(t, {}).get("gc_fsyncs", 0.0)
+        rec["fsyncs_per_s"] = round(max(0.0, rec["gc_fsyncs"] - gc0)
+                                    / max(dt, 1e-9), 1)
 
 
 def render_table(view: dict, scrape_bytes: int) -> str:
@@ -221,12 +249,19 @@ def render_table(view: dict, scrape_bytes: int) -> str:
     # appear once a tenant reports sequence drift or a completed reseq
     reseqing = any(rec.get("reseqs") or rec.get("seq_drift")
                    for rec in view["tenants"].values())
+    # ...and again for the group-commit write path (ISSUE 19): the
+    # columns appear once a tenant has paid any shared fsync or a
+    # lock-free read has retried — an idle fleet's table is unchanged
+    committing = any(rec.get("gc_fsyncs") or rec.get("seqlock_retries")
+                     for rec in view["tenants"].values())
     head = (f"{'TENANT':<12} {'CLUSTER':<8} {'QPS':>8} {'P99w':>9} "
             f"{'LAG':>5} {'EPOCH':>5} {'RES':>4} {'APPLIED':>9}")
     if migrating:
         head += f" {'MIG':>8} {'DLAG':>6}"
     if reseqing:
         head += f" {'SDRIFT':>6} {'RESEQ':>5}"
+    if committing:
+        head += f" {'FSYN/s':>7} {'GC50':>5} {'GC99':>5} {'SLRT':>6}"
     lines = [head, "-" * len(head)]
     for t, rec in sorted(view["tenants"].items()):
         p99 = rec.get("window_p99_ms")
@@ -246,6 +281,13 @@ def render_table(view: dict, scrape_bytes: int) -> str:
             rq = rec.get("reseqs")
             row += (f" {(sd if sd is not None else '-'):>6} "
                     f"{(rq if rq is not None else '-'):>5}")
+        if committing:
+            fps = rec.get("fsyncs_per_s")
+            slr = rec.get("seqlock_retries")
+            row += (f" {(fps if fps is not None else '-'):>7} "
+                    f"{(rec.get('gc_p50') if rec.get('gc_p50') is not None else '-'):>5} "
+                    f"{(rec.get('gc_p99') if rec.get('gc_p99') is not None else '-'):>5} "
+                    f"{(slr if slr is not None else '-'):>6}")
         lines.append(row)
     lines.append("")
     ihead = (f"{'INSTANCE':<22} {'CLUSTER':<8} {'EPOCH':>5} "

@@ -25,6 +25,10 @@ What is learned, and from where:
                          by link scale — the historical cost ``sheep
                          plan --explain`` prints beside each candidate's
                          analytic price.
+  ``fold_bps:reseq``     measured bytes/s of a serve-tier re-sequence
+                         fold (``reseq.fold`` spans, serve/reseq.py) —
+                         replaces the analytic RESEQ_FOLD_BPS guess in
+                         ``plan_reseq`` once this host has history.
 
 Keys carry a **host fingerprint** (cpu model + effective cores) and a
 **scale bucket** (log2 of n or links): a prior learned on an 8-core
@@ -200,6 +204,12 @@ class PriorStore:
                 if rung and size and dur > 0:
                     self.observe("rung_s", str(rung), size, dur, host)
                     seen += 1
+            elif k == "span" and name == "reseq.fold":
+                b = int(a.get("bytes") or 0)
+                dur = float(r.get("dur", 0.0))
+                if b > 0 and dur > 0:
+                    self.observe("fold_bps", "reseq", b, b / dur, host)
+                    seen += 1
         return seen
 
     def harvest_bench(self, path: str, host: str | None = None) -> int:
@@ -239,6 +249,18 @@ def mem_ratio(priors: "PriorStore | None", rung: str, n: int) -> dict | None:
     if priors is None:
         return None
     p = priors.lookup("mem_ratio", rung, n)
+    if p is None or p["count"] < MIN_CORRECT_SAMPLES or p["mean"] <= 0:
+        return None
+    return p
+
+
+def fold_bps(priors: "PriorStore | None", blob: int) -> dict | None:
+    """The usable measured fold-throughput prior (bytes/s) for a
+    re-sequence of ``blob`` bytes on this host, or None (no store / too
+    few samples to correct)."""
+    if priors is None:
+        return None
+    p = priors.lookup("fold_bps", "reseq", blob)
     if p is None or p["count"] < MIN_CORRECT_SAMPLES or p["mean"] <= 0:
         return None
     return p

@@ -95,6 +95,13 @@ RESEQ_ENV = "SHEEP_RESEQ"
 RESEQ_DRIFT_ENV = "SHEEP_RESEQ_DRIFT"
 RESEQ_DRIFT_MIN_ENV = "SHEEP_RESEQ_DRIFT_MIN"
 RESEQ_RANK_ENV = "SHEEP_RESEQ_RANK"
+#: the leader group-commit window (ISSUE 19): the shared fsync is cut
+#: when the group reaches MAX records or DELAY_S elapses with company; a
+#: lone insert never waits (idle latency unchanged).  DELAY_S=0 keeps
+#: pure piggybacking: whatever appended during the previous fsync forms
+#: the next group.
+GROUP_COMMIT_MAX_ENV = "SHEEP_SERVE_GROUP_COMMIT_MAX"
+GROUP_COMMIT_DELAY_ENV = "SHEEP_SERVE_GROUP_COMMIT_DELAY_S"
 
 #: a connection whose un-flushed responses exceed this is a slow
 #: consumer and is closed (replication peers get snapshot-sized room)
@@ -119,6 +126,10 @@ class ServeConfig:
     reseq_frac: float = 0.25
     reseq_min: int = 256
     reseq_rank: int = 8
+    #: leader group commit (ISSUE 19): records per shared fsync cap and
+    #: the adaptive window a non-lone leader may stretch to fill it
+    group_commit_max: int = 256
+    group_commit_delay_s: float = 0.002
     read_only: bool = False
     #: ceiling on how long an injected hang may stall a handler
     hang_cap_s: float = 2.0
@@ -145,6 +156,11 @@ class ServeConfig:
             kw["reseq_min"] = int(os.environ[RESEQ_DRIFT_MIN_ENV])
         if os.environ.get(RESEQ_RANK_ENV):
             kw["reseq_rank"] = int(os.environ[RESEQ_RANK_ENV])
+        if os.environ.get(GROUP_COMMIT_MAX_ENV):
+            kw["group_commit_max"] = int(os.environ[GROUP_COMMIT_MAX_ENV])
+        if os.environ.get(GROUP_COMMIT_DELAY_ENV):
+            kw["group_commit_delay_s"] = float(
+                os.environ[GROUP_COMMIT_DELAY_ENV])
         kw.update(overrides)
         return cls(**kw)
 
@@ -1363,6 +1379,24 @@ class ServeDaemon:
                       "completed re-sequence swaps per tenant")
         sgen = m.gauge("sheep_serve_seq_gen",
                        "sequence generation currently served")
+        # group-commit + seqlock visibility (ISSUE 19): the write-path
+        # amortization (fsyncs vs records, recent group size quantiles)
+        # and how often lock-free reads had to retry or take the lock —
+        # `sheep top` derives fsyncs/s and grouping from these
+        gcf = m.gauge("sheep_serve_group_commit_fsyncs_total",
+                      "shared group-commit fsyncs on the leader write "
+                      "path")
+        gcr = m.gauge("sheep_serve_group_commit_records_total",
+                      "insert records covered by group-commit fsyncs")
+        gc50 = m.gauge("sheep_serve_group_commit_size_p50",
+                       "p50 records per shared fsync (last 512 groups)")
+        gc99 = m.gauge("sheep_serve_group_commit_size_p99",
+                       "p99 records per shared fsync (last 512 groups)")
+        slr = m.gauge("sheep_serve_read_seqlock_retries_total",
+                      "lock-free read attempts discarded by a racing "
+                      "write")
+        slf = m.gauge("sheep_serve_read_seqlock_fallbacks_total",
+                      "lock-free reads that fell back to the state lock")
         for name in self.tenants.names():
             t = self.tenants.get(name)
             res.labels(tenant=name).set(int(t.resident))
@@ -1371,6 +1405,14 @@ class ServeDaemon:
                 sdrift.labels(tenant=name).set(t.core.seq_drift)
                 rsq.labels(tenant=name).set(t.core.reseqs)
                 sgen.labels(tenant=name).set(t.core.seq_gen)
+                gcf.labels(tenant=name).set(t.core.gc_fsyncs)
+                gcr.labels(tenant=name).set(t.core.gc_records)
+                gc50.labels(tenant=name).set(
+                    t.core._gc_size_quantile(0.5))
+                gc99.labels(tenant=name).set(
+                    t.core._gc_size_quantile(0.99))
+                slr.labels(tenant=name).set(t.core.seqlock_retries)
+                slf.labels(tenant=name).set(t.core.seqlock_fallbacks)
             evg.labels(tenant=name).set(t.evictions)
             rsg.labels(tenant=name).set(t.restores)
             if t.mig is not None:

@@ -22,13 +22,27 @@ The sites are the boundaries of one request's lifecycle:
   insert  an insert request, at dispatch — BEFORE its WAL append, so a
           kill here loses an unacknowledged insert (allowed: it was never
           acknowledged)
-  wal     immediately after the insert's WAL record is fsync'd, before
-          the in-memory apply — the critical boundary: a kill here MUST
-          recover the insert from the log (kill-at-every-insert-boundary
-          property, tests/test_serve.py)
-  apply   after the in-memory apply, before the OK is written — a kill
-          here must change nothing on replay (the record is already
-          applied; replay must be idempotent by seqno)
+  wal     immediately after the insert's WAL record is fsync'd (under
+          group commit: after the SHARED group fsync that covers it),
+          before the OK is written — the critical boundary: a kill here
+          MUST recover the insert from the log
+          (kill-at-every-insert-boundary property, tests/test_serve.py)
+  apply   after the in-memory apply is durable, before the OK is
+          written — a kill here must change nothing on replay (the
+          record is already applied; replay must be idempotent by seqno)
+
+The leader group-commit path (ISSUE 19) adds the two boundaries that
+exist BEFORE the shared fsync — both may lose the record, and both are
+allowed to, because the OK is only written after the fsync:
+
+  gc-append    inside the critical section, before the deferred
+               (sync=False) WAL append — a kill here loses the insert
+               entirely; it was never appended, applied, or acked
+  gc-unsynced  after the deferred append + in-memory apply, before the
+               shared group fsync — the record is in the OS file but
+               not durable; a power cut here tears the group tail and
+               replay stops at the last synced boundary (never acked,
+               so nothing acked is lost)
 
 The re-sequence job (ISSUE 18, serve/reseq.py) adds its four phase
 boundaries — each one a point where kill -9 must resume or abort
@@ -70,8 +84,9 @@ from dataclasses import dataclass, field
 SERVE_FAULT_PLAN_ENV = "SHEEP_SERVE_FAULT_PLAN"
 
 KINDS = ("kill", "hang", "slow")
-SITES = ("req", "query", "insert", "wal", "apply",
-         "reseq-hist", "reseq-fold", "reseq-swap", "reseq-seal", "*")
+SITES = ("req", "query", "insert", "gc-append", "gc-unsynced", "wal",
+         "apply", "reseq-hist", "reseq-fold", "reseq-swap", "reseq-seal",
+         "*")
 
 #: how long a "slow" fault stalls while holding its slot
 SLOW_S = 0.25

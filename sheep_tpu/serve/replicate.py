@@ -568,16 +568,21 @@ class ReplicationHub:
             if sent_any:
                 last_sent_t = time.monotonic()
                 continue  # more may have landed while we were sending
+            # durable_seqno, not applied: records_from withholds the
+            # group-commit window's unsynced tail, so waking on applied
+            # would busy-spin until the shared fsync lands — and a PING
+            # advertising an unsynced seqno would NACK-loop the follower
+            # asking for records the leader will not ship yet
             with self._cv:
-                if fs.next_send <= self.core.applied_seqno:
+                if fs.next_send <= self.core.durable_seqno:
                     continue  # a NACK rewound us while unlocked
                 self._cv.wait(self.hb_s)
             if not fs.alive or self._stopped:
                 return
             if (time.monotonic() - last_sent_t >= self.hb_s
-                    and fs.next_send > self.core.applied_seqno):
+                    and fs.next_send > self.core.durable_seqno):
                 line = encode_ping(self.core.epoch,
-                                   self.core.applied_seqno)
+                                   self.core.durable_seqno)
                 if not self._transmit(fs, line, "hb"):
                     self.detach(fs.conn)
                     return
@@ -809,6 +814,17 @@ class Replicator:
             line = rf.readline().decode("ascii").strip()
             toks = line.split()
             if not toks or toks[0] != "OK":
+                if " badrepl " in f" {line} ":
+                    # our sig is unknown to the leader's chain: we
+                    # applied a re-sequence generation the cluster lost
+                    # (the old leader died before its swap quorum-acked,
+                    # ISSUE 19).  Without an exit this retries forever;
+                    # with one, the orphan rolls back to the surviving
+                    # leader's generation — sound, because it is in our
+                    # own manifest chain and nothing acked lives only in
+                    # the orphaned gen.
+                    if self._adopt_across_badrepl(host, port):
+                        return  # reconnect under the adopted identity
                 raise ReplProtocolError(f"HELLO refused: {line!r}")
             kv = parse_kv_args(toks[1:])
             if kv.get("mode") == "snapshot":
@@ -867,3 +883,58 @@ class Replicator:
                     return  # leader went away: rediscover + reconnect
                 applier.feed(data)
                 self.last_frame_t = time.monotonic()
+
+    def _adopt_across_badrepl(self, host: str, port: int) -> bool:
+        """The snapshot-adoption exit for a ``badrepl`` refusal: this
+        replica serves a sequence generation the surviving leader's
+        chain has never seen (it applied a RESEQ swap whose leader died
+        before the quorum ack, PR 18's orphan).  Fetch the leader's
+        snapshot and, ONLY when its sig is in our own manifest chain
+        (i.e. we are rolling back along our own history, not adopting a
+        foreign build input), adopt it under a durable adoption manifest
+        — the exact discipline of the forward gen-mismatch path, with
+        the rollback sanctioned the same way.  Returns True when the
+        core now serves the leader's generation."""
+        from . import reseq as reseq_mod
+        core = self.core
+        if not core.state_dir:
+            return False
+        try:
+            blob, seqno, epoch, sig = fetch_snapshot(
+                host, port, timeout_s=max(5.0, 10 * self.hb_s),
+                tenant=self.tenant)
+        except (OSError, ConnectionError, ReplProtocolError,
+                IntegrityError) as exc:
+            self.events.append(("repl_error", f"badrepl fetch: {exc}"))
+            return False
+        if not sig or sig == core.sig:
+            return False
+        if not reseq_mod.chain_has_sig(core.state_dir, sig):
+            # genuinely a different build input: keep refusing loudly
+            self.events.append(("repl_error",
+                                f"badrepl sig {sig[:12]}... not in the "
+                                f"local chain — not adopting"))
+            return False
+        tmp = os.path.join(core.state_dir, "resync.fetch")
+        with open(tmp, "wb") as f:
+            f.write(blob)
+        try:
+            snap = load_serve_snapshot(tmp, integrity="trust")
+            if snap.sig != sig:
+                raise IntegrityError(
+                    f"replication snapshot sig {snap.sig[:12]}... does "
+                    f"not match the advertised {sig[:12]}...")
+            reseq_mod.write_adoption(core.state_dir, core.sig,
+                                     core.seq_gen, snap.sig, snap.seq_gen)
+            core.reset_from_snapshot(snap, allow_sig_change=True,
+                                     allow_gen_rollback=True)
+            reseq_mod.finish_adoption(core.state_dir, snap.sig,
+                                      snap.seq_gen)
+        finally:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+        self.resyncs += 1
+        self.events.append(("repl_reseq_rollback", snap.seq_gen))
+        return True

@@ -53,6 +53,7 @@ import numpy as np
 from ..core.sequence import (degree_sequence_from_degrees,
                              host_degree_histogram)
 from ..integrity.errors import IntegrityError, MalformedArtifact
+from ..obs import trace as _obs
 from ..runtime.snapshot import input_signature
 
 MANIFEST_NAME = "reseq.json"
@@ -131,13 +132,22 @@ def active(state_dir: str) -> bool:
 def _sig_order(man: dict) -> list[str]:
     """Every signature the manifest vouches for, oldest first: the
     completed chain plus (once the swap phase is durable) the in-flight
-    old->new link."""
+    old->new link.  The in-flight link DEFINES the direction: a
+    sanctioned gen ROLLBACK (the badrepl orphan adopting the surviving
+    leader's older generation, ISSUE 19) re-orders new_sig after
+    old_sig even when both already sit in the chain the other way
+    around — so every crash window of the rollback heals through the
+    same old->new gate as a forward adoption."""
     order = [c.get("sig") for c in man.get("chain", [])
              if isinstance(c, dict) and c.get("sig")]
     if man.get("phase") in ("swap", "adopt", "done"):
-        for s in (man.get("old_sig"), man.get("new_sig")):
-            if s and s not in order:
-                order.append(s)
+        old, new = man.get("old_sig"), man.get("new_sig")
+        if old and old not in order:
+            order.append(old)
+        if new:
+            if new in order:
+                order.remove(new)
+            order.append(new)
     return order
 
 
@@ -284,7 +294,9 @@ def finish_adoption(state_dir: str, new_sig: str, new_gen: int) -> None:
 
 def _price(records: int, inserted: int, seq_drift: int) -> dict:
     from ..plan.model import plan_reseq
-    return plan_reseq(records, inserted, seq_drift)
+    from ..plan.priors import PriorStore
+    return plan_reseq(records, inserted, seq_drift,
+                      priors=PriorStore.from_env())
 
 
 def run_reseq(core, force: bool = False, hub=None,
@@ -417,18 +429,25 @@ def _drive(core, man: dict, ticket: int, hub=None,
 
     # -- fold: the streamed build over .dat + WAL'd inserts.  Checkpoints
     # land in the state dir; resume=True picks them up after a kill.
+    # The span carries the fold's blob size so the PriorStore can
+    # harvest MEASURED fold throughput for plan_reseq (the same loop
+    # that teaches plan_build its rung seconds).
     graph_path = core.graph_path
-    if graph_path and graph_path.endswith(".dat"):
-        from ..ops.extmem import build_forest_extmem
-        _, forest = build_forest_extmem(
-            graph_path, block_edges=block, seq=new_seq,
-            checkpoint_dir=ckpt_dir(state_dir), resume=True,
-            governor=core.governor, events=events,
-            tail_edges=(ins_t, ins_h))
-    else:
-        from ..core.forest import build_forest
-        forest = build_forest(tail, head, new_seq,
-                              max_vid=max(n - 1, 0))
+    blob_bytes = (len(tail) + len(head)) * tail.itemsize \
+        + len(new_seq) * new_seq.itemsize
+    with _obs.span("reseq.fold", bytes=int(blob_bytes),
+                   records=int(len(tail)), gen=int(man["new_gen"])):
+        if graph_path and graph_path.endswith(".dat"):
+            from ..ops.extmem import build_forest_extmem
+            _, forest = build_forest_extmem(
+                graph_path, block_edges=block, seq=new_seq,
+                checkpoint_dir=ckpt_dir(state_dir), resume=True,
+                governor=core.governor, events=events,
+                tail_edges=(ins_t, ins_h))
+        else:
+            from ..core.forest import build_forest
+            forest = build_forest(tail, head, new_seq,
+                                  max_vid=max(n - 1, 0))
     parent, pst = forest.parent, forest.pst_weight
 
     # -- pending artifact durable, THEN the swap phase: the extmem

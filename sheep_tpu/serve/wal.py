@@ -326,19 +326,23 @@ class WalAppender:
         self.next_seqno = seqno + 1
         return seqno
 
-    def sync(self) -> None:
+    def sync(self, **attrs) -> None:
         """fsync any deferred appends (the burst seal).  No-op when
         nothing is pending.  On failure the error re-raises typed and the
         log is NOT truncated: deferred records are already applied by the
         caller and a truncation here could leave a seqno gap on disk —
         the bytes stay buffered for a later retry, and a crash before one
         lands is covered by the torn-tail repair (none of the deferred
-        records were acknowledged)."""
+        records were acknowledged).
+
+        ``attrs`` land on the ``wal.fsync`` span: the group-commit
+        coordinator (serve/state.py) attributes the one shared fsync to
+        every rid it seals (one span, many rids)."""
         if not self._unsynced:
             return
         try:
             self._f.flush()
-            with _obs.span("wal.fsync", burst=True):
+            with _obs.span("wal.fsync", burst=True, **attrs):
                 os.fsync(self._f.fileno())
         except OSError as exc:
             typed = _typed(exc, self.path)

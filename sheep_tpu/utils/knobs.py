@@ -243,10 +243,17 @@ KNOBS: dict[str, Knob] = {k.name: k for k in [
        "repartition"),
     _K("SHEEP_SERVE_DRIFT_MIN", "int", "64",
        "serve", "minimum cut inserts before drift can trigger"),
+    _K("SHEEP_SERVE_GROUP_COMMIT_MAX", "int", "256",
+       "serve", "max records one shared group-commit fsync may cover; "
+       "a full window seals immediately"),
+    _K("SHEEP_SERVE_GROUP_COMMIT_DELAY_S", "float", "0.002",
+       "serve", "max extra wait for companions before the group fsync "
+       "(a lone insert never waits)"),
     _K("SHEEP_SERVE_FAULT_PLAN", "plan", "",
        "serve", "serve-layer fault plan kind@site:nth "
-       "(kill/hang/slow at req/query/insert/wal/apply and the "
-       "reseq-hist/fold/swap/seal phase boundaries)"),
+       "(kill/hang/slow at req/query/insert/gc-append/gc-unsynced/"
+       "wal/apply and the reseq-hist/fold/swap/seal phase "
+       "boundaries)"),
     _K("SHEEP_SERVE_TENANTS", "list", "",
        "serve", "tenant specs name=dir[:graph[:k]] behind one daemon"),
     _K("SHEEP_SERVE_MAX_RESIDENT", "int", "0",

@@ -441,3 +441,70 @@ def test_plan_cli_usage_errors(plan_env, capsys):
     assert main([]) == 2
     assert main(["--harvest", "x.store"]) == 2
     assert main(["/nonexistent/g.dat"]) == 1
+
+# ---------------------------------------------------------------------------
+# plan_reseq learns fold throughput (ISSUE 19 satellite)
+# ---------------------------------------------------------------------------
+
+
+def test_plan_reseq_learns_fold_throughput(plan_env, tmp_path):
+    """The serve-tier re-sequence planner learns the way plan_build
+    does: ``reseq.fold`` trace spans harvest into a ``fold_bps`` prior,
+    and plan_reseq then prices the rebuild at the MEASURED throughput —
+    provenance ``learned`` — with the analytic RESEQ_FOLD_BPS fallback
+    whenever history is too thin to correct."""
+    import time as _t
+
+    from sheep_tpu.obs import trace as obs
+    from sheep_tpu.plan.model import RESEQ_FOLD_BPS, plan_reseq
+    from sheep_tpu.plan.priors import fold_bps
+
+    records, inserted = 1 << 20, 1 << 10
+    blob = (records + inserted) * 12
+    base = plan_reseq(records, inserted, 5, horizon_s=60.0)
+    assert base["decision"] == "go" and base["provenance"] == "priced"
+    assert base["fold_bps"] == RESEQ_FOLD_BPS
+
+    # real reseq.fold spans harvest into the fold_bps prior
+    tpath = str(tmp_path / "r.trace")
+    plan_env.setenv("SHEEP_TRACE", tpath)
+    try:
+        for _ in range(2):
+            with obs.span("reseq.fold", bytes=blob, records=records):
+                _t.sleep(0.01)
+    finally:
+        obs.close_recorder()
+    st = PriorStore()
+    assert st.harvest_trace(tpath) == 2
+    p = fold_bps(st, blob)
+    assert p and p["count"] == 2 and p["mean"] > 0
+
+    # measured history REPLACES the analytic constant: a host whose
+    # folds really run at 4 MB/s prices 16x dearer, provenance learned
+    slow = PriorStore()
+    slow.observe("fold_bps", "reseq", blob, float(4 << 20))
+    slow.observe("fold_bps", "reseq", blob, float(4 << 20))
+    out = plan_reseq(records, inserted, 5, horizon_s=60.0, priors=slow)
+    assert out["provenance"] == "learned"
+    assert out["fold_bps"] == 4 << 20
+    assert out["cost_s"] > base["cost_s"]
+    assert out["analytic_cost_s"] == base["cost_s"]
+    assert out["prior"]["count"] == 2
+    assert "measured fold" in out["reason"]
+    # ...and the learned price can flip the verdict at a tight horizon
+    out2 = plan_reseq(records, inserted, 5, horizon_s=1.0, priors=slow)
+    assert out2["decision"] == "stay" and out2["provenance"] == "learned"
+
+    # one noisy sample must not correct (MIN_CORRECT_SAMPLES)
+    thin = PriorStore()
+    thin.observe("fold_bps", "reseq", blob, float(4 << 20))
+    out3 = plan_reseq(records, inserted, 5, horizon_s=60.0, priors=thin)
+    assert out3["provenance"] == "priced"
+    assert out3["fold_bps"] == RESEQ_FOLD_BPS
+
+    # a prior at a DIFFERENT scale bucket never corrects this blob
+    far = PriorStore()
+    far.observe("fold_bps", "reseq", blob // 1024, float(4 << 20))
+    far.observe("fold_bps", "reseq", blob // 1024, float(4 << 20))
+    out4 = plan_reseq(records, inserted, 5, horizon_s=60.0, priors=far)
+    assert out4["provenance"] == "priced"

@@ -437,3 +437,63 @@ def test_reseq_pins_tenant_eviction(tmp_path):
     man["phase"] = "done"
     reseq.save_manifest(sd, man)
     core.close()
+
+# ---------------------------------------------------------------------------
+# the orphaned follower: rollback across badrepl (ISSUE 19 satellite)
+# ---------------------------------------------------------------------------
+
+
+def test_orphaned_follower_rolls_back_across_badrepl(tmp_path):
+    """PR 18's leftover orphan: a replica that applied a re-sequence
+    swap whose leader died before the quorum ack HELLOs the surviving
+    leader with a sig the leader's chain has never seen.  That badrepl
+    refusal used to retry forever; now the orphan fetches the leader's
+    snapshot and — because the leader's sig is in the ORPHAN'S own
+    manifest chain (a rollback along its own history, not a foreign
+    build input) — adopts it under a durable adoption manifest and
+    streams again.  Sound: the swap carried no client writes, so
+    nothing acked lives only in the orphaned generation."""
+    lcore, lsd, _, _ = _state(tmp_path, "lead")
+    for row in _skewed_inserts(12):
+        lcore.insert(row.reshape(1, 2))
+    lcore.close()
+    # the orphan: a bit-identical replica that went one generation
+    # AHEAD on a swap the cluster lost with its failed leader
+    fsd = str(tmp_path / "orphan")
+    shutil.copytree(lsd, fsd)
+    orphan = ServeCore.open(fsd)
+    res = run_reseq(orphan, force=True)
+    assert res["seq_gen"] == 1
+    orphan_sig = orphan.sig
+    orphan.close()
+
+    lead = ServeDaemon(
+        ServeCore.open(lsd), ServeConfig(),
+        cluster=ClusterConfig(node_id="L", role="leader", peers=[fsd],
+                              hb_s=0.05, failover_s=0.6,
+                              poll_timeout_s=1.0)).start()
+    lh, lp = lead.address
+    assert lead.core.seq_gen == 0  # the cluster never saw gen 1
+    fol = ServeDaemon(
+        ServeCore.open(fsd), ServeConfig(),
+        cluster=ClusterConfig(node_id="F", role="follower", peers=[lsd],
+                              hb_s=0.05, failover_s=0.6,
+                              poll_timeout_s=1.0)).start()
+    assert fol.core.sig == orphan_sig != lead.core.sig
+    # the fix: rollback adoption instead of a badrepl retry loop
+    _wait_until(lambda: fol.core.sig == lead.core.sig,
+                what="orphan rollback adoption")
+    assert fol.core.seq_gen == 0
+    _wait_until(lambda: lead.hub.follower_count() == 1,
+                what="orphan re-attached")
+    # ...and the rolled-back replica streams normally again
+    with ServeClient(lh, lp) as c:
+        c.insert([(3, 141)])
+    _wait_until(lambda: fol.core.applied_seqno == lead.core.applied_seqno,
+                what="post-rollback insert replicated")
+    np.testing.assert_array_equal(fol.core.parent, lead.core.parent)
+    # the rollback is SANCTIONED: the orphan's dir passes strict fsck
+    _, failures = fsck_paths([fsd], mode="strict")
+    assert not failures, failures
+    lead.shutdown()
+    fol.shutdown()

@@ -14,7 +14,7 @@ import time
 import numpy as np
 import pytest
 
-from sheep_tpu import INVALID_PART
+from sheep_tpu import INVALID_JNID, INVALID_PART
 from sheep_tpu.core.forest import build_forest
 from sheep_tpu.core.sequence import degree_sequence, sequence_positions
 from sheep_tpu.integrity.errors import IntegrityError, MalformedArtifact
@@ -209,6 +209,46 @@ def test_insert_link_property_random_graphs():
                             impl="python")
         np.testing.assert_array_equal(parent, want.parent)
         np.testing.assert_array_equal(pst, want.pst_weight.astype(np.int64))
+
+
+def test_insert_link_ancestor_memo_is_pure_accelerator():
+    """insert_link with the ancestor memo (ISSUE 19) must be
+    bit-identical to the bare walk — same parent array, same rewrite
+    count — across long adversarial link sequences, and every memo
+    entry must remain a live ancestor between calls (the never-
+    invalidated invariant the jump shortcut rests on)."""
+    rng = np.random.default_rng(77)
+    for _ in range(20):
+        n = int(rng.integers(8, 300))
+        bare = np.full(n, INVALID_JNID, dtype=np.uint32)
+        for x in range(n - 1):
+            if rng.random() < 0.8:
+                bare[x] = int(rng.integers(x + 1, n))  # monotone chains
+        memo_parent = bare.copy()
+        skip = np.full(n, INVALID_JNID, dtype=np.uint32)
+        for q in range(400):
+            lo = int(rng.integers(0, n))
+            hi = int(rng.integers(0, n))
+            if lo == hi:
+                continue
+            lo, hi = min(lo, hi), max(lo, hi)
+            want = insert_link(bare, lo, hi)
+            got = insert_link(memo_parent, lo, hi, skip)
+            assert want == got, (q, lo, hi)
+            np.testing.assert_array_equal(bare, memo_parent)
+        # memo invariant: every recorded skip target is still an
+        # ancestor of its node in the final tree
+        for x in range(n):
+            s = int(skip[x])
+            if s == INVALID_JNID:
+                continue
+            r = x
+            while True:
+                p = int(memo_parent[r])
+                assert p != INVALID_JNID, (x, s)
+                r = p
+                if r == s:
+                    break
 
 
 def test_ecv_down_matches_evaluator(tmp_path):
@@ -681,3 +721,260 @@ def test_serve_cli_kill9_recovery(tmp_path):
     finally:
         proc2.terminate()
         proc2.wait(timeout=30)
+
+# ---------------------------------------------------------------------------
+# group commit (ISSUE 19): shared fsync, kill boundaries, torn group tail
+# ---------------------------------------------------------------------------
+
+
+def test_group_commit_shares_fsync_across_concurrent_inserts(tmp_path):
+    """Concurrent inserts must amortize the fsync: strictly fewer shared
+    fsyncs than inserts, every insert durable on return, and recovery
+    bit-identical to the uninterrupted run."""
+    core, sd, _, _ = _tiny_state(tmp_path, name="gc")
+    core.group_commit_delay_s = 0.05
+    nthreads, per = 8, 4
+    total = nthreads * per
+    barrier = threading.Barrier(nthreads)
+    errs = []
+
+    def worker(t):
+        rng = np.random.default_rng(100 + t)
+        barrier.wait()
+        try:
+            for _ in range(per):
+                row = rng.integers(0, 140, size=(1, 2)).astype(np.uint32)
+                core.insert(row)
+        except Exception as exc:  # pragma: no cover - surfaced below
+            errs.append(exc)
+
+    threads = [threading.Thread(target=worker, args=(t,))
+               for t in range(nthreads)]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join()
+    assert not errs
+    st = core.stats()
+    assert st["applied_seqno"] == st["durable_seqno"] == total
+    assert 0 < st["gc_fsyncs"] < total  # the whole point: shared fsyncs
+    assert st["gc_records"] == total
+    assert st["gc_size_p99"] >= st["gc_size_p50"] >= 1
+    core.close()
+    revived = ServeCore.open(sd)
+    np.testing.assert_array_equal(revived.parent, core.parent)
+    np.testing.assert_array_equal(revived.pst, core.pst)
+    assert revived.applied_seqno == total
+    revived.close()
+
+
+@pytest.mark.faults
+def test_kill_at_every_group_commit_boundary(tmp_path):
+    """The NEW pre-fsync boundaries (ISSUE 19).  ``gc-append``: the kill
+    lands before any byte reaches the log — the insert vanishes cleanly
+    (applied == nth).  ``gc-unsynced``: appended + applied but the
+    shared fsync has not run — an in-process kill cannot unflush the
+    OS-buffered record, so the reopen legally recovers it (it was never
+    acknowledged, so recovering OR losing it both honor the contract);
+    POWER loss in the same window is simulated by truncating the log to
+    its pre-append size, and the reopen then lands exactly at the
+    pre-insert boundary.  Every arm must converge bit-identically to the
+    uninterrupted run once the 'client' retries the unacked tail."""
+    core, sd, tail, head = _tiny_state(tmp_path, name="gcref")
+    rng = np.random.default_rng(11)
+    ins = rng.integers(0, 140, size=(6, 2)).astype(np.uint32)
+    for row in ins:
+        core.insert(row.reshape(1, 2))
+    want_parent = core.parent.copy()
+    want_pst = core.pst.copy()
+    want_ecv = core.ecv()["ecv_down"]
+    core.close()
+
+    base_core, base_sd, _, _ = _tiny_state(tmp_path, name="gcbase")
+    base_core.close()
+
+    def run_until_killed(sd_n, site, nth):
+        victim = ServeCore.open(sd_n)
+        sizes = []
+        serve_faults.install_plan(parse_serve_fault_plan(
+            f"kill@{site}:{nth}", kill_mode="raise"))
+        killed_at = None
+        for i, row in enumerate(ins):
+            sizes.append(os.path.getsize(wal_path(sd_n)))
+            try:
+                victim.insert(row.reshape(1, 2))
+            except ServeKilled:
+                killed_at = i
+                break
+        serve_faults.clear_plan()
+        assert killed_at == nth
+        victim.close()
+        return sizes
+
+    def finish_and_check(sd_n, resume_from):
+        revived = ServeCore.open(sd_n)
+        assert revived.applied_seqno == resume_from
+        assert revived.durable_seqno == resume_from
+        for row in ins[resume_from:]:
+            revived.insert(row.reshape(1, 2))
+        np.testing.assert_array_equal(revived.parent, want_parent)
+        np.testing.assert_array_equal(revived.pst, want_pst)
+        assert revived.ecv()["ecv_down"] == want_ecv
+        revived.close()
+
+    for nth in range(len(ins)):
+        # gc-append: killed before the WAL write — nothing to recover
+        sd_n = str(tmp_path / f"kill-gc-append-{nth}")
+        shutil.copytree(base_sd, sd_n)
+        run_until_killed(sd_n, "gc-append", nth)
+        finish_and_check(sd_n, resume_from=nth)
+
+        # gc-unsynced, in-process: the flushed record survives the raise
+        sd_n = str(tmp_path / f"kill-gc-unsynced-{nth}")
+        shutil.copytree(base_sd, sd_n)
+        run_until_killed(sd_n, "gc-unsynced", nth)
+        finish_and_check(sd_n, resume_from=nth + 1)
+
+        # gc-unsynced, power loss: the unfsynced tail never hit the
+        # platter — truncate to the pre-append size and recover WITHOUT
+        # the killed insert
+        sd_n = str(tmp_path / f"cut-gc-unsynced-{nth}")
+        shutil.copytree(base_sd, sd_n)
+        sizes = run_until_killed(sd_n, "gc-unsynced", nth)
+        w = wal_path(sd_n)
+        with open(w, "r+b") as f:
+            f.truncate(sizes[nth])
+        finish_and_check(sd_n, resume_from=nth)
+
+
+def test_wal_torn_multi_record_group_tail(tmp_path):
+    """A deferred-fsync GROUP torn mid-record (power loss inside the
+    commit window): strict refuses, repair salvages exactly the complete
+    records — the group's own durable prefix, never a partial record."""
+    p = str(tmp_path / "group.wal")
+    payloads = [b"one", b"twotwo", b"three33"]
+    create_wal(p, SIG)
+    with WalAppender(p) as w:
+        for payload in payloads:
+            w.append(payload, sync=False)  # one group, seal never ran
+    blob = open(p, "rb").read()
+    open(p, "wb").write(blob[:-3])  # tear record 3 mid-payload
+    with pytest.raises(MalformedArtifact):
+        read_wal(p, "strict")
+    with pytest.warns(UserWarning):
+        _, _, records, _, torn = read_wal(p, "repair")
+    assert torn and [r[1] for r in records] == payloads[:2]
+    with pytest.warns(UserWarning):
+        repair_wal(p)
+    _, _, records, _, torn = read_wal(p, "strict")
+    assert not torn and [r[1] for r in records] == payloads[:2]
+
+
+def test_open_repairs_torn_group_tail_to_group_boundary(tmp_path):
+    """End-to-end: a leader dies with a 3-record group appended but
+    unsealed and power loss tears the 3rd record.  strict refuses the
+    open; repair truncates back to the last COMPLETE record of the group
+    and replays exactly that durable prefix."""
+    from sheep_tpu.serve.state import encode_inserts
+    core, sd, _, _ = _tiny_state(tmp_path, name="gtail")
+    rows = np.array([[1, 2], [3, 4], [5, 6]], np.uint32)
+    w = wal_path(sd)
+    for r in rows:
+        core._wal.append(encode_inserts(r.reshape(1, 2)), sync=False)
+    core._wal.close()  # drop the handle without the covering fsync
+    blob = open(w, "rb").read()
+    open(w, "wb").write(blob[:-3])  # tear record 3 mid-payload
+    with pytest.raises(MalformedArtifact):
+        ServeCore.open(sd)
+    with pytest.warns(UserWarning):
+        revived = ServeCore.open(sd, integrity="repair")
+    assert revived.applied_seqno == 2  # the group's durable prefix
+    assert revived.durable_seqno == 2
+    revived.close()
+
+
+def test_group_commit_fsync_failure_fails_every_covered_waiter(tmp_path):
+    """A failed GROUP fsync must propagate to the insert(s) it covered —
+    nothing covered by the failed fsync may be acknowledged — and a
+    retry after the fault clears succeeds."""
+    core, sd, _, _ = _tiny_state(tmp_path, name="gcfail")
+    core.insert(np.array([[1, 2]], np.uint32))
+    faultfs.install_plan(faultfs.parse_io_fault_plan("eio@wal:0"))
+    with pytest.raises(WriteFault):
+        core.insert(np.array([[3, 4]], np.uint32))
+    faultfs.clear_plan()
+    assert core.durable_seqno == 1  # the failed group acked nothing
+    core.insert(np.array([[5, 6]], np.uint32))
+    assert core.durable_seqno == core.applied_seqno
+    core.close()
+
+
+# ---------------------------------------------------------------------------
+# lock-free reads (ISSUE 19): seqlock parity under an insert hammer
+# ---------------------------------------------------------------------------
+
+
+def test_seqlock_reads_under_insert_hammer(tmp_path):
+    """The seqlock property: while a writer hammers inserts and swaps
+    the partition underneath, every lock-free read that completes inside
+    one stable version is bit-identical to the locked path at that SAME
+    version — batch == scalar == locked, sentinels included, and no read
+    ever observes a half-applied batch or a torn repartition swap."""
+    core, sd, _, _ = _tiny_state(tmp_path, name="hammer", log2=8)
+    vids = np.arange(0, 300, 7, dtype=np.int64)  # straddles the tables
+    done = threading.Event()
+    werrs = []
+
+    def writer():
+        rng = np.random.default_rng(99)
+        try:
+            for i in range(120):
+                rows = rng.integers(0, 280, size=(3, 2)).astype(np.uint32)
+                core.insert(rows)
+                if i % 40 == 20:
+                    core.repartition()  # a mid-hammer atomic swap
+        except Exception as exc:  # pragma: no cover - surfaced below
+            werrs.append(exc)
+        finally:
+            done.set()
+
+    th = threading.Thread(target=writer)
+    th.start()
+    checked = 0
+    try:
+        while not done.is_set() or checked < 25:
+            got_p = core.part_batch(vids)
+            got_b = core.parent_batch(vids)
+            got_e = core.ecv()
+            # pin a version: when it held across the lock-free read, the
+            # locked path at the same version must agree bit-for-bit
+            with core._lock:
+                v0 = core._version
+                want_p = core.part_batch(vids)
+                want_b = core.parent_batch(vids)
+                want_ps = np.array([core.part(int(v)) for v in vids])
+                want_e = core.ecv()
+            got_p2 = core.part_batch(vids)
+            got_b2 = core.parent_batch(vids)
+            if core._version == v0:
+                np.testing.assert_array_equal(got_p2, want_p)
+                np.testing.assert_array_equal(got_b2, want_b)
+                np.testing.assert_array_equal(want_p, want_ps)
+                checked += 1
+            assert got_p.shape == vids.shape  # lock-free always answers
+            assert got_b.shape == vids.shape
+            assert set(got_e) == set(want_e)
+    finally:
+        done.set()
+        th.join()
+    assert not werrs
+    assert checked >= 25
+    st = core.stats()
+    assert st["seqlock_retries"] >= 0  # counters exist and never go bad
+    assert st["seqlock_fallbacks"] >= 0
+    # quiesced: lock-free equals locked exactly, and subtree answers
+    for v in (0, 1, 5, int(vids[-1])):
+        assert core.part(v) == int(core.part_batch([v])[0])
+        sub = core.subtree(v)
+        assert sub is None or (sub[0] >= 1 and isinstance(sub[1], int))
+    core.close()

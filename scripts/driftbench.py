@@ -30,9 +30,19 @@ acceptance booleans computed IN the record:
   accept                both of the above
 
 Usage: python scripts/driftbench.py [graph] [out.json]
+       python scripts/driftbench.py --routed [out.json]
 Defaults: data/hep-th.dat, DRIFTBENCH_r01.json at the repo root.
 Env: DRIFTBENCH_BATCHES (default 6), DRIFTBENCH_BATCH (default 1500),
 DRIFTBENCH_SEED (default 7).
+
+``--routed`` (ISSUE 20, writes DRIFTBENCH_r02.json) runs the fourth
+arm as REAL processes: the same stream shape drives routed inserts via
+``bin/route`` against a live multi-tenant daemon while the daemon's
+own sequence-drift detector fires background re-sequences that race a
+concurrent routed-read thread — accept iff every acked insert survives
+to applied_seqno (acked-loss 0), at least one reseq landed mid-stream,
+and no concurrent read errored.  Env: DRIFTBENCH_ROUTED_BATCHES
+(default 4), DRIFTBENCH_ROUTED_BATCH (default 400).
 """
 
 from __future__ import annotations
@@ -166,7 +176,184 @@ def run_arm(arm, graph, stream, batches, batch, workdir):
     return out
 
 
+def run_routed(out_path):
+    """The ROUTED arm (ISSUE 20, r02): the same seeded power-law insert
+    stream driven via ``bin/route`` against a live MULTI-TENANT daemon
+    while the daemon's own background re-sequence (fired by the
+    sequence-drift detector off the insert path) races concurrent
+    routed reads from a dedicated reader thread.  The in-process arms
+    above prove quality; this arm proves DURABILITY UNDER SERVING:
+
+      acked_loss_zero  every routed-insert OK survives to the daemon's
+                       applied_seqno, per tenant, with a reseq swap (at
+                       least one) landing mid-stream
+      reseq_raced      the detector-driven reseq actually ran while the
+                       reader thread was live (seq_gen advanced)
+      zero_read_errors concurrent routed reads never errored and never
+                       returned a malformed answer through the swap
+    """
+    import signal
+    import subprocess
+    import threading
+
+    from sheep_tpu.serve.protocol import ServeError, connect_retry
+    from sheep_tpu.utils.synth import rmat_edges
+
+    batches = int(os.environ.get("DRIFTBENCH_ROUTED_BATCHES", "4"))
+    batch = int(os.environ.get("DRIFTBENCH_ROUTED_BATCH", "400"))
+    seed = int(os.environ.get("DRIFTBENCH_SEED", "7"))
+    chunk = 16  # pairs per routed INSERT request
+
+    work = tempfile.mkdtemp(prefix="driftbench-routed-")
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    # the background reseq must FIRE under this stream: low thresholds,
+    # detector on (the default), no follower so no quorum waits
+    env["SHEEP_RESEQ"] = "1"
+    env["SHEEP_RESEQ_DRIFT"] = "0.05"
+    env["SHEEP_RESEQ_DRIFT_MIN"] = "64"
+
+    tail, head = rmat_edges(8, 4 << 8, seed=seed)
+    g = os.path.join(work, "g.dat")
+    write_dat(g, tail, head)
+    stream = power_law_stream(tail, head, batches * batch, seed)
+    tenants = ("default", "web")
+    procs = []
+    print(f"DRIFTBENCH routed arm: {len(tail)} edges + {batches}x{batch} "
+          f"power-law inserts x {len(tenants)} tenants via bin/route "
+          f"(seed {seed})", flush=True)
+
+    def _addr(d, name="serve.addr", timeout=90.0):
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            try:
+                host, port = open(os.path.join(d, name)).read().split()
+                return host, int(port)
+            except (OSError, ValueError):
+                time.sleep(0.05)
+        raise SystemExit(f"{d}/{name} never appeared")
+
+    record = {"bench": "DRIFTBENCH", "rev": "r02", "arm": "routed",
+              "edges": int(len(tail)), "batches": batches,
+              "batch": batch, "chunk": chunk, "seed": seed,
+              "tenants": list(tenants)}
+    try:
+        sd = os.path.join(work, "serve")
+        procs.append(subprocess.Popen(
+            [sys.executable, "-m", "sheep_tpu.cli.serve", "-d", sd,
+             "-g", g, "-k", str(NUM_PARTS),
+             "--tenant", f"web={work}/web-t:{g}:{NUM_PARTS}"],
+            env=env, cwd=REPO, stdout=subprocess.DEVNULL,
+            stderr=subprocess.DEVNULL))
+        _addr(sd)
+        rd = os.path.join(work, "route")
+        procs.append(subprocess.Popen(
+            [sys.executable, "-m", "sheep_tpu.cli.route", "-d", rd,
+             "--cluster", sd], env=env, cwd=REPO,
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL))
+        rh, rp = _addr(rd, name="router.addr")
+
+        stop = threading.Event()
+        read_stats = {"n": 0, "errors": 0, "malformed": 0}
+
+        def reader():
+            probe = list(range(32))
+            c = connect_retry(rh, rp, timeout_s=90)
+            i = 0
+            while not stop.is_set():
+                try:
+                    c.tenant(tenants[i % len(tenants)])
+                    got = c.part(probe)
+                    read_stats["n"] += 1
+                    if len(got) != len(probe) \
+                            or not all(isinstance(v, int) for v in got):
+                        read_stats["malformed"] += 1
+                except (ServeError, OSError):
+                    read_stats["errors"] += 1
+                i += 1
+            c.close()
+
+        t = threading.Thread(target=reader, daemon=True)
+        t.start()
+
+        ins = connect_retry(rh, rp, timeout_s=90)
+        acked = {t_: 0 for t_ in tenants}
+        last_seq = {t_: 0 for t_ in tenants}
+        t0 = time.monotonic()
+        for b in range(batches):
+            rows = stream[b * batch:(b + 1) * batch]
+            for t_ in tenants:
+                ins.tenant(t_)
+                for off in range(0, len(rows), chunk):
+                    part = rows[off:off + chunk]
+                    last_seq[t_] = ins.insert(
+                        [(int(u), int(v)) for u, v in part])
+                    acked[t_] += 1
+            print(f"  [routed] batch {b + 1}/{batches}: "
+                  f"acked={acked} reads={read_stats['n']}", flush=True)
+        wall = time.monotonic() - t0
+        stop.set()
+        t.join(timeout=30)
+
+        final = {}
+        for t_ in tenants:
+            ins.tenant(t_)
+            deadline = time.monotonic() + 90
+            while time.monotonic() < deadline:
+                st = ins.kv("STATS")
+                if st["applied_seqno"] >= last_seq[t_]:
+                    break
+                time.sleep(0.05)
+            final[t_] = {k: st[k] for k in ("applied_seqno", "inserted",
+                                            "reseqs", "seq_gen")}
+        ins.request("QUIT")
+        ins.close()
+
+        record["acked"] = acked
+        record["final"] = final
+        record["reads"] = read_stats
+        record["wall_s"] = round(wall, 2)
+        record["acked_loss_zero"] = all(
+            final[t_]["applied_seqno"] == acked[t_]
+            and final[t_]["inserted"] == batches * batch
+            for t_ in tenants)
+        record["reseq_raced"] = any(final[t_]["reseqs"] >= 1
+                                    for t_ in tenants)
+        record["zero_read_errors"] = (read_stats["errors"] == 0
+                                      and read_stats["malformed"] == 0
+                                      and read_stats["n"] > 0)
+        record["accept"] = bool(record["acked_loss_zero"]
+                                and record["reseq_raced"]
+                                and record["zero_read_errors"])
+        record["env_capture"] = env_capture()
+    finally:
+        for p in procs:
+            try:
+                p.send_signal(signal.SIGTERM)
+            except OSError:
+                pass
+        for p in procs:
+            try:
+                p.wait(timeout=30)
+            except subprocess.TimeoutExpired:
+                p.kill()
+        shutil.rmtree(work, ignore_errors=True)
+
+    with open(out_path, "w") as f:
+        json.dump(record, f, indent=2, sort_keys=True)
+        f.write("\n")
+    print(f"DRIFTBENCH routed: acked_loss_zero="
+          f"{record['acked_loss_zero']} reseq_raced="
+          f"{record['reseq_raced']} reads={record['reads']} "
+          f"accept={record['accept']} -> {out_path}", flush=True)
+    return 0 if record["accept"] else 1
+
+
 def main(argv):
+    if len(argv) > 1 and argv[1] == "--routed":
+        return run_routed(argv[2] if len(argv) > 2
+                          else os.path.join(REPO, "DRIFTBENCH_r02.json"))
     graph = argv[1] if len(argv) > 1 else os.path.join(REPO, "data",
                                                        "hep-th.dat")
     out_path = argv[2] if len(argv) > 2 else os.path.join(

@@ -1,0 +1,320 @@
+"""SCRUBBENCH: does anti-entropy catch silent divergence in time? (ISSUE 20)
+
+A routed 2-cluster fleet — two real leader+follower pairs behind a
+``bin/route`` process, one tenant pinned (by the hash ring) to each
+cluster — runs under combined insert+read load.  Mid-run the bench
+flips ONE byte of the loaded follower's live state (the gated CORRUPT
+verb), then keeps driving inserts through the router one record at a
+time so the detection point is measurable in RECORDS, not seconds:
+
+  detect_within_cadence  the follower's stream verifier (VERIFY frames
+                         every SHEEP_SCRUB_VERIFY_N records) quarantines
+                         the replica within one cadence of the flip —
+                         detect_records <= verify_n + 1 (the +1 is the
+                         bench's own poll granularity)
+  zero_divergent_reads   every routed read in the whole run (before,
+                         during and after the episode) matched the
+                         leader's answer for the same probe: the router
+                         kept spreading to healthy members and the
+                         quarantined replica's typed refusal was never
+                         surfaced as data
+  crc_equal_after_heal   the quarantined follower re-synced from the
+                         leader's snapshot and rejoined with an
+                         identical state_crc (the CRC verb, both sides)
+  other_cluster_clean    the second cluster's tenant saw the exact same
+                         load and zero anomalies — divergence in c0
+                         never bled into c1's read path
+  p99_bounded            routed read p99 during the quarantine+heal
+                         window stayed under 2s (the client deadline is
+                         30s): the heal is background work, not a stall
+
+``accept`` is the conjunction; exit 0 iff accept.  The record stores
+per-phase latency quantiles, the detection ledger (corrupt seqno,
+detect seqno, cadence), and the healed-state crc pair.
+
+Usage: python scripts/scrubbench.py [out.json]
+Default out: SCRUBBENCH_r01.json at the repo root.
+Env: SCRUBBENCH_VERIFY_N (default 8), SCRUBBENCH_READS (default 60),
+SCRUBBENCH_SEED (default 23).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from sheep_tpu.io.edges import write_dat  # noqa: E402
+from sheep_tpu.serve.protocol import ServeClient, ServeError, \
+    connect_retry  # noqa: E402
+from sheep_tpu.serve.router import HashRing  # noqa: E402
+from sheep_tpu.utils.envinfo import env_capture  # noqa: E402
+from sheep_tpu.utils.synth import rmat_edges  # noqa: E402
+
+VERIFY_N = int(os.environ.get("SCRUBBENCH_VERIFY_N", "8"))
+READS = int(os.environ.get("SCRUBBENCH_READS", "60"))
+SEED = int(os.environ.get("SCRUBBENCH_SEED", "23"))
+PROBE = list(range(64))  # base-graph vertices: stable answers all run
+
+
+def _addr(d, name="serve.addr", timeout=90.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        try:
+            host, port = open(os.path.join(d, name)).read().split()
+            return host, int(port)
+        except (OSError, ValueError):
+            time.sleep(0.05)
+    raise SystemExit(f"{d}/{name} never appeared")
+
+
+def _wait(cond, timeout_s=90.0, what="condition"):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if cond():
+            return
+        time.sleep(0.05)
+    raise SystemExit(f"timed out waiting for {what}")
+
+
+def _quantile(xs, q):
+    if not xs:
+        return 0.0
+    ys = sorted(xs)
+    return ys[min(len(ys) - 1, int(q * len(ys)))]
+
+
+def _pick_tenants():
+    """Two tenant names the ring pins to different clusters, so BOTH
+    clusters carry load through the one router."""
+    ring = HashRing(["c0", "c1"])
+    by_cluster: dict[str, str] = {}
+    i = 0
+    while len(by_cluster) < 2:
+        name = f"bench{i}"
+        by_cluster.setdefault(ring.lookup(name), name)
+        i += 1
+    return by_cluster["c0"], by_cluster["c1"]
+
+
+def main() -> int:
+    out_path = sys.argv[1] if len(sys.argv) > 1 \
+        else os.path.join(REPO, "SCRUBBENCH_r01.json")
+    work = tempfile.mkdtemp(prefix="scrubbench-")
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    env["SHEEP_SERVE_REPL_HB_S"] = "0.1"
+    env["SHEEP_SERVE_FAILOVER_S"] = "30"
+    env["SHEEP_SCRUB_VERIFY_N"] = str(VERIFY_N)
+    env["SHEEP_SCRUB_ALLOW_CORRUPT"] = "1"
+    # freeze placement so the PART probe has one answer all run: no
+    # drift-triggered repartition, no background re-sequence
+    env["SHEEP_SERVE_DRIFT"] = "9.0"
+    env["SHEEP_RESEQ"] = "0"
+
+    tail, head = rmat_edges(7, 4 << 7, seed=SEED)
+    g = os.path.join(work, "g.dat")
+    write_dat(g, tail, head)
+    t0, t1 = _pick_tenants()
+    tenants = (t0, t1)
+
+    procs = []
+
+    def spawn(mod, d, *args):
+        p = subprocess.Popen([sys.executable, "-m", mod, "-d", d, *args],
+                             env=env, cwd=REPO,
+                             stdout=subprocess.DEVNULL,
+                             stderr=subprocess.DEVNULL)
+        procs.append(p)
+        return p
+
+    record = {
+        "bench": "scrubbench",
+        "rev": 1,
+        "seed": SEED,
+        "verify_n": VERIFY_N,
+        "edges": int(len(tail)),
+        "tenants": {"c0": t0, "c1": t1},
+    }
+    try:
+        dirs = {}
+        for ci, tname in (("c0", t0), ("c1", t1)):
+            ld, fd = os.path.join(work, f"{ci}-lead"), \
+                os.path.join(work, f"{ci}-fol")
+            dirs[ci] = (ld, fd)
+            spawn("sheep_tpu.cli.serve", ld, "-g", g, "-k", "3",
+                  "--role", "leader", "--node-id", f"{ci}L",
+                  "--peers", fd,
+                  "--tenant", f"{tname}={work}/{ci}-lead-t:{g}:3")
+            _addr(ld)
+            spawn("sheep_tpu.cli.serve", fd, "--role", "follower",
+                  "--node-id", f"{ci}F", "--peers", ld,
+                  "--tenant", f"{tname}={work}/{ci}-fol-t")
+            _addr(fd)
+        route_d = os.path.join(work, "route")
+        spawn("sheep_tpu.cli.route", route_d,
+              "--cluster", f"c0@{dirs['c0'][0]},{dirs['c0'][1]}",
+              "--cluster", f"c1@{dirs['c1'][0]},{dirs['c1'][1]}")
+        rh, rp = _addr(route_d, name="router.addr")
+        rc = connect_retry(rh, rp, timeout_s=90)
+
+        # both tenant streams live (leader sees its follower) before load
+        for tname in tenants:
+            def _ready(t=tname):
+                try:
+                    rc.tenant(t)
+                    return rc.kv("STATS").get("followers") == 1
+                except (ServeError, OSError):
+                    return False
+            _wait(_ready, what=f"tenant {tname} replicated")
+
+        # direct (non-routed) handles: the leader gives the probe's
+        # expected answer; the follower is watched for the quarantine
+        c0lh, c0lp = _addr(dirs["c0"][0])
+        c0fh, c0fp = _addr(dirs["c0"][1])
+        lead0 = ServeClient(c0lh, c0lp, timeout_s=30.0)
+        fol0 = ServeClient(c0fh, c0fp, timeout_s=30.0)
+        lead0.tenant(t0)
+        fol0.tenant(t0)
+
+        acked = {t: 0 for t in tenants}
+        lat = {"before": [], "episode": [], "after": []}
+        mismatches = {t: 0 for t in tenants}
+        expected = {}
+
+        def insert_one(tname, i):
+            rc.tenant(tname)
+            rc.insert([(int(tail[i % len(tail)]),
+                        int(head[(i * 7 + 3) % len(head)]))])
+            acked[tname] += 1
+
+        def read_round(phase, n=1):
+            for tname in tenants:
+                rc.tenant(tname)
+                for _ in range(n):
+                    start = time.monotonic()
+                    got = rc.part(PROBE)
+                    lat[phase].append(time.monotonic() - start)
+                    if got != expected[tname]:
+                        mismatches[tname] += 1
+
+        # -- phase 1: warmup + baseline -------------------------------------
+        for i in range(24):
+            for tname in tenants:
+                insert_one(tname, i)
+        for tname in tenants:
+            rc.tenant(tname)
+            expected[tname] = rc.part(PROBE)
+        # the probe's answer must be leader-authoritative, not a fluke
+        assert expected[t0] == lead0.part(PROBE)
+        read_round("before", n=max(1, READS // 2))
+
+        # -- phase 2: flip one byte of the c0 follower's live state ---------
+        _wait(lambda: fol0.kv("STATS")["applied_seqno"] == acked[t0],
+              what="c0 follower caught up")
+        corrupt_seq = acked[t0]
+        bad_crc = fol0.kv("CORRUPT")["crc"]
+        record["corrupt"] = {"seqno": corrupt_seq, "crc": bad_crc}
+
+        # -- phase 3: keep the fleet loaded; count records to detection -----
+        detect_seq = None
+        healed = False
+        for i in range(24, 24 + 6 * VERIFY_N):
+            for tname in tenants:
+                insert_one(tname, i)
+            read_round("episode")
+            st = fol0.kv("STATS")
+            if detect_seq is None and (st.get("diverged")
+                                       or st.get("quarantine_heals")):
+                detect_seq = acked[t0]
+            if st.get("quarantine_heals") and not st.get("diverged"):
+                healed = True
+                break
+        if detect_seq is None:
+            raise SystemExit("divergence never detected")
+        if not healed:
+            _wait(lambda: fol0.kv("STATS").get("quarantine_heals", 0) >= 1
+                  and not fol0.kv("STATS").get("diverged"),
+                  what="quarantine healed")
+        detect_records = detect_seq - corrupt_seq
+
+        # -- phase 4: quiesced equality + steady-state reads ----------------
+        _wait(lambda: fol0.kv("STATS")["applied_seqno"]
+              == lead0.kv("STATS")["applied_seqno"],
+              what="healed follower caught up")
+        lead_crc = lead0.kv("CRC")
+        fol_crc = fol0.kv("CRC")
+        read_round("after", n=max(1, READS // 2))
+
+        fst = fol0.kv("STATS")
+        record["detect"] = {
+            "seqno": detect_seq,
+            "records": detect_records,
+            "cadence": VERIFY_N,
+        }
+        record["heal"] = {
+            "quarantine_heals": fst.get("quarantine_heals", 0),
+            "leader_crc": lead_crc["crc"],
+            "follower_crc": fol_crc["crc"],
+            "follower_seqno": fol_crc["seqno"],
+        }
+        record["acked"] = dict(acked)
+        record["reads"] = {
+            phase: {
+                "n": len(xs),
+                "p50_s": round(_quantile(xs, 0.50), 6),
+                "p99_s": round(_quantile(xs, 0.99), 6),
+            } for phase, xs in lat.items()
+        }
+        record["mismatched_reads"] = dict(mismatches)
+
+        record["detect_within_cadence"] = detect_records <= VERIFY_N + 1
+        record["zero_divergent_reads"] = all(
+            v == 0 for v in mismatches.values())
+        record["crc_equal_after_heal"] = \
+            lead_crc["crc"] == fol_crc["crc"] and bad_crc != lead_crc["crc"]
+        record["other_cluster_clean"] = \
+            mismatches[t1] == 0 and acked[t1] == acked[t0]
+        record["p99_bounded"] = _quantile(lat["episode"], 0.99) <= 2.0
+        record["accept"] = all(record[k] for k in (
+            "detect_within_cadence", "zero_divergent_reads",
+            "crc_equal_after_heal", "other_cluster_clean", "p99_bounded"))
+        record["env"] = env_capture()
+
+        lead0.close()
+        fol0.close()
+        rc.request("QUIT")
+        rc.close()
+    finally:
+        for p in procs:
+            try:
+                p.send_signal(signal.SIGTERM)
+            except OSError:
+                pass
+        for p in procs:
+            try:
+                p.wait(timeout=30)
+            except subprocess.TimeoutExpired:
+                p.kill()
+        shutil.rmtree(work, ignore_errors=True)
+
+    with open(out_path, "w") as f:
+        json.dump(record, f, indent=2, sort_keys=True)
+        f.write("\n")
+    print(f"scrubbench: detect {record['detect']['records']} records "
+          f"(cadence {VERIFY_N}), mismatches {record['mismatched_reads']}, "
+          f"accept={record['accept']} -> {out_path}")
+    return 0 if record["accept"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -615,6 +615,44 @@ then
 fi
 # -------------------------------------------------------------------------
 
+# --- power-law ECV(down) regression gate (quality matrix, 2nd family) ----
+# The second graph family (ISSUE 20 satellite): a deterministic RMAT
+# synthesis — the skewed power-law degree tail the degree sequence is
+# built to exploit — partitioned for every baselined part count and
+# held to data/powerlaw-ecv-baseline.json.  hep-th alone gates one
+# degree distribution; a sequence/build/partition change that only
+# hurts heavy-tailed graphs now fails here instead of shipping.
+if ! env JAX_PLATFORMS=cpu python - <<'EOF'
+import json
+from sheep_tpu.core import build_forest, degree_sequence
+from sheep_tpu.partition.evaluate import evaluate_partition
+from sheep_tpu.partition.partition import Partition
+from sheep_tpu.utils.synth import rmat_edges
+
+spec = json.load(open("data/powerlaw-ecv-baseline.json"))
+gen, base = spec["generator"], spec["ecv_down"]
+tail, head = rmat_edges(gen["log2_nodes"], gen["edges"],
+                        seed=gen["seed"])
+max_vid = int(max(tail.max(), head.max()))
+seq = degree_sequence(tail, head)
+forest = build_forest(tail, head, seq)
+for p_s, ceiling in sorted(base.items(), key=lambda kv: int(kv[0])):
+    p = int(p_s)
+    part = Partition.from_forest(seq, forest, p, max_vid=max_vid)
+    rep = evaluate_partition(part.parts, tail, head, seq, p,
+                             max_vid=max_vid, file_edges=len(tail))
+    assert rep.ecv_down <= ceiling, (
+        f"power-law ECV(down) regressed at p={p}: {rep.ecv_down} > "
+        f"baseline {ceiling}")
+    print(f"power-law p={p}: ECV(down) {rep.ecv_down} <= {ceiling}")
+EOF
+then
+  echo "POWER-LAW ECV GATE FAILED: partition quality regressed past" \
+       "the recorded baseline on the heavy-tailed family" >&2
+  exit 1
+fi
+# -------------------------------------------------------------------------
+
 # --- flight-recorder smoke (observability, ISSUE 10) ---------------------
 # One traced build (SHEEP_TRACE on): the tree must stay oracle-exact, the
 # trace file must fsck clean (sealed sidecar + parseable JSONL), and
@@ -1339,6 +1377,124 @@ EOF
 then
   echo "GROUP-COMMIT SMOKE FAILED: kill -9 mid-group lost an acknowledged" \
        "insert or the shared fsync never amortized" >&2
+  exit 1
+fi
+# -------------------------------------------------------------------------
+
+# --- scrub smoke (anti-entropy + self-healing replicas, ISSUE 20) --------
+# A real routed leader+follower pair: bit-flip the follower's sealed
+# snapshot ON DISK (silent storage rot, not a crash), then drive the
+# scrubber — the rotten artifact must be quarantined (renamed, never
+# loaded) and repaired back to fsck-clean, the follower's state_crc
+# must equal the leader's, and routed reads must answer identically
+# before, during and after the episode (the rot never surfaces as
+# data).  Seconds of work; a regression in the quarantine/repair
+# contract fails the gate before pytest even runs.
+if ! python - <<'EOF'
+import glob, os, signal, subprocess, sys, tempfile, time
+REPO = os.getcwd()
+sys.path.insert(0, REPO)
+from sheep_tpu.io.edges import write_dat
+from sheep_tpu.serve.protocol import ServeClient, ServeError, connect_retry
+from sheep_tpu.utils.synth import rmat_edges
+
+work = tempfile.mkdtemp()
+tail, head = rmat_edges(7, 4 << 7, seed=47)
+write_dat(work + "/g.dat", tail, head)
+lead_d, fol_d, route_d = work + "/lead", work + "/fol", work + "/route"
+env = dict(os.environ)
+env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+env["SHEEP_SERVE_REPL_HB_S"] = "0.1"
+env["SHEEP_SERVE_FAILOVER_S"] = "30"
+env["SHEEP_RESEQ"] = "0"
+env["SHEEP_SERVE_DRIFT"] = "9.0"   # frozen placement: one probe answer
+
+def addr(d, name="serve.addr", timeout=60.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        try:
+            host, port = open(f"{d}/{name}").read().split()
+            return host, int(port)
+        except (OSError, ValueError):
+            time.sleep(0.05)
+    raise SystemExit(f"{d}/{name} never appeared")
+
+def spawn(mod, d, *args):
+    return subprocess.Popen([sys.executable, "-m", mod, "-d", d, *args],
+                            env=env, cwd=REPO)
+
+lead = spawn("sheep_tpu.cli.serve", lead_d, "-g", work + "/g.dat",
+             "-k", "3", "--role", "leader", "--node-id", "lead",
+             "--peers", fol_d)
+addr(lead_d)
+fol = spawn("sheep_tpu.cli.serve", fol_d, "--role", "follower",
+            "--node-id", "fol", "--peers", lead_d)
+fh, fp = addr(fol_d)
+router = spawn("sheep_tpu.cli.route", route_d,
+               "--cluster", f"{lead_d},{fol_d}")
+rh, rp = addr(route_d, name="router.addr")
+rc = connect_retry(rh, rp, timeout_s=60)
+deadline = time.monotonic() + 60
+while time.monotonic() < deadline:
+    try:
+        if rc.kv("STATS").get("followers") == 1:
+            break
+    except ServeError:
+        pass
+    time.sleep(0.1)
+for i in range(6):
+    rc.insert([(int(tail[i]), int(head[(i + 3) % len(head)]))])
+probe = list(range(64))
+expected = rc.part(probe)
+
+# the silent fault: one byte of the follower's sealed snapshot rots
+snaps = sorted(glob.glob(fol_d + "/*.snap"))
+assert snaps, f"no sealed snapshot in {fol_d}"
+with open(snaps[-1], "r+b") as f:
+    f.seek(os.path.getsize(snaps[-1]) // 2)
+    b = f.read(1)
+    f.seek(-1, 1)
+    f.write(bytes([b[0] ^ 0x01]))
+
+fc = connect_retry(fh, fp, timeout_s=60)
+counts = fc.kv("SCRUB")           # the scrubber: quarantine + repair
+assert counts["quarantined"] >= 1, counts
+assert counts["repaired"] >= 1, counts
+assert counts["unrepaired"] == 0, counts
+# routed reads answered identically through the episode
+for _ in range(8):
+    assert rc.part(probe) == expected, "routed read diverged"
+# the quarantined evidence exists and the repaired name fscks clean
+quar = glob.glob(fol_d + "/*.quarantined")
+assert quar, "no quarantined evidence left behind"
+fsck = subprocess.run(
+    [sys.executable, "-m", "sheep_tpu.cli.fsck", "-q", fol_d],
+    env=env, cwd=REPO, capture_output=True)
+assert fsck.returncode == 0, fsck.stdout[-800:] + fsck.stderr[-400:]
+# ... and the healed follower is byte-for-byte the leader's state
+lh, lp = addr(lead_d)
+lc = connect_retry(lh, lp, timeout_s=60)
+deadline = time.monotonic() + 60
+while time.monotonic() < deadline:
+    if fc.kv("STATS")["applied_seqno"] == lc.kv("STATS")["applied_seqno"]:
+        break
+    time.sleep(0.05)
+assert fc.kv("CRC")["crc"] == lc.kv("CRC")["crc"], "state_crc differs"
+for cl in (rc, fc, lc):
+    try:
+        cl.request("QUIT")
+        cl.close()
+    except (ServeError, OSError):
+        pass
+for p in (router, lead, fol):
+    p.send_signal(signal.SIGTERM)
+    p.wait(timeout=60)
+print("scrub smoke ok: snapshot rot quarantined + repaired, crc equal, "
+      "%d routed reads clean" % (8,))
+EOF
+then
+  echo "SCRUB SMOKE FAILED: snapshot rot escaped the scrubber, the" \
+       "repair left the follower divergent, or a routed read saw it" >&2
   exit 1
 fi
 # -------------------------------------------------------------------------

@@ -25,8 +25,13 @@ tenant has paid a group-commit fsync or a lock-free read has retried
 (ISSUE 19) the write-path columns appear: ``FSYN/s`` (shared WAL
 fsyncs per second — the amortization the group commit buys), ``GC50``
 / ``GC99`` (records per shared fsync, p50/p99) and ``SLRT`` (seqlock
-read retries).  An ``instances`` footer shows per-instance
-epoch/lag/RSS from the same scrape.
+read retries).  Anti-entropy (ISSUE 20): a ``DVRG`` column appears
+once any tenant is quarantined (state diverged from the leader; reads
+refused until re-sync), and the instance footer grows
+``SCRUB``/``QUAR``/``REP`` (scrub passes, artifacts quarantined,
+artifacts repaired) once any member has completed a scrub pass.  An
+``instances`` footer shows per-instance epoch/lag/RSS from the same
+scrape.
 
 ``--json`` takes two scrapes ``-i`` seconds apart (default 1.0; 0 =
 single scrape, qps null) and prints one JSON object — what the tier-1
@@ -92,7 +97,7 @@ def fleet_view(samples) -> dict:
             t, {"instances": [], "resident_on": [], "requests": 0.0,
                 "window_p99_ms": None, "applied_seqno": 0,
                 "cluster": None, "mig": None, "mig_lag": None,
-                "seq_drift": None, "reseqs": None,
+                "seq_drift": None, "reseqs": None, "diverged": None,
                 "gc_fsyncs": 0.0, "gc_p50": None, "gc_p99": None,
                 "seqlock_retries": None})
 
@@ -153,6 +158,18 @@ def fleet_view(samples) -> dict:
             rec = tn(labels)
             if rec is not None:
                 rec["mig_lag"] = max(rec["mig_lag"] or 0, int(val))
+        elif name == "sheep_diverged":
+            # anti-entropy (ISSUE 20): any instance reporting the
+            # tenant quarantined marks the whole tenant row
+            rec = tn(labels)
+            if rec is not None:
+                rec["diverged"] = max(rec["diverged"] or 0, int(val))
+        elif name == "sheep_scrub_runs_total" and inst:
+            instances[inst]["scrub_runs"] = int(val)
+        elif name == "sheep_scrub_quarantined_total" and inst:
+            instances[inst]["scrub_quar"] = int(val)
+        elif name == "sheep_scrub_repaired_total" and inst:
+            instances[inst]["scrub_rep"] = int(val)
         elif name == "sheep_serve_seq_drift":
             rec = tn(labels)
             if rec is not None:
@@ -254,6 +271,13 @@ def render_table(view: dict, scrape_bytes: int) -> str:
     # lock-free read has retried — an idle fleet's table is unchanged
     committing = any(rec.get("gc_fsyncs") or rec.get("seqlock_retries")
                      for rec in view["tenants"].values())
+    # anti-entropy columns (ISSUE 20): DVRG appears once any tenant is
+    # quarantined; the instance table's SCRUB/QUAR/REP appear once any
+    # member has completed a scrub pass
+    diverging = any(rec.get("diverged")
+                    for rec in view["tenants"].values())
+    scrubbing = any(rec.get("scrub_runs")
+                    for rec in view["instances"].values())
     head = (f"{'TENANT':<12} {'CLUSTER':<8} {'QPS':>8} {'P99w':>9} "
             f"{'LAG':>5} {'EPOCH':>5} {'RES':>4} {'APPLIED':>9}")
     if migrating:
@@ -262,6 +286,8 @@ def render_table(view: dict, scrape_bytes: int) -> str:
         head += f" {'SDRIFT':>6} {'RESEQ':>5}"
     if committing:
         head += f" {'FSYN/s':>7} {'GC50':>5} {'GC99':>5} {'SLRT':>6}"
+    if diverging:
+        head += f" {'DVRG':>4}"
     lines = [head, "-" * len(head)]
     for t, rec in sorted(view["tenants"].items()):
         p99 = rec.get("window_p99_ms")
@@ -288,17 +314,26 @@ def render_table(view: dict, scrape_bytes: int) -> str:
                     f"{(rec.get('gc_p50') if rec.get('gc_p50') is not None else '-'):>5} "
                     f"{(rec.get('gc_p99') if rec.get('gc_p99') is not None else '-'):>5} "
                     f"{(slr if slr is not None else '-'):>6}")
+        if diverging:
+            row += f" {('YES' if rec.get('diverged') else '-'):>4}"
         lines.append(row)
     lines.append("")
     ihead = (f"{'INSTANCE':<22} {'CLUSTER':<8} {'EPOCH':>5} "
              f"{'LAG':>5} {'RSS':>9}")
+    if scrubbing:
+        ihead += f" {'SCRUB':>5} {'QUAR':>4} {'REP':>4}"
     lines += [ihead, "-" * len(ihead)]
     for inst, rec in sorted(view["instances"].items()):
         rss = rec.get("vmrss_mb")
-        lines.append(
+        irow = (
             f"{inst:<22} {rec.get('cluster') or '?':<8} "
             f"{rec.get('epoch', '-'):>5} {rec.get('repl_lag', '-'):>5} "
             f"{(f'{rss}M' if rss is not None else '-'):>9}")
+        if scrubbing:
+            irow += (f" {rec.get('scrub_runs', '-'):>5} "
+                     f"{rec.get('scrub_quar', '-'):>4} "
+                     f"{rec.get('scrub_rep', '-'):>4}")
+        lines.append(irow)
     if view.get("workers"):
         whead = (f"{'WORKER':<22} {'INFLT':>5} {'DONE':>6} "
                  f"{'SHIPPED':>10} {'RSS':>9}")

@@ -400,7 +400,10 @@ def fsck_paths(paths, mode: str | None = None):
         targets = collect_artifacts(root)
         chain = _manifest_chain_result(root, mode)
         reseq_chain = _reseq_chain_result(root, mode)
-        if not targets and chain is None and reseq_chain is None:
+        scrub_chain = _scrub_chain_result(root, mode)
+        quarantined = _quarantined_results(root, mode)
+        if not targets and chain is None and reseq_chain is None \
+                and scrub_chain is None and not quarantined:
             results.append((root, False, "no artifacts found"))
             continue
         for path in targets:
@@ -413,8 +416,64 @@ def fsck_paths(paths, mode: str | None = None):
             results.append(chain)
         if reseq_chain is not None:
             results.append(reseq_chain)
+        if scrub_chain is not None:
+            results.append(scrub_chain)
+        results.extend(quarantined)
     failures = [r for r in results if not r[1]]
     return results, failures
+
+
+def _scrub_chain_result(root: str, mode: str):
+    """The anti-entropy scrub-history line for a state dir (ISSUE 20),
+    or None when the root is a file / was never scrubbed.  The scrub
+    manifest is hash-chained (serve/scrub.py), so an edited or dropped
+    run record is a failure, not a shrug."""
+    from ..serve import scrub as scrub_mod
+    if not os.path.isdir(root):
+        return None
+    mpath = scrub_mod.scrub_manifest_path(root)
+    if not os.path.exists(mpath):
+        return None
+    try:
+        return (mpath, True, scrub_mod.verify_scrub_chain(root))
+    except (IntegrityError, OSError) as exc:
+        return (mpath, False, str(exc))
+
+
+def _quarantined_results(root: str, mode: str):
+    """The quarantine convention (ISSUE 20): ``*.quarantined`` artifacts
+    are REPORTED, never loaded, and never counted as failures — the
+    scrubber already did the failing; the rename IS the containment.
+    Repair mode re-verifies each one and reclaims (renames back) those
+    whose bytes now check out — the transient-rot case; anything still
+    corrupt stays quarantined.  A dir-level quarantine marker
+    (divergence, not rot) is reported the same way."""
+    from ..serve import scrub as scrub_mod
+    out = []
+    if not os.path.isdir(root):
+        return out
+    marker = scrub_mod.read_quarantine(root) \
+        if os.path.exists(scrub_mod.quarantine_path(root)) else None
+    if marker is not None:
+        out.append((scrub_mod.quarantine_path(root), True,
+                    f"dir quarantined: phase={marker.get('phase', '?')} "
+                    f"reason={marker.get('reason', '?')} — reads "
+                    f"refused until the re-sync clears it"))
+    for qpath in scrub_mod.quarantined_paths(root):
+        if mode == "repair":
+            try:
+                detail = scrub_mod.reclaim_quarantined(qpath)
+                out.append((qpath, True, f"reclaimed: {detail}"))
+                continue
+            except (IntegrityError, OSError) as exc:
+                out.append((qpath, True,
+                            f"quarantined, still corrupt — kept "
+                            f"({exc})"))
+                continue
+        out.append((qpath, True,
+                    "quarantined by the scrubber; never loaded "
+                    "(repair mode re-verifies and reclaims)"))
+    return out
 
 
 def _reseq_chain_result(root: str, mode: str):

@@ -117,6 +117,10 @@ def atomic_write(path: str, mode: str = "wb",
             pre_publish(tmp)
         os.replace(tmp, path)
         _fsync_dir(path)
+        # post-seal silent-corruption seam (ISSUE 20): a `rot@site:nth`
+        # plan entry flips one published byte AFTER the rename — the
+        # artifact lied to no writer, only a re-verification can see it
+        faultfs.rot_after_seal(path)
     except BaseException as exc:
         try:
             f.close()

@@ -11,10 +11,11 @@ Grammar — the I/O sibling of the supervisor's ``SHEEP_FAULT_PLAN``
 
     SHEEP_IO_FAULT_PLAN = entry[,entry...]
     entry               = kind @ site : nth
-    kind                = enospc | eio | short | slow
+    kind                = enospc | eio | short | slow | rot
     site                = tre | seq | dat | net | sidecar | ckpt |
                           wal | snap | hist | manifest | other | *
     nth                 = 0-based index of the write at that site
+                          (for ``rot``: of the SEAL at that site)
 
 e.g. ``SHEEP_IO_FAULT_PLAN=enospc@ckpt:1,short@tre:0``.  Sites are
 artifact CLASSES, derived from the target path (:func:`site_for`) with
@@ -39,6 +40,17 @@ DIFFERENT recovery path:
           never appear under a final name.
   slow    writes stall (default 50ms each, ``:nth`` still selects the
           open): the watchdog/heartbeat shape.  Never fails the write.
+  rot     silent POST-SEAL corruption (ISSUE 20): the write itself
+          succeeds, the sidecar vouches for the published bytes — and
+          then one byte of the artifact flips under its final name, the
+          way a rotting disk or a torn page the kernel never surfaced
+          would.  No error is raised at injection time; ONLY a later
+          re-verification (the scrubber, fsck, the anti-entropy stream)
+          can notice.  Fires from :func:`rot_after_seal` (io/atomic.py
+          calls it after every atomic publish) and counts SEALS per
+          site in its own counter space, so ``rot@snap:0`` means "the
+          first snapshot sealed", independent of how many write-opens
+          the same site saw.
 
 Faults are injected at the Python file layer, byte-for-byte deterministic
 under every runner — no filesystem setup, no privileges, works in CI.
@@ -54,7 +66,11 @@ from dataclasses import dataclass, field
 
 IO_FAULT_PLAN_ENV = "SHEEP_IO_FAULT_PLAN"
 
-KINDS = ("enospc", "eio", "short", "slow")
+KINDS = ("enospc", "eio", "short", "slow", "rot")
+
+#: the kinds that fire on a write-open (everything except ``rot``, which
+#: has its own post-seal channel so write counters never consume it)
+_WRITE_KINDS = ("enospc", "eio", "short", "slow")
 
 #: suffix -> site class (checked in order; .sum first so a tree's sidecar
 #: is "sidecar", not "tre").  ``wal``/``snap`` are the serve daemon's
@@ -106,8 +122,14 @@ class IoFaultPlan:
 
     faults: list[IoFault] = field(default_factory=list)
 
-    def take(self, site: str, index: int) -> str | None:
+    def take(self, site: str, index: int,
+             kinds: tuple | None = None) -> str | None:
+        """Pop-and-return the first entry matching ``(site, index)``;
+        ``kinds`` restricts which entries are eligible (the write channel
+        must never consume a ``rot`` entry and vice versa)."""
         for i, f in enumerate(self.faults):
+            if kinds is not None and f.kind not in kinds:
+                continue
             if f.matches(site, index):
                 del self.faults[i]
                 return f.kind
@@ -139,6 +161,7 @@ def parse_io_fault_plan(spec: str) -> IoFaultPlan:
 _plan: IoFaultPlan | None = None
 _env_spec: str | None = None
 _counters: dict[str, int] = {}
+_rot_counters: dict[str, int] = {}
 
 
 def install_plan(plan: IoFaultPlan | None) -> None:
@@ -147,6 +170,7 @@ def install_plan(plan: IoFaultPlan | None) -> None:
     _plan = plan
     _env_spec = None
     _counters.clear()
+    _rot_counters.clear()
 
 
 def clear_plan() -> None:
@@ -155,6 +179,7 @@ def clear_plan() -> None:
 
 def reset_counters() -> None:
     _counters.clear()
+    _rot_counters.clear()
 
 
 def _active_plan() -> IoFaultPlan | None:
@@ -183,11 +208,44 @@ def arm(path: str) -> str | None:
     plan = _active_plan()
     if plan is None:
         return None
-    kind = plan.take(site, index)
+    kind = plan.take(site, index, kinds=_WRITE_KINDS)
     if kind is not None:
         from ..obs import trace as _obs
         _obs.event("io.fault", site=site, index=index, kind=kind)
     return kind
+
+
+def rot_after_seal(path: str) -> bool:
+    """``rot@site:nth`` — flip one byte of the PUBLISHED artifact at
+    ``path``, leaving its sidecar untouched (module docstring).  Called by
+    io/atomic.py after every atomic publish and by the serve tier's WAL
+    archiver; counts seals per site in its own counter space.  Returns
+    True when a byte flipped."""
+    site = site_for(path)
+    index = _rot_counters.get(site, 0)
+    _rot_counters[site] = index + 1
+    plan = _active_plan()
+    if plan is None:
+        return False
+    if plan.take(site, index, kinds=("rot",)) is None:
+        return False
+    try:
+        size = os.path.getsize(path)
+    except OSError:
+        return False
+    if size == 0:
+        return False
+    off = size // 2
+    with open(path, "r+b") as f:
+        f.seek(off)
+        byte = f.read(1)
+        f.seek(off)
+        f.write(bytes([byte[0] ^ 0x01]))
+        f.flush()
+        os.fsync(f.fileno())
+    from ..obs import trace as _obs
+    _obs.event("io.fault", site=site, index=index, kind="rot")
+    return True
 
 
 def hurt_read(path: str) -> None:

@@ -256,6 +256,11 @@ class FailoverWatcher:
             self.daemon.demote(other[0], int(other[1].get("epoch", 0)))
 
     def _check_failover(self) -> None:
+        # a quarantined node never stands for election (ISSUE 20): its
+        # applied state is KNOWN divergent, so a high applied_seqno is
+        # a lie — promoting it would serve the divergence fleet-wide
+        if getattr(self.daemon.core, "quarantined", False):
+            return
         rep = self.daemon.replicator
         age = rep.stream_age_s() if rep is not None else None
         if age is None:
@@ -271,9 +276,13 @@ class FailoverWatcher:
             top_epoch = max(top_epoch, int(st.get("epoch", 0)))
             if st.get("role") == "leader":
                 return  # a leader lives; discovery will (re)point at it
+        # peers advertising `diverged` in STATS are excluded the same
+        # way — every node filters identically, so the deterministic
+        # rule still picks one winner from the same candidate set
         candidates = [(int(st.get("applied_seqno", 0)),
                        str(st.get("node", st.get("_addr", ""))))
-                      for _, st in alive]
+                      for _, st in alive
+                      if not int(st.get("diverged", 0))]
         candidates.append((self.daemon.core.applied_seqno,
                            self.config.node_id))
         self.elections += 1

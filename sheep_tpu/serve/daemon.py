@@ -76,6 +76,7 @@ from .protocol import (MAX_LINE, BadRequest, err_line, ok_kv, ok_line,
                        parse_kv_args, parse_request, parse_vids,
                        parse_vids_batch)
 from .replicate import ReplicationHub, Replicator, payload_crc
+from .scrub import ALLOW_CORRUPT_ENV
 from .state import PARENT_ABSENT, PARENT_ROOT, ServeCore
 from .tenants import DEFAULT_TENANT, Tenant, TenantManager, UnknownTenant
 
@@ -130,6 +131,9 @@ class ServeConfig:
     #: the adaptive window a non-lone leader may stretch to fill it
     group_commit_max: int = 256
     group_commit_delay_s: float = 0.002
+    #: anti-entropy (ISSUE 20): background artifact-scrub period in
+    #: seconds (0 = off; the SCRUB verb still runs one inline)
+    scrub_interval_s: float = 0.0
     read_only: bool = False
     #: ceiling on how long an injected hang may stall a handler
     hang_cap_s: float = 2.0
@@ -161,6 +165,8 @@ class ServeConfig:
         if os.environ.get(GROUP_COMMIT_DELAY_ENV):
             kw["group_commit_delay_s"] = float(
                 os.environ[GROUP_COMMIT_DELAY_ENV])
+        from .scrub import scrub_interval_s
+        kw["scrub_interval_s"] = scrub_interval_s()
         kw.update(overrides)
         return cls(**kw)
 
@@ -242,7 +248,15 @@ class ServeDaemon:
         self.counters = {"requests": 0, "queries": 0, "inserts": 0,
                          "shed": 0, "timeouts": 0, "readonly": 0,
                          "errors": 0, "faults": 0, "notleader": 0,
-                         "stale": 0, "repl_quorum_fails": 0, "moved": 0}
+                         "stale": 0, "repl_quorum_fails": 0, "moved": 0,
+                         "diverged_reads": 0}
+        # anti-entropy accounting (ISSUE 20): daemon-lifetime scrub
+        # totals, exported via STATS + the sheep_scrub_* gauges
+        self._scrubbing = threading.Lock()
+        self._last_scrub = time.monotonic()
+        self.scrub_totals = {"runs": 0, "checked": 0, "failed": 0,
+                             "quarantined": 0, "repaired": 0,
+                             "unrepaired": 0}
         # flight-recorder metrics (ISSUE 10): per-daemon registry so
         # in-process test clusters never share counters; exported raw
         # over the METRICS verb and summarized into STATS (per-verb
@@ -343,6 +357,10 @@ class ServeDaemon:
             if self.role == "follower":
                 self._start_replicators()
             self.watcher = FailoverWatcher(self, self.cluster).start()
+        # a kill -9 mid-quarantine left a durable marker (ISSUE 20):
+        # restart into the quarantine — reads stay refused, and the
+        # follower stream heals off the marker's recorded phase
+        self._sweep_quarantine()
         # a kill -9 mid-re-sequence left a durable manifest: resume (or
         # cleanly abort) it now, in the background (ISSUE 18)
         self._resume_pending_reseqs()
@@ -605,6 +623,7 @@ class ServeDaemon:
                         self._on_writable(conn)
             self._apply_dirty()
             self._write_status()
+            self._maybe_background_scrub()
         # shutdown: close everything the loop owns
         for conn in list(self._conns.values()):
             self._close_conn(conn)
@@ -925,7 +944,15 @@ class ServeDaemon:
         # the mdelta netfault site so the migration wire sweeps
         # independently of ordinary replication
         site = "mdelta" if kv.get("mig") else "repl"
-        hub.attach(conn, node, from_seqno, site=site)
+        # anti-entropy capability (ISSUE 20): only a follower that said
+        # verify=1 gets VERIFY frames — an old follower's parser never
+        # sees a kind it cannot name, and the leader only pays the
+        # state_crc capture when at least one verifying follower exists
+        verify = bool(kv.get("verify")) and not kv.get("mig")
+        if verify:
+            from .scrub import verify_cadence
+            core.enable_verify(verify_cadence())
+        hub.attach(conn, node, from_seqno, site=site, verify=verify)
         self.config.events.append(("repl_attach", f"{node}:{tname}"
                                    if tname != DEFAULT_TENANT else node))
         return True
@@ -1133,6 +1160,17 @@ class ServeDaemon:
             return err_line("moved", f"dest={tenant.moved_dest}"), False
         core = self.tenants.core_of(tenant.name)
         if verb in ("PART", "PARENT", "SUBTREE", "ECV"):
+            # the quarantine read gate (ISSUE 20): a replica whose state
+            # diverged from the leader's refuses every read with a typed
+            # error until the re-sync proves it crc-equal again — a
+            # wrong answer served fast is still a wrong answer
+            if getattr(core, "quarantined", False):
+                self.counters["diverged_reads"] += 1
+                return err_line(
+                    "diverged",
+                    "replica state diverged from the leader "
+                    "(quarantined); re-sync in progress - read another "
+                    "replica or the leader"), False
             stale = self._check_staleness(tenant)
             if stale is not None:
                 return stale, False
@@ -1173,6 +1211,13 @@ class ServeDaemon:
             return self._stats_line(tenant), False
         if verb == "METRICS":
             return self._metrics_response(), False
+        if verb == "CRC":
+            # the anti-entropy comparison point (ISSUE 20): state_crc at
+            # the applied seqno — O(state) per call, deliberately its
+            # own verb so STATS polling never pays it
+            return ok_kv(crc=core.state_crc(),
+                         seqno=core.applied_seqno,
+                         epoch=core.epoch), False
         if verb == "INSERT":
             if self.role != "leader":
                 self.counters["notleader"] += 1
@@ -1240,6 +1285,37 @@ class ServeDaemon:
                 self._resequencing.release()
             res.pop("plan", None)  # kv lines carry scalars only
             return ok_kv(**res), False
+        if verb == "SCRUB":
+            # the operator's forced anti-entropy pass (ISSUE 20): one
+            # inline scrub over this tenant's sealed artifacts — pricing
+            # skipped (force), one at a time daemon-wide like RESEQ
+            if not core.state_dir:
+                return err_line("unavailable",
+                                "tenant has no state dir to scrub"), False
+            if not self._scrubbing.acquire(blocking=False):
+                return err_line("unavailable",
+                                "a scrub is already running"), False
+            try:
+                counts = self._scrub_tenant(tenant, core)
+            finally:
+                self._scrubbing.release()
+            counts.pop("events", None)  # kv lines carry scalars only
+            return ok_kv(**counts), False
+        if verb == "CORRUPT":
+            # the bench/test divergence injector (ISSUE 20): flip one
+            # byte of LIVE applied state.  Refused unless the operator
+            # opted the daemon in — a production daemon cannot be asked
+            # to corrupt itself over the wire
+            if os.environ.get(ALLOW_CORRUPT_ENV, "") != "1":
+                return err_line(
+                    "unavailable",
+                    f"CORRUPT is a rehearsal verb; set "
+                    f"{ALLOW_CORRUPT_ENV}=1 to enable it"), False
+            try:
+                crc = core.corrupt_one_byte()
+            except RuntimeError as exc:
+                return err_line("unavailable", str(exc)), False
+            return ok_kv(crc=crc, seqno=core.applied_seqno), False
         raise BadRequest(f"unhandled verb {verb!r}")  # unreachable
 
     def _handle_mig(self, req) -> str:
@@ -1397,6 +1473,27 @@ class ServeDaemon:
                       "write")
         slf = m.gauge("sheep_serve_read_seqlock_fallbacks_total",
                       "lock-free reads that fell back to the state lock")
+        # anti-entropy visibility (ISSUE 20): per-tenant quarantine
+        # state plus daemon-lifetime scrub totals — `sheep top`'s
+        # DIVERGED/SCRUB columns and the router's health view read these
+        dvg = m.gauge("sheep_diverged",
+                      "1 = tenant state diverged from the leader "
+                      "(quarantined; reads refused until re-sync)")
+        m.gauge("sheep_scrub_runs_total",
+                "completed anti-entropy scrub passes").set(
+            self.scrub_totals["runs"])
+        m.gauge("sheep_scrub_checked_total",
+                "sealed artifacts re-verified by the scrubber").set(
+            self.scrub_totals["checked"])
+        m.gauge("sheep_scrub_quarantined_total",
+                "artifacts renamed *.quarantined by the scrubber").set(
+            self.scrub_totals["quarantined"])
+        m.gauge("sheep_scrub_repaired_total",
+                "quarantined artifacts repaired back under their real "
+                "name").set(self.scrub_totals["repaired"])
+        m.gauge("sheep_scrub_unrepaired_total",
+                "quarantined artifacts with no surviving repair input"
+                ).set(self.scrub_totals["unrepaired"])
         for name in self.tenants.names():
             t = self.tenants.get(name)
             res.labels(tenant=name).set(int(t.resident))
@@ -1413,6 +1510,8 @@ class ServeDaemon:
                     t.core._gc_size_quantile(0.99))
                 slr.labels(tenant=name).set(t.core.seqlock_retries)
                 slf.labels(tenant=name).set(t.core.seqlock_fallbacks)
+                dvg.labels(tenant=name).set(
+                    int(getattr(t.core, "quarantined", False)))
             evg.labels(tenant=name).set(t.evictions)
             rsg.labels(tenant=name).set(t.restores)
             if t.mig is not None:
@@ -1477,6 +1576,15 @@ class ServeDaemon:
         rec["role"] = self.role
         rec["node"] = self.node_id
         rec["leader"] = self.leader_addr()
+        # anti-entropy health (ISSUE 20): the router's read spread and
+        # the election candidate filter both key on `diverged`
+        rec["diverged"] = int(getattr(core, "quarantined", False))
+        rec["scrub_runs"] = self.scrub_totals["runs"]
+        rec["scrub_quarantined"] = self.scrub_totals["quarantined"]
+        rec["scrub_repaired"] = self.scrub_totals["repaired"]
+        rep = tenant.replicator
+        if rep is not None and rep.quarantine_heals:
+            rec["quarantine_heals"] = rep.quarantine_heals
         if self.role == "leader":
             hub = tenant.hub if tenant.hub is not None else self.hub
             lags = hub.lag_report()
@@ -1550,6 +1658,9 @@ class ServeDaemon:
             "applied_seqno": core.applied_seqno,
             "leader": self.leader_addr(),
             "peers": list(self.cluster.peers),
+            "diverged": int(getattr(core, "quarantined", False)),
+            "scrub_runs": self.scrub_totals["runs"],
+            "scrub_repaired": self.scrub_totals["repaired"],
         }
         if self.role == "leader":
             out["followers"] = self.hub.lag_report()
@@ -1678,3 +1789,96 @@ class ServeDaemon:
 
         threading.Thread(target=work, daemon=True,
                          name="serve-reseq-resume").start()
+
+    # -- anti-entropy (ISSUE 20) -------------------------------------------
+
+    def _sweep_quarantine(self) -> None:
+        """Startup sweep: a durable quarantine marker in any tenant's
+        state dir means a kill -9 interrupted a divergence heal — the
+        restarted daemon re-enters the quarantine (reads refused) and
+        lets that tenant's follower stream resume the heal off the
+        marker's phase."""
+        from . import scrub as scrub_mod
+        for name in self.tenants.names():
+            t = self.tenants.get(name)
+            if t.core is None or not t.core.state_dir:
+                continue
+            marker = scrub_mod.read_quarantine(t.core.state_dir)
+            if marker is not None:
+                t.core.quarantined = True
+                self.config.events.append(
+                    ("quarantine_resumed", name,
+                     marker.get("phase", "?")))
+
+    def _scrub_source(self, tenant: Tenant) -> tuple[str, int] | None:
+        """Where a scrub may fetch a clean snapshot from: a follower's
+        connected leader; a leader repairs from its own live core."""
+        rep = tenant.replicator
+        if rep is not None and rep.connected_to is not None:
+            return rep.connected_to
+        return None
+
+    def _scrub_tenant(self, tenant: Tenant, core: ServeCore) -> dict:
+        """One scrub pass over one tenant (caller holds _scrubbing).
+        A quarantined core's state is suspect, so it never reseals its
+        own snapshots — repairs come from the leader instead."""
+        from . import scrub as scrub_mod
+        counts = scrub_mod.run_scrub(
+            core.state_dir,
+            core=None if getattr(core, "quarantined", False) else core,
+            leader=self._scrub_source(tenant), tenant=tenant.name)
+        self.scrub_totals["runs"] += 1
+        for k in ("checked", "failed", "quarantined", "repaired",
+                  "unrepaired"):
+            self.scrub_totals[k] += counts.get(k, 0)
+        if counts.get("failed"):
+            self.config.events.append(
+                ("scrub", tenant.name, counts["failed"],
+                 counts["repaired"]))
+        return counts
+
+    def _maybe_background_scrub(self) -> None:
+        """Kick the paced background scrub when its interval elapses —
+        one at a time daemon-wide, priced by plan_scrub so a pass that
+        cannot amortize inside its horizon declines (the same GO/STAY
+        discipline as the reseq job)."""
+        interval = self.config.scrub_interval_s
+        if interval <= 0:
+            return
+        now = time.monotonic()
+        if now - self._last_scrub < interval:
+            return
+        if not self._scrubbing.acquire(blocking=False):
+            return
+        self._last_scrub = now
+
+        def work():
+            from . import scrub as scrub_mod
+            from ..plan.model import plan_scrub
+            try:
+                for name in self.tenants.names():
+                    t = self.tenants.get(name)
+                    core = t.core
+                    if core is None or not core.state_dir:
+                        continue
+                    paths = scrub_mod.sealed_artifacts(core.state_dir)
+                    total = 0
+                    for p in paths:
+                        try:
+                            total += os.path.getsize(p)
+                        except OSError:
+                            pass
+                    plan = plan_scrub(len(paths), total)
+                    if plan["decision"] != "go":
+                        self.config.events.append(
+                            ("scrub_declined", name, plan["reason"]))
+                        continue
+                    self._scrub_tenant(t, core)
+            except Exception as exc:
+                # scrubbing is maintenance; it never hurts serving
+                self.config.events.append(("scrub_error", str(exc)))
+            finally:
+                self._scrubbing.release()
+
+        threading.Thread(target=work, daemon=True,
+                         name="serve-scrub").start()

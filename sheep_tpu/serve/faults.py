@@ -60,6 +60,25 @@ checkpoint boundaries: ``SHEEP_FAULT_PLAN``'s ``ext-boundary`` site):
               in-memory state is new, the disk is old: a kill here
               restarts on the OLD generation and resumes the rebuild
 
+The anti-entropy machinery (ISSUE 20, serve/scrub.py +
+replicate._heal_quarantine) adds its phase boundaries — the quarantine
+marker is durable BEFORE each fires, so a kill at any of them restarts
+into the same phase, reads stay refused throughout, and divergent data
+is never served:
+
+  quar-enter   right after the durable quarantine marker lands on a
+               VERIFY mismatch (phase "diverged")
+  quar-resync  after the marker advances to phase "resync", before the
+               leader snapshot fetch — a kill re-fetches idempotently
+  quar-verify  after the adopted state is in place and the marker
+               records the rejoin crc (phase "verify"), before the
+               durable clear — a kill re-runs the (idempotent) re-sync
+  quar-clear   after the marker is cleared and reads are re-admitted
+  scrub-quar   right after the artifact scrubber renames a failed
+               artifact to ``*.quarantined`` (before its repair)
+  scrub-repair after a successful repair publishes, before the scrub
+               manifest records it
+
 Kinds:
 
   kill    the daemon dies instantly (``os._exit(137)`` — no atexit, no
@@ -86,6 +105,8 @@ SERVE_FAULT_PLAN_ENV = "SHEEP_SERVE_FAULT_PLAN"
 KINDS = ("kill", "hang", "slow")
 SITES = ("req", "query", "insert", "gc-append", "gc-unsynced", "wal",
          "apply", "reseq-hist", "reseq-fold", "reseq-swap", "reseq-seal",
+         "quar-enter", "quar-resync", "quar-verify", "quar-clear",
+         "scrub-quar", "scrub-repair",
          "*")
 
 #: how long a "slow" fault stalls while holding its slot

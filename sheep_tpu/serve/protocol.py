@@ -123,9 +123,11 @@ import time
 from dataclasses import dataclass, field
 
 #: verbs that read state (admission kind "query"); TENANT is the
-#: connection-scoped selector (ISSUE 11) and never holds a slot
+#: connection-scoped selector (ISSUE 11) and never holds a slot.  CRC
+#: (ISSUE 20) answers the tenant's state_crc at its applied seqno — the
+#: anti-entropy comparison point benches and smokes key divergence on
 QUERY_VERBS = ("PART", "PARENT", "SUBTREE", "ECV", "STATS", "METRICS",
-               "PING", "TENANT")
+               "PING", "TENANT", "CRC")
 #: verbs that mutate state (admission kind "insert", shed first)
 INSERT_VERBS = ("INSERT",)
 #: operator verbs (admitted as queries; SNAPSHOT/REPARTITION do their own
@@ -133,8 +135,12 @@ INSERT_VERBS = ("INSERT",)
 #: (ISSUE 17) is the daemon-side migration surface the router's MIGRATE
 #: verb drives: ``MIG ADOPT|SEAL|UNSEAL|CUT|DROP|STAT <tenant> [k=v...]``.
 #: RESEQ (ISSUE 18) forces the crash-safe re-sequence rebuild the
-#: sequence-drift detector would otherwise trigger on its own
-ADMIN_VERBS = ("SNAPSHOT", "REPARTITION", "RESEQ", "EVICT", "MIG", "QUIT")
+#: sequence-drift detector would otherwise trigger on its own.  SCRUB
+#: (ISSUE 20) forces one inline anti-entropy pass over the tenant's
+#: sealed artifacts; CORRUPT flips one live byte (refused unless
+#: SHEEP_SCRUB_ALLOW_CORRUPT=1 — a bench/test-only divergence injector)
+ADMIN_VERBS = ("SNAPSHOT", "REPARTITION", "RESEQ", "SCRUB", "CORRUPT",
+               "EVICT", "MIG", "QUIT")
 #: the replication family (serve/replicate.py): handled OUTSIDE admission
 #: — a configured replica is cluster plumbing, not client load, and
 #: shedding it would turn an overload into a lag spiral

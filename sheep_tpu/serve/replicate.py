@@ -68,6 +68,15 @@ class ResyncRequired(ReplProtocolError):
     existing reconnect path already handles it."""
 
 
+class Diverged(ReplProtocolError):
+    """A VERIFY frame proved this follower's applied state differs from
+    the leader's at the same seqno (ISSUE 20): silently corrupted state
+    the seqno chain can never catch.  The applier has already entered
+    the durable quarantine (serve/scrub.py) before raising; subclassing
+    ReplProtocolError tears the stream through the existing reconnect
+    path, where the Replicator's quarantine re-sync takes over."""
+
+
 # -- frame codec ------------------------------------------------------------
 
 
@@ -110,19 +119,37 @@ def encode_ping(epoch: int, seqno: int) -> str:
     return f"REPL PING epoch={epoch} seqno={seqno}"
 
 
+def encode_verify(epoch: int, seqno: int, crc: int) -> str:
+    """The anti-entropy checkpoint (ISSUE 20): "my state_crc at applied
+    seqno ``seqno`` was ``crc``".  Stamped in-stream right after the
+    APPEND it names, so a follower at the same position compares
+    directly.  Only sent on streams whose HELLO advertised ``verify=1``
+    — an old follower never sees the frame (forward compat by
+    capability, not by tolerance)."""
+    return f"REPL VERIFY epoch={epoch} seqno={seqno} crc={crc}"
+
+
 def encode_hello(node: str, epoch: int, seqno: int, sig: str,
-                 tenant: str | None = None, mig: bool = False) -> str:
+                 tenant: str | None = None, mig: bool = False,
+                 verify: bool = False) -> str:
     """The stream handshake; ``tenant`` names a non-default tenant's
     stream (ISSUE 11) and is omitted otherwise so the single-tenant
     handshake stays byte-identical to PR 7.  ``mig=1`` (ISSUE 17) marks
     a MIGRATION delta stream: the leader files its APPENDs under the
     ``mdelta`` netfault site instead of ``repl`` so the migration wire
-    is chaos-sweepable independently of ordinary replication."""
+    is chaos-sweepable independently of ordinary replication.
+    ``verify=1`` (ISSUE 20) advertises that this follower understands
+    VERIFY anti-entropy frames; a leader that predates them ignores the
+    unknown token (kv grammar), a new leader only stamps VERIFY on
+    streams that asked — either mixed-version pairing degrades to plain
+    PR-7 replication, never to a NACK storm."""
     line = f"REPL HELLO node={node} epoch={epoch} seqno={seqno} sig={sig}"
     if tenant is not None and tenant != "default":
         line += f" tenant={tenant}"
     if mig:
         line += " mig=1"
+    if verify:
+        line += " verify=1"
     return line
 
 
@@ -204,6 +231,17 @@ def parse_frame(line: str) -> ReplFrame:
         for field in ("epoch", "seqno", "gen", "sig"):
             if field not in kv:
                 raise ReplProtocolError(f"RESEQ frame missing {field}=")
+    elif kind == "VERIFY":
+        for field in ("epoch", "seqno", "crc"):
+            if field not in kv:
+                raise ReplProtocolError(f"VERIFY frame missing {field}=")
+        try:
+            if int(kv["crc"]) < 0:
+                raise ValueError
+        except ValueError:
+            raise ReplProtocolError(
+                f"VERIFY frame crc={kv['crc']!r} is not a non-negative "
+                f"integer")
     elif kind in ("HELLO", "FENCED", "SNAPSHOT"):
         pass
     else:
@@ -235,12 +273,17 @@ class ReplApplier:
     tests/test_replicate.py, mirroring the PR-6 torn-WAL sweep).
     """
 
-    def __init__(self, core: ServeCore, send, on_epoch=None):
+    def __init__(self, core: ServeCore, send, on_epoch=None,
+                 on_diverged=None):
         self.core = core
         self._send = send
         #: adopt a later leader epoch (default: seal the boundary
         #: locally via core.advance_epoch)
         self._on_epoch = on_epoch or core.advance_epoch
+        #: divergence hook (ISSUE 20): called with (seqno, want_crc,
+        #: got_crc) AFTER the durable quarantine is entered, BEFORE the
+        #: stream tears — the daemon bumps counters/events off it
+        self.on_diverged = on_diverged
         self._buf = bytearray()
         self.leader_seqno = core.applied_seqno
         self.last_frame_t: float | None = None
@@ -248,6 +291,8 @@ class ReplApplier:
         self.dups = 0
         self.gaps = 0
         self.frame_errors = 0
+        self.verifies = 0   # VERIFY checkpoints compared (ISSUE 20)
+        self.diverged = 0   # ... of which mismatched -> quarantine
         self.resyncs_required = 0  # generation breaks (ISSUE 18)
         self.bursts = 0  # sealed APPEND bursts (one fsync + one ACK each)
         self._unsynced = False  # applied-but-unsynced records in the WAL
@@ -326,7 +371,7 @@ class ReplApplier:
             self.frame_errors += 1
             self._send(encode_nack(self.core.applied_seqno + 1))
             return
-        if frame.kind not in ("APPEND", "PING", "RESEQ"):
+        if frame.kind not in ("APPEND", "PING", "RESEQ", "VERIFY"):
             return  # HELLO responses etc. are the Replicator's business
         epoch = frame.epoch()
         if epoch < self.core.epoch:
@@ -355,6 +400,38 @@ class ReplApplier:
                 f"leader re-sequenced to generation {gen} (sig "
                 f"{frame.kv['sig'][:12]}...); this follower is at "
                 f"{self.core.seq_gen} — snapshot adoption required")
+        if frame.kind == "VERIFY":
+            # anti-entropy checkpoint (ISSUE 20): the leader's state_crc
+            # at exactly this applied seqno.  Comparable only when we
+            # are AT that seqno — after a NACK rewind the leader
+            # re-streams records we already hold, and the re-sent VERIFY
+            # lands while applied_seqno is ahead; skip it, the next
+            # in-position point compares.  The burst seals first so the
+            # crc names a durable state.
+            self._seal_burst()
+            if self.core.applied_seqno != frame.seqno():
+                return
+            want = int(frame.kv["crc"])
+            got = self.core.state_crc()
+            self.verifies += 1
+            if got == want:
+                self._send(encode_ack(self.core.applied_seqno))
+                return
+            self.diverged += 1
+            from . import scrub
+            if self.core.state_dir:
+                scrub.enter_quarantine(
+                    self.core.state_dir, reason="stream-verify",
+                    seqno=frame.seqno(), epoch=frame.epoch(),
+                    expect_crc=want, got_crc=got)
+            self.core.quarantined = True
+            self.core._fire("quar-enter")
+            if self.on_diverged is not None:
+                self.on_diverged(frame.seqno(), want, got)
+            raise Diverged(
+                f"state_crc {got:#010x} != leader's {want:#010x} at "
+                f"seqno {frame.seqno()} — quarantined; snapshot re-sync "
+                f"required")
         if frame.kind == "APPEND":
             gen = int(frame.kv.get("gen", 0))
             if gen != self.core.seq_gen:
@@ -403,10 +480,10 @@ class ReplApplier:
 
 class _FollowerState:
     __slots__ = ("conn", "node", "acked", "next_send", "last_ack_t",
-                 "attached_at", "alive", "thread", "site")
+                 "attached_at", "alive", "thread", "site", "verify")
 
     def __init__(self, conn, node: str, next_send: int,
-                 site: str = "repl"):
+                 site: str = "repl", verify: bool = False):
         self.conn = conn
         self.node = node
         self.acked = 0
@@ -416,6 +493,7 @@ class _FollowerState:
         self.alive = True
         self.thread: threading.Thread | None = None
         self.site = site  # netfault site for APPENDs (mdelta: migration)
+        self.verify = verify  # HELLO advertised verify=1 (ISSUE 20)
 
 
 class ReplicationHub:
@@ -443,14 +521,18 @@ class ReplicationHub:
     # -- membership --------------------------------------------------------
 
     def attach(self, conn, node: str, from_seqno: int,
-               site: str = "repl") -> None:
+               site: str = "repl", verify: bool = False) -> None:
         """Register one follower stream starting after ``from_seqno``
         and spawn its sender.  The caller (daemon) already decided
         stream-vs-snapshot; a sender that later finds the WAL moved past
         its position closes the connection so the follower re-HELLOs.
         ``site`` names the netfault site its APPENDs arm ("mdelta" for a
-        migration delta stream, ISSUE 17)."""
-        fs = _FollowerState(conn, node, from_seqno + 1, site=site)
+        migration delta stream, ISSUE 17).  ``verify`` marks a stream
+        whose HELLO advertised VERIFY capability (ISSUE 20): its sender
+        stamps anti-entropy checkpoints; the caller is responsible for
+        core.enable_verify so the capture ring is live."""
+        fs = _FollowerState(conn, node, from_seqno + 1, site=site,
+                            verify=verify)
         fs.acked = from_seqno
         with self._cv:
             self._followers[id(conn)] = fs
@@ -563,6 +645,19 @@ class ReplicationHub:
                 if not self._transmit(fs, line, fs.site):
                     self.detach(fs.conn)
                     return
+                if fs.verify:
+                    # stamp the anti-entropy checkpoint right AFTER the
+                    # APPEND it names (ISSUE 20): the follower compares
+                    # at exactly this applied position.  verify_crc is
+                    # only non-None at captured verify points, so the
+                    # common record ships nothing extra.
+                    vcrc = self.core.verify_crc(seqno)
+                    if vcrc is not None:
+                        vline = encode_verify(self.core.epoch, seqno,
+                                              vcrc)
+                        if not self._transmit(fs, vline, fs.site):
+                            self.detach(fs.conn)
+                            return
                 fs.next_send = seqno + 1
                 sent_any = True
             if sent_any:
@@ -743,12 +838,18 @@ class Replicator:
     def __init__(self, core: ServeCore, node_id: str, discover,
                  hb_s: float = DEFAULT_HB_S, retry_s: float = 0.2,
                  events: list | None = None, tenant: str | None = None,
-                 mig: bool = False):
+                 mig: bool = False, verify: bool = True,
+                 on_diverged=None):
         self.core = core
         self.node_id = node_id
         self.discover = discover
         self.tenant = tenant  # None/"default": the PR-7 handshake bytes
         self.mig = mig        # migration delta stream (mdelta site)
+        #: advertise VERIFY capability in HELLO (ISSUE 20; migration
+        #: delta streams replay into a build-side core mid-cutover, so
+        #: they stay on the plain PR-7 handshake)
+        self.verify = verify and not mig
+        self.on_diverged = on_diverged
         self.hb_s = hb_s
         self.retry_s = retry_s
         self.events = events if events is not None else []
@@ -758,6 +859,7 @@ class Replicator:
         self.connected_to: tuple[str, int] | None = None
         self.last_frame_t: float | None = None
         self.resyncs = 0
+        self.quarantine_heals = 0
 
     @property
     def lag(self) -> int:
@@ -803,13 +905,24 @@ class Replicator:
 
     def _stream_once(self, target: tuple[str, int]) -> None:
         host, port = target
+        from . import scrub
+        if self.core.quarantined or (
+                self.core.state_dir
+                and scrub.read_quarantine(self.core.state_dir)
+                is not None):
+            # quarantine healing takes priority over streaming: the
+            # durable marker survives any kill, so every restart lands
+            # back here until the re-sync completes and clears it
+            self._heal_quarantine(target)
+            return  # reconnect streams normally from the adopted boundary
         with socket.create_connection((host, port),
                                       timeout=max(1.0, 3 * self.hb_s)) \
                 as sock:
             rf = sock.makefile("rb")
             hello = encode_hello(self.node_id, self.core.epoch,
                                  self.core.applied_seqno, self.core.sig,
-                                 tenant=self.tenant, mig=self.mig)
+                                 tenant=self.tenant, mig=self.mig,
+                                 verify=self.verify)
             sock.sendall((hello + "\n").encode("ascii"))
             line = rf.readline().decode("ascii").strip()
             toks = line.split()
@@ -871,7 +984,8 @@ class Replicator:
             def send_up(text: str) -> None:
                 sock.sendall((text + "\n").encode("ascii"))
 
-            applier = ReplApplier(self.core, send_up)
+            applier = ReplApplier(self.core, send_up,
+                                  on_diverged=self.on_diverged)
             self.applier = applier
             sock.settimeout(max(0.2, 3 * self.hb_s))
             while not self._stop.is_set():
@@ -883,6 +997,70 @@ class Replicator:
                     return  # leader went away: rediscover + reconnect
                 applier.feed(data)
                 self.last_frame_t = time.monotonic()
+
+    def _heal_quarantine(self, target: tuple[str, int]) -> None:
+        """The quarantine re-sync (ISSUE 20): this replica's state
+        proved divergent, so stream resumption is forbidden — fetch the
+        leader's snapshot and adopt it WHOLE, rolling back any divergent
+        tail (the leader re-streams every acked record past the
+        snapshot boundary on reconnect).  Phase machine, each phase
+        durable in the quarantine marker BEFORE its work starts::
+
+            diverged -> resync -> verify -> (cleared)
+
+        kill -9 anywhere re-enters here on restart (the marker survives;
+        reads stay refused throughout) and every step is idempotent — a
+        re-fetch is just a fresh snapshot.  The ``quar-resync`` /
+        ``quar-verify`` / ``quar-clear`` serve-fault sites make each
+        boundary deterministically killable."""
+        from . import scrub
+        core = self.core
+        host, port = target
+        core.quarantined = True  # restart path: marker seen before flag
+        scrub.mark_phase(core.state_dir, scrub.PHASE_RESYNC)
+        core._fire("quar-resync")
+        blob, seqno, epoch, sig = fetch_snapshot(
+            host, port, timeout_s=max(5.0, 10 * self.hb_s),
+            tenant=self.tenant)
+        tmp = os.path.join(core.state_dir, "resync.fetch")
+        with open(tmp, "wb") as f:
+            f.write(blob)
+            f.flush()
+            os.fsync(f.fileno())
+        try:
+            snap = load_serve_snapshot(tmp, integrity="trust")
+            if snap.sig != core.sig and snap.seq_gen > core.seq_gen:
+                # the leader ALSO re-sequenced while we were dark:
+                # sanction the generation adoption exactly like the
+                # ordinary snapshot-mode path
+                from . import reseq as reseq_mod
+                reseq_mod.write_adoption(
+                    core.state_dir, core.sig, core.seq_gen,
+                    snap.sig, snap.seq_gen)
+                core.reset_from_snapshot(snap, allow_sig_change=True,
+                                         allow_rollback=True)
+                reseq_mod.finish_adoption(core.state_dir, snap.sig,
+                                          snap.seq_gen)
+            else:
+                core.reset_from_snapshot(snap, allow_rollback=True)
+        finally:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+        # the adopted state IS the leader's sealed snapshot (crc-checked
+        # in flight, resealed locally): record the crc we rejoined at —
+        # the next in-stream VERIFY point is the live rejoin proof
+        scrub.mark_phase(core.state_dir, scrub.PHASE_VERIFY,
+                         crc=core.state_crc(), seqno=core.applied_seqno)
+        core._fire("quar-verify")
+        scrub.clear_quarantine(core.state_dir)
+        core.quarantined = False
+        core._fire("quar-clear")
+        self.resyncs += 1
+        self.quarantine_heals += 1
+        self.events.append(("quarantine_healed", core.applied_seqno,
+                            core.state_crc()))
 
     def _adopt_across_badrepl(self, host: str, port: int) -> bool:
         """The snapshot-adoption exit for a ``badrepl`` refusal: this

@@ -172,6 +172,11 @@ class _Cluster:
     """One serve cluster as the router sees it: peer specs, a cached
     leader, and a read-spread cursor."""
 
+    #: how long an ``ERR diverged`` keeps a member out of the read
+    #: spread before it is re-tried (heals are snapshot-sized; the
+    #: member itself re-admits reads the moment its quarantine clears)
+    DIVERGED_TTL_S = 5.0
+
     def __init__(self, cid: str, peers: list[str],
                  poll_timeout_s: float = 2.0):
         self.cid = cid
@@ -180,6 +185,7 @@ class _Cluster:
         self._leader: tuple[str, int] | None = None
         self._rr = 0
         self._lock = threading.Lock()
+        self._diverged: dict[tuple[str, int], float] = {}
 
     def nodes(self) -> list[tuple[str, int]]:
         out = []
@@ -216,17 +222,34 @@ class _Cluster:
         with self._lock:
             self._leader = None
 
+    def mark_diverged(self, addr: tuple[str, int]) -> None:
+        """A member answered ``ERR diverged`` (ISSUE 20): keep it out
+        of the read spread until the TTL lapses — every read it would
+        get is a guaranteed refusal while it re-syncs."""
+        with self._lock:
+            self._diverged[addr] = time.monotonic() + self.DIVERGED_TTL_S
+
     def read_targets(self) -> list[tuple[str, int]]:
         """Cluster members, rotated one step per call — the read spread
         across followers AND leader; retries walk the rest of the
-        list."""
+        list.  Members marked diverged are pushed to the BACK, not
+        dropped: if every healthy member is unreachable they are still
+        a typed answer, and their refusal re-confirms the mark."""
         nodes = self.nodes()
         if not nodes:
             return []
+        now = time.monotonic()
         with self._lock:
+            self._diverged = {a: t for a, t in self._diverged.items()
+                              if t > now}
+            bad = set(self._diverged)
             self._rr = (self._rr + 1) % len(nodes)
             k = self._rr
-        return nodes[k:] + nodes[:k]
+        rotated = nodes[k:] + nodes[:k]
+        if not bad:
+            return rotated
+        return ([a for a in rotated if a not in bad]
+                + [a for a in rotated if a in bad])
 
 
 class Router:
@@ -263,7 +286,7 @@ class Router:
                          "writes": 0, "retries": 0, "reroutes": 0,
                          "errors": 0, "insert_unknown": 0,
                          "scrapes": 0, "scrape_errors": 0,
-                         "moved_reroutes": 0}
+                         "moved_reroutes": 0, "diverged_skips": 0}
         # the router's own registry (ISSUE 12): its counters + process
         # self-accounting ride the fleet scrape like any member's
         self.metrics = Registry()
@@ -819,6 +842,15 @@ class Router:
                 if resp.startswith("ERR stale") and is_read:
                     last_err = "stale replica"
                     continue  # typed, unanswered: next replica
+                if resp.startswith("ERR diverged") and is_read:
+                    # the quarantine refusal (ISSUE 20): typed and
+                    # unanswered like stale, but ALSO remembered — the
+                    # member refuses every read until its re-sync
+                    # completes, so the spread stops offering it reads
+                    self.counters["diverged_skips"] += 1
+                    cluster.mark_diverged(addr)
+                    last_err = "diverged replica (quarantined)"
+                    continue
                 if resp.startswith(("ERR fenced", "ERR unavailable")):
                     # surface typed (an INSERT here is durable-but-
                     # unacked territory: the client decides), but make

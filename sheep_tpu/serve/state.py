@@ -515,6 +515,20 @@ class ServeCore:
         self._version = 0
         self.seqlock_retries = 0
         self.seqlock_fallbacks = 0
+        # -- anti-entropy (ISSUE 20): with a verify-capable follower
+        # attached, the leader captures state_crc at every verify_n-th
+        # applied seqno INSIDE the apply critical section (the crc names
+        # exactly that seqno's state — RLock makes state_crc re-entrant
+        # here) into a small ring the replication hub stamps VERIFY
+        # frames from.  0 = off: a leader with no verify-capable
+        # follower pays nothing.
+        self.verify_n = 0
+        self._verify_crcs: dict[int, int] = {}
+        self.verify_points = 0
+        # mirrors the durable quarantine marker (serve/scrub.py): True
+        # refuses reads typed (`ERR diverged`) until the snapshot
+        # re-sync re-verifies and durably clears it
+        self.quarantined = False
         self._load_snapshot(snap)
 
     def _load_snapshot(self, snap: ServeSnapshot) -> None:
@@ -1099,6 +1113,52 @@ class ServeCore:
                 crc = zlib.crc32(np.ascontiguousarray(arr).tobytes(), crc)
             return crc & 0xFFFFFFFF
 
+    # -- anti-entropy (ISSUE 20) ------------------------------------------
+
+    def enable_verify(self, every_n: int) -> None:
+        """Start (or retune) verify-point capture: every ``every_n``-th
+        applied seqno gets its state_crc recorded for VERIFY stamping.
+        Called by the hub when a verify-capable follower attaches."""
+        with self._lock:
+            self.verify_n = max(0, int(every_n))
+            if not self.verify_n:
+                self._verify_crcs.clear()
+
+    def _capture_verify(self, seqno: int) -> None:
+        """Under the state lock, right after ``applied_seqno`` advanced:
+        record the crc that names this exact seqno's state."""
+        n = self.verify_n
+        if not n or seqno % n:
+            return
+        self._verify_crcs[seqno] = self.state_crc()
+        self.verify_points += 1
+        while len(self._verify_crcs) > 32:
+            self._verify_crcs.pop(next(iter(self._verify_crcs)))
+
+    def verify_crc(self, seqno: int) -> int | None:
+        """The captured verify-point crc for ``seqno`` (None when the
+        seqno is not a verify point or fell out of the ring)."""
+        with self._lock:
+            return self._verify_crcs.get(seqno)
+
+    def corrupt_one_byte(self) -> int:
+        """TEST/BENCH ONLY (the daemon gates the CORRUPT verb behind
+        ``SHEEP_SCRUB_ALLOW_CORRUPT=1``): flip one bit of an inserted-edge
+        endpoint in the live serving state — the silent-corruption shape
+        the anti-entropy stream exists to catch.  state_crc changes; the
+        WAL and snapshots do not (nothing was written), so only stream
+        VERIFY can see it.  Returns the new state_crc.  Raises
+        RuntimeError when there are no inserted edges to corrupt."""
+        with self._lock:
+            if not self.ins_head:
+                raise RuntimeError("corrupt_one_byte: no inserted edges")
+            self._mut_begin()
+            try:
+                self.ins_head[-1] = int(self.ins_head[-1]) ^ 0x1
+            finally:
+                self._mut_end()
+            return self.state_crc()
+
     def ecv(self) -> dict:
         """Exact ECV(down) over (original + inserted) edges under the
         CURRENT partition, plus the drift accounting.  Raises
@@ -1218,6 +1278,7 @@ class ServeCore:
             seqno = self._wal.append(payload, sync=False)
             self._apply_pairs(pairs)
             self.applied_seqno = seqno
+            self._capture_verify(seqno)
             self._tail_push(seqno, payload, rid)
             self._fire("gc-unsynced")
             self._inserts_since_snap += 1
@@ -1350,6 +1411,7 @@ class ServeCore:
             self._fire("wal")
             self._apply_pairs(pairs)
             self.applied_seqno = seqno
+            self._capture_verify(seqno)
             if sync:
                 self.durable_seqno = seqno
             self._tail_push(seqno, payload, rid)
@@ -1594,6 +1656,11 @@ class ServeCore:
                 shutil.copyfile(wpath, arch)
                 with open(arch, "rb") as f:
                     os.fsync(f.fileno())
+                # the archive bypasses atomic_write (a straight copy), so
+                # the post-seal rot seam fires here explicitly: archived
+                # epoch WALs are scrubbable artifacts like any other
+                from ..io import faultfs
+                faultfs.rot_after_seal(arch)
             except OSError as exc:
                 # the archive is an audit artifact, not a recovery
                 # dependency (every record is in the sealed snapshot)
@@ -1612,7 +1679,8 @@ class ServeCore:
 
     def reset_from_snapshot(self, snap: ServeSnapshot,
                             allow_sig_change: bool = False,
-                            allow_gen_rollback: bool = False) -> None:
+                            allow_gen_rollback: bool = False,
+                            allow_rollback: bool = False) -> None:
         """Follower full re-sync: discard the local chain and adopt a
         snapshot shipped by the leader (the stream could not be resumed
         — the follower lagged past the leader's WAL, or carries a fenced
@@ -1638,7 +1706,16 @@ class ServeCore:
         itself carries no client writes, and the surviving leader holds
         every quorum-acked record); the caller MUST have written the
         adoption manifest (reseq.write_adoption) sanctioning the
-        rollback first, same discipline as ``allow_sig_change``."""
+        rollback first, same discipline as ``allow_sig_change``.
+
+        ``allow_rollback`` — quarantine healing (ISSUE 20): the stream
+        anti-entropy check proved this replica's tail DIVERGENT, so
+        adopting the leader's (possibly older-seqno) snapshot and
+        re-streaming from its boundary is the point, not an accident.
+        Sound because every acked record past the snapshot boundary is
+        in the leader's chain and re-ships on reconnect; the caller must
+        hold the durable quarantine marker (serve/scrub.py) sanctioning
+        the discard, same discipline as the other two flags."""
         snap.validate()
         with self._lock:
             if snap.sig != self.sig and not (
@@ -1650,7 +1727,7 @@ class ServeCore:
                     f"{self.sig[:12]}...) — refusing to adopt")
             if (snap.epoch, snap.applied_seqno) < (self.epoch,
                                                    self.applied_seqno) \
-                    and not allow_gen_rollback:
+                    and not (allow_gen_rollback or allow_rollback):
                 raise IntegrityError(
                     f"replication snapshot (epoch {snap.epoch}, seqno "
                     f"{snap.applied_seqno}) is older than the local state "

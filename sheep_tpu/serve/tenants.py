@@ -196,14 +196,23 @@ class Tenant:
         """Cold-evictable: resident, not the default, and no replication
         machinery would be stranded by dropping the core.  A tenant with
         an in-flight re-sequence (ISSUE 18) is pinned too: sealing it
-        out of memory would orphan the rebuild mid-phase."""
+        out of memory would orphan the rebuild mid-phase.  A quarantined
+        tenant (ISSUE 20) is pinned hardest of all: eviction SEALS the
+        in-memory state to a snapshot, and its state is exactly what the
+        quarantine says not to trust — sealing it would launder the
+        divergence into a sidecar-vouched artifact."""
         if self.name == DEFAULT_TENANT or self.core is None:
             return False
         if self.replicator is not None or self.mig is not None:
             return False
+        if getattr(self.core, "quarantined", False):
+            return False
         if self.core.state_dir:
             from .reseq import active
             if active(self.core.state_dir):
+                return False
+            from .scrub import read_quarantine
+            if read_quarantine(self.core.state_dir) is not None:
                 return False
         return self.hub is None or self.hub.follower_count() == 0
 

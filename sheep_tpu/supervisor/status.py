@@ -352,7 +352,10 @@ def serve_status_json(state_dir: str) -> dict:
                 for key in ("role", "epoch", "applied_seqno", "repl_lag",
                             "followers", "node", "leader", "moved_dest",
                             "mig_phase", "mig_lag", "migrating",
-                            "seq_drift", "reseqs", "seq_gen"):
+                            "seq_drift", "reseqs", "seq_gen",
+                            "diverged", "scrub_runs",
+                            "scrub_quarantined", "scrub_repaired",
+                            "quarantine_heals"):
                     if key in out["stats"]:
                         out[key] = out["stats"][key]
         except Exception:
@@ -392,6 +395,17 @@ def serve_status_json(state_dir: str) -> dict:
             out["reseq_phase"] = man.get("phase")
     except Exception:
         pass
+    # a durable quarantine marker (ISSUE 20) is likewise visible with
+    # the daemon down — the operator must know this replica's state is
+    # divergent BEFORE deciding to restart or promote it
+    try:
+        from ..serve import scrub as scrub_mod
+        quar = scrub_mod.read_quarantine(state_dir)
+        if quar is not None:
+            out["quarantine_phase"] = quar.get("phase")
+            out["diverged"] = 1
+    except Exception:
+        pass
     out["trace"] = newest_trace_rollup(state_dir)
     return out
 
@@ -404,7 +418,10 @@ def render_serve_status(state_dir: str) -> str:
     for key in ("node", "role", "epoch", "applied_seqno", "leader",
                 "repl_lag", "followers", "addr", "newest_snapshot",
                 "moved_dest", "mig_phase", "mig_lag", "migrating",
-                "seq_drift", "reseqs", "seq_gen", "reseq_phase"):
+                "seq_drift", "reseqs", "seq_gen", "reseq_phase",
+                "diverged", "scrub_runs", "scrub_quarantined",
+                "scrub_repaired", "quarantine_heals",
+                "quarantine_phase"):
         if key in rec and rec[key] is not None:
             lines.append(f"{key}: {rec[key]}")
     st = rec.get("stats", {})

@@ -343,6 +343,28 @@ KNOBS: dict[str, Knob] = {k.name: k for k in [
     _K("SHEEP_RESEQ_HORIZON_S", "float", "60",
        "reseq", "priced rebuild cost above this horizon stays (drift "
        "keeps accruing until forced or cheaper)"),
+    # -- anti-entropy / scrubbing (ISSUE 20) -------------------------------
+    _K("SHEEP_SCRUB_VERIFY_N", "int", "256",
+       "scrub", "VERIFY-frame cadence in applied records: the leader "
+       "stamps a state-crc checkpoint into the replication stream "
+       "every N records (0 = off); divergence is detected within one "
+       "cadence"),
+    _K("SHEEP_SCRUB_INTERVAL_S", "float", "0",
+       "scrub", "background artifact-scrub period per daemon (0 = "
+       "off; the SCRUB verb still runs one inline)"),
+    _K("SHEEP_SCRUB_PACE_S", "float", "0",
+       "scrub", "sleep between artifacts inside one scrub pass so the "
+       "re-read never starves foreground I/O"),
+    _K("SHEEP_SCRUB_PIN", "str", "",
+       "scrub", "pin the background scrub pricing verdict: go / stay "
+       "(unset = plan_scrub prices the pass)"),
+    _K("SHEEP_SCRUB_HORIZON_S", "float", "30",
+       "scrub", "priced re-verification cost above this horizon stays "
+       "(the interval re-offers the pass later)"),
+    _K("SHEEP_SCRUB_ALLOW_CORRUPT", "flag", "0",
+       "scrub", "enable the CORRUPT verb (bench/test divergence "
+       "injector that flips one live byte); production daemons refuse "
+       "it unset"),
     # -- multi-process / dist CLI ------------------------------------------
     _K("SHEEP_COORDINATOR", "str", "",
        "dist", "jax.distributed coordinator address"),

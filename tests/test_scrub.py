@@ -1,0 +1,746 @@
+"""Anti-entropy tests (ISSUE 20): the rot-injection sweep over every
+sealed artifact kind (detected -> quarantined -> repaired -> fsck-clean),
+the VERIFY frame grammar + old-daemon forward compatibility, kill -9 at
+every quarantine/re-sync phase boundary (the marker survives and reads
+stay refused), and the router's exclusion of quarantined members from
+the read spread."""
+
+import os
+import shutil
+import socket
+import time
+
+import numpy as np
+import pytest
+
+from sheep_tpu.cli.graph2tree import _tree_sig
+from sheep_tpu.core.forest import build_forest
+from sheep_tpu.core.sequence import degree_sequence
+from sheep_tpu.integrity.errors import IntegrityError, MalformedArtifact
+from sheep_tpu.integrity.fsck import fsck_file, fsck_paths
+from sheep_tpu.io import faultfs
+from sheep_tpu.io.edges import write_dat
+from sheep_tpu.io.faultfs import parse_io_fault_plan
+from sheep_tpu.io.seqfile import write_sequence
+from sheep_tpu.io.trefile import write_tree
+from sheep_tpu.ops.distext import write_histogram
+from sheep_tpu.ops.extmem import range_degree_histogram
+from sheep_tpu.plan.model import plan_scrub
+from sheep_tpu.serve import faults as serve_faults
+from sheep_tpu.serve import netfaults, scrub
+from sheep_tpu.serve.cluster import ClusterConfig
+from sheep_tpu.serve.daemon import ServeConfig, ServeDaemon
+from sheep_tpu.serve.faults import ServeKilled, parse_serve_fault_plan
+from sheep_tpu.serve.protocol import ServeClient, ServeError
+from sheep_tpu.serve.replicate import (Diverged, ReplApplier, Replicator,
+                                       ReplProtocolError,
+                                       bootstrap_state_dir, encode_append,
+                                       encode_hello, encode_verify,
+                                       parse_frame)
+from sheep_tpu.serve.router import Router, _Cluster
+from sheep_tpu.serve.state import ServeCore
+from sheep_tpu.utils.synth import rmat_edges
+
+
+@pytest.fixture(autouse=True)
+def _clean_fault_plans():
+    faultfs.clear_plan()
+    serve_faults.clear_plan()
+    netfaults.clear_plan()
+    yield
+    faultfs.clear_plan()
+    serve_faults.clear_plan()
+    netfaults.clear_plan()
+
+
+def _wait_until(cond, timeout_s=15.0, poll_s=0.02, what="condition"):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if cond():
+            return
+        time.sleep(poll_s)
+    raise TimeoutError(f"{what} not reached in {timeout_s}s")
+
+
+def _make_state(tmp_path, name, seed=5, log2=7, parts=3):
+    tail, head = rmat_edges(log2, 4 << log2, seed=seed)
+    g = str(tmp_path / f"{name}.dat")
+    write_dat(g, tail, head)
+    sd = str(tmp_path / name)
+    core = ServeCore.bootstrap(sd, graph_path=g, num_parts=parts)
+    return core, sd, tail, head
+
+
+def _flip(path, offset=None, xor=0x01):
+    b = bytearray(open(path, "rb").read())
+    off = (len(b) // 2) if offset is None else (offset % len(b))
+    b[off] ^= xor
+    open(path, "wb").write(bytes(b))
+
+
+# ---------------------------------------------------------------------------
+# the durable quarantine marker
+# ---------------------------------------------------------------------------
+
+
+def test_quarantine_marker_lifecycle(tmp_path):
+    sd = str(tmp_path)
+    assert scrub.read_quarantine(sd) is None
+    rec = scrub.enter_quarantine(sd, "stream-verify", seqno=7, epoch=1,
+                                 expect_crc=10, got_crc=11)
+    assert rec["phase"] == scrub.PHASE_DIVERGED and rec["seqno"] == 7
+    # idempotent: a second entry never rewinds the phase
+    scrub.mark_phase(sd, scrub.PHASE_RESYNC)
+    again = scrub.enter_quarantine(sd, "other", seqno=99)
+    assert again["phase"] == scrub.PHASE_RESYNC
+    rec = scrub.mark_phase(sd, scrub.PHASE_VERIFY, crc=5)
+    assert rec["crc"] == 5
+    # fields from earlier phases persist through the walk
+    assert scrub.read_quarantine(sd)["seqno"] == 7
+    with pytest.raises(ValueError):
+        scrub.mark_phase(sd, "limbo")
+    scrub.clear_quarantine(sd)
+    assert scrub.read_quarantine(sd) is None
+    scrub.clear_quarantine(sd)  # clearing twice is fine
+
+
+def test_unreadable_marker_reads_as_quarantined(tmp_path):
+    """When the evidence of divergence is itself damaged, the dir must
+    still refuse to serve — an unreadable marker IS a marker."""
+    sd = str(tmp_path)
+    with open(scrub.quarantine_path(sd), "w") as f:
+        f.write("{torn")
+    rec = scrub.read_quarantine(sd)
+    assert rec is not None and rec["phase"] == scrub.PHASE_DIVERGED
+    assert rec["reason"] == "unreadable-marker"
+
+
+# ---------------------------------------------------------------------------
+# the hash-chained scrub manifest
+# ---------------------------------------------------------------------------
+
+
+def test_scrub_chain_appends_verifies_and_refuses_tampering(tmp_path):
+    import json
+    sd = str(tmp_path)
+    for i in range(3):
+        scrub.append_scrub_record(sd, {"at": float(i), "checked": i})
+    assert "runs=3" in scrub.verify_scrub_chain(sd)
+    runs = scrub.load_scrub_manifest(sd)
+    assert runs[1]["prev"] == runs[0]["hash"] and runs[0]["prev"] == ""
+    # edit a landed record: its hash no longer covers the body
+    runs[1]["checked"] = 999
+    with open(scrub.scrub_manifest_path(sd), "w") as f:
+        json.dump(runs, f)
+    with pytest.raises(MalformedArtifact):
+        scrub.verify_scrub_chain(sd)
+    # drop a record: the chain link breaks
+    runs[1]["checked"] = 1  # restore the body so only the drop breaks it
+    with open(scrub.scrub_manifest_path(sd), "w") as f:
+        json.dump([runs[0], runs[2]], f)
+    with pytest.raises(MalformedArtifact):
+        scrub.verify_scrub_chain(sd)
+
+
+def test_scrub_chain_trim_keeps_verifiable_anchor(tmp_path):
+    sd = str(tmp_path)
+    for i in range(scrub.SCRUB_CHAIN_KEEP + 9):
+        scrub.append_scrub_record(sd, {"at": float(i)})
+    runs = scrub.load_scrub_manifest(sd)
+    assert len(runs) == scrub.SCRUB_CHAIN_KEEP
+    # the trimmed prefix's hash survives as the oldest record's anchor
+    assert runs[0]["prev"] != ""
+    assert "chain-ok" in scrub.verify_scrub_chain(sd)
+
+
+# ---------------------------------------------------------------------------
+# VERIFY frame grammar + forward compat
+# ---------------------------------------------------------------------------
+
+
+def test_verify_frame_codec_roundtrip():
+    line = encode_verify(3, 512, 0xDEADBEEF)
+    fr = parse_frame(line)
+    assert fr.kind == "VERIFY" and fr.epoch() == 3
+    assert fr.seqno() == 512 and int(fr.kv["crc"]) == 0xDEADBEEF
+    for bad in ("REPL VERIFY epoch=1 seqno=2",        # missing crc
+                "REPL VERIFY epoch=1 crc=5",          # missing seqno
+                "REPL VERIFY epoch=x seqno=2 crc=5"):  # non-integer
+        with pytest.raises(ReplProtocolError):
+            parse_frame(bad)
+
+
+def test_hello_advertises_verify_by_capability():
+    plain = encode_hello("n1", 0, 0, "sig")
+    assert "verify" not in plain and "mig" not in plain
+    assert encode_hello("n1", 0, 0, "sig", verify=True).endswith(" verify=1")
+    # migration delta streams never advertise verify (Replicator)
+    assert "verify" not in encode_hello("n1", 0, 0, "sig", mig=True)
+
+
+def test_verify_mismatch_quarantines_match_acks(tmp_path):
+    leader, _, _, _ = _make_state(tmp_path, "lead")
+    seqno = leader.insert(np.array([[2, 9]], np.uint32))
+    payload = leader.records_from(seqno - 1)[0][1]
+    fol, fsd, _, _ = _make_state(tmp_path, "fol")
+    sent = []
+    applier = ReplApplier(fol, sent.append)
+    applier.feed((encode_append(0, seqno, payload) + "\n").encode("ascii"))
+    assert fol.applied_seqno == 1
+    # matching crc: compared, acked, no quarantine
+    good = leader.state_crc()
+    assert good == fol.state_crc()
+    applier.feed((encode_verify(0, 1, good) + "\n").encode("ascii"))
+    assert applier.verifies == 1 and applier.diverged == 0
+    assert sent[-1] == "REPL ACK seqno=1"
+    # a VERIFY for a seqno we are not at is skipped, never compared
+    applier.feed((encode_verify(0, 5, 12345) + "\n").encode("ascii"))
+    assert applier.verifies == 1
+    # mismatch: durable quarantine BEFORE the stream tears
+    seen = []
+    applier.on_diverged = lambda s, w, g: seen.append((s, w, g))
+    with pytest.raises(Diverged):
+        applier.feed((encode_verify(0, 1, good ^ 1) + "\n")
+                     .encode("ascii"))
+    assert applier.diverged == 1 and fol.quarantined
+    assert seen == [(1, good ^ 1, good)]
+    rec = scrub.read_quarantine(fsd)
+    assert rec["phase"] == scrub.PHASE_DIVERGED
+    assert rec["got_crc"] == good and rec["expect_crc"] == good ^ 1
+    leader.close()
+    fol.close()
+
+
+def test_old_follower_never_sees_verify_frames(tmp_path, monkeypatch):
+    """Forward compat by capability: a HELLO without ``verify=1`` (an
+    old daemon) gets the plain PR-7 stream — zero VERIFY frames — while
+    a verify-capable HELLO on the same leader gets stamped."""
+    monkeypatch.setenv(scrub.VERIFY_N_ENV, "2")
+    core, sd, _, _ = _make_state(tmp_path, "lead")
+    d = ServeDaemon(core, ServeConfig()).start()
+    try:
+        lh, lp = d.address
+
+        def stream_bytes(hello_line, n_inserts):
+            s = socket.create_connection((lh, lp), timeout=10.0)
+            s.sendall((hello_line + "\n").encode("ascii"))
+            time.sleep(0.2)
+            with ServeClient(lh, lp) as c:
+                for i in range(n_inserts):
+                    c.insert([(i, i + 3)])
+            got = bytearray()
+            s.settimeout(0.5)
+            try:
+                while True:
+                    data = s.recv(1 << 16)
+                    if not data:
+                        break
+                    got.extend(data)
+            except socket.timeout:
+                pass
+            s.close()
+            return bytes(got)
+
+        old = stream_bytes(
+            encode_hello("old", core.epoch, core.applied_seqno, core.sig),
+            4)
+        assert b"APPEND" in old and b"VERIFY" not in old
+        new = stream_bytes(
+            encode_hello("new", core.epoch, core.applied_seqno, core.sig,
+                         verify=True), 4)
+        assert b"APPEND" in new and b"VERIFY" in new
+    finally:
+        d.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# plan_scrub pricing
+# ---------------------------------------------------------------------------
+
+
+def test_plan_scrub_pricing(monkeypatch):
+    monkeypatch.delenv("SHEEP_SCRUB_PIN", raising=False)
+    none = plan_scrub(0, 0)
+    assert none["decision"] == "stay"
+    small = plan_scrub(4, 1 << 20)
+    assert small["decision"] == "go" and small["cost_s"] < 1.0
+    huge = plan_scrub(4, 1 << 40, horizon_s=1.0)
+    assert huge["decision"] == "stay"
+    monkeypatch.setenv("SHEEP_SCRUB_PIN", "go")
+    pinned = plan_scrub(4, 1 << 40, horizon_s=1.0)
+    assert pinned["decision"] == "go" and pinned["provenance"] == "forced"
+
+
+# ---------------------------------------------------------------------------
+# the rot sweep: every sealed artifact kind, detected -> quarantined ->
+# repaired -> fsck-clean
+# ---------------------------------------------------------------------------
+
+
+def _leg_artifacts(d):
+    """A worker-leg-shaped artifact family in ``d``: .dat -> .seq ->
+    .tre -> .hist, each sidecar-sealed."""
+    tail, head = rmat_edges(6, 4 << 6, seed=11)
+    dat = os.path.join(d, "leg.dat")
+    write_dat(dat, tail, head)
+    seq = degree_sequence(tail, head)
+    seq_p = os.path.join(d, "leg.seq")
+    write_sequence(seq, seq_p)
+    forest = build_forest(tail, head, seq)
+    tre_p = os.path.join(d, "leg.tre")
+    write_tree(tre_p, forest.parent, forest.pst_weight, sig=_tree_sig(seq))
+    hist_p = os.path.join(d, "leg.hist")
+    deg, max_vid, records = range_degree_histogram(
+        dat, start_edge=0, end_edge=len(tail))
+    write_histogram(hist_p, deg, records, max_vid, 0, len(tail))
+    return {".seq": seq_p, ".tre": tre_p, ".hist": hist_p}
+
+
+@pytest.mark.faults
+@pytest.mark.parametrize("kind", [".seq", ".tre", ".hist"])
+def test_rot_sweep_leg_artifacts(tmp_path, kind):
+    d = str(tmp_path)
+    paths = _leg_artifacts(d)
+    victim = paths[kind]
+    before = open(victim, "rb").read()
+    _flip(victim)
+    counts = scrub.run_scrub(d, fire_faults=False)
+    assert counts["failed"] == 1 and counts["quarantined"] == 1
+    assert counts["repaired"] == 1 and counts["unrepaired"] == 0
+    # the repair re-derived byte-identical content under the real name
+    assert open(victim, "rb").read() == before
+    # the quarantined copy stays as evidence, and fsck is clean: the
+    # *.quarantined convention reports without failing
+    assert os.path.exists(victim + scrub.QUAR_SUFFIX)
+    _, failures = fsck_paths([d], mode="strict")
+    assert not failures, failures
+    # the run chained its record
+    assert "chain-ok" in scrub.verify_scrub_chain(d)
+
+
+@pytest.mark.faults
+def test_rot_sweep_snapshot_reseals_from_live_core(tmp_path):
+    core, sd, _, _ = _make_state(tmp_path, "lead")
+    snaps = [n for n in os.listdir(sd) if n.endswith(".snap")]
+    assert snaps
+    _flip(os.path.join(sd, snaps[0]))
+    counts = scrub.run_scrub(sd, core=core, fire_faults=False)
+    assert counts["quarantined"] == 1 and counts["repaired"] == 1
+    _, failures = fsck_paths([sd], mode="strict")
+    assert not failures, failures
+    core.close()
+
+
+@pytest.mark.faults
+def test_rot_sweep_snapshot_fetches_from_leader(tmp_path):
+    """No live core over the rotted dir: the repair pulls the leader's
+    crc-verified snapshot over the replication wire."""
+    lcore, lsd, _, _ = _make_state(tmp_path, "lead")
+    d = ServeDaemon(lcore, ServeConfig()).start()
+    try:
+        lh, lp = d.address
+        fsd = str(tmp_path / "fol")
+        bootstrap_state_dir(fsd, lh, lp)
+        snaps = [n for n in os.listdir(fsd) if n.endswith(".snap")]
+        assert snaps
+        _flip(os.path.join(fsd, snaps[0]))
+        counts = scrub.run_scrub(fsd, leader=(lh, lp), fire_faults=False)
+        assert counts["quarantined"] == 1 and counts["repaired"] == 1
+        _, failures = fsck_paths([fsd], mode="strict")
+        assert not failures, failures
+    finally:
+        d.shutdown()
+
+
+@pytest.mark.faults
+def test_rot_sweep_archived_wal_retired_by_coverage(tmp_path):
+    """A rotted epoch-archived WAL is repaired by PROOF, not bytes: a
+    clean later-epoch snapshot covers its records by construction."""
+    core, sd, _, _ = _make_state(tmp_path, "lead")
+    core.insert(np.array([[1, 5]], np.uint32))
+    core.advance_epoch(1)  # archives the epoch-0 WAL + seals epoch-1 snap
+    arch = [n for n in os.listdir(sd)
+            if n.startswith("serve-e") and n.endswith(".wal")]
+    assert arch
+    _flip(os.path.join(sd, arch[0]))
+    counts = scrub.run_scrub(sd, core=core, fire_faults=False)
+    assert counts["quarantined"] == 1 and counts["repaired"] == 1
+    detail = dict((p, d) for p, v, d in counts["events"])
+    assert any("retired-by-snapshot" in d for d in detail.values())
+    # the archive stays quarantined (evidence); fsck stays clean
+    assert arch[0] + scrub.QUAR_SUFFIX in os.listdir(sd)
+    _, failures = fsck_paths([sd], mode="strict")
+    assert not failures, failures
+    core.close()
+
+
+@pytest.mark.faults
+def test_rot_fault_plan_flips_published_bytes(tmp_path, monkeypatch):
+    """The ``rot@site:nth`` injector: the write succeeds, the sidecar
+    vouches, and THEN one published byte flips — exactly the silent
+    at-rest decay the scrubber exists to catch."""
+    d = str(tmp_path)
+    faultfs.install_plan(parse_io_fault_plan("rot@seq:0"))
+    tail, head = rmat_edges(6, 4 << 6, seed=11)
+    seq = degree_sequence(tail, head)
+    p = os.path.join(d, "leg.seq")
+    write_sequence(seq, p)  # publish succeeds; rot fires post-seal
+    faultfs.clear_plan()
+    with pytest.raises(IntegrityError):
+        fsck_file(p, "strict")
+    # the scrubber re-derives it from the sibling .dat
+    write_dat(os.path.join(d, "leg.dat"), tail, head)
+    counts = scrub.run_scrub(d, fire_faults=False)
+    assert counts["repaired"] == 1
+    assert "sum=verified" in fsck_file(p, "strict")
+
+
+def test_scrub_unrepairable_stays_quarantined_and_reported(tmp_path):
+    """No surviving repair input: the artifact STAYS quarantined (never
+    silently dropped) and fsck keeps reporting it without failing."""
+    d = str(tmp_path)
+    tail, head = rmat_edges(6, 4 << 6, seed=11)
+    p = os.path.join(d, "leg.seq")
+    write_sequence(degree_sequence(tail, head), p)  # no sibling .dat
+    _flip(p)
+    counts = scrub.run_scrub(d, fire_faults=False)
+    assert counts["quarantined"] == 1 and counts["unrepaired"] == 1
+    assert counts["repaired"] == 0
+    assert not os.path.exists(p)
+    assert os.path.exists(p + scrub.QUAR_SUFFIX)
+    results, failures = fsck_paths([d], mode="strict")
+    assert not failures
+    assert any(p + scrub.QUAR_SUFFIX == rp and ok
+               for rp, ok, _ in results)
+
+
+# ---------------------------------------------------------------------------
+# fsck: the quarantine convention + reclaim
+# ---------------------------------------------------------------------------
+
+
+def test_fsck_never_loads_quarantined_and_repair_reclaims(tmp_path):
+    """A *.quarantined file whose bytes are actually FINE (transient
+    controller flake): plain fsck reports it, never loads it, never
+    fails on it; ``--repair`` re-verifies on the quarantined name and
+    reclaims it back under the real name."""
+    d = str(tmp_path)
+    tail, head = rmat_edges(6, 4 << 6, seed=11)
+    p = os.path.join(d, "leg.seq")
+    write_sequence(degree_sequence(tail, head), p)
+    qp = scrub.quarantine_artifact(p)
+    assert qp == p + scrub.QUAR_SUFFIX and not os.path.exists(p)
+    # sidecar rode along under the quarantined name
+    assert os.path.exists(qp + ".sum")
+    results, failures = fsck_paths([d], mode="strict")
+    assert not failures
+    assert any("quarantined" in detail and ok
+               for _, ok, detail in results)
+    # repair mode reclaims the clean bytes
+    results, failures = fsck_paths([d], mode="repair")
+    assert not failures
+    assert any("reclaimed" in detail for _, ok, detail in results)
+    assert os.path.exists(p) and not os.path.exists(qp)
+    assert "sum=verified" in fsck_file(p, "strict")
+
+
+def test_reclaim_refuses_still_corrupt_and_clobber(tmp_path):
+    d = str(tmp_path)
+    tail, head = rmat_edges(6, 4 << 6, seed=11)
+    p = os.path.join(d, "leg.seq")
+    write_sequence(degree_sequence(tail, head), p)
+    qp = scrub.quarantine_artifact(p)
+    _flip(qp)
+    with pytest.raises(IntegrityError):
+        scrub.reclaim_quarantined(qp)
+    assert os.path.exists(qp) and not os.path.exists(p)
+    # a repair already landed a fresh copy: reclaim must not clobber it
+    write_sequence(degree_sequence(tail, head), p)
+    with pytest.raises(IntegrityError):
+        scrub.reclaim_quarantined(qp)
+    assert os.path.exists(p)
+
+
+def test_fsck_validates_scrub_chain(tmp_path):
+    d = str(tmp_path)
+    tail, head = rmat_edges(6, 4 << 6, seed=11)
+    write_sequence(degree_sequence(tail, head),
+                   os.path.join(d, "leg.seq"))
+    scrub.append_scrub_record(d, {"at": 1.0, "checked": 1})
+    results, failures = fsck_paths([d], mode="strict")
+    assert not failures
+    assert any("chain-ok" in detail for _, _, detail in results)
+    # tamper: fsck now fails on the manifest
+    import json
+    runs = scrub.load_scrub_manifest(d)
+    runs[0]["checked"] = 42
+    with open(scrub.scrub_manifest_path(d), "w") as f:
+        json.dump(runs, f)
+    _, failures = fsck_paths([d], mode="strict")
+    assert any("scrub" in str(f) for f in failures), failures
+
+
+# ---------------------------------------------------------------------------
+# the live cluster: divergence -> quarantine -> heal, kill -9 at every
+# phase boundary, read refusal throughout
+# ---------------------------------------------------------------------------
+
+
+def _spawn_pair(tmp_path, verify_n=4, **env):
+    os.environ[scrub.VERIFY_N_ENV] = str(verify_n)
+    lcore, lsd, tail, head = _make_state(tmp_path, "lead")
+    fsd = str(tmp_path / "fol")
+    lead = ServeDaemon(
+        lcore, ServeConfig(),
+        cluster=ClusterConfig(node_id="L", role="leader", peers=[fsd],
+                              hb_s=0.05, failover_s=30.0,
+                              poll_timeout_s=1.0)).start()
+    lh, lp = lead.address
+    bootstrap_state_dir(fsd, lh, lp)
+    fol = ServeDaemon(
+        ServeCore.open(fsd), ServeConfig(),
+        cluster=ClusterConfig(node_id="F", role="follower", peers=[lsd],
+                              hb_s=0.05, failover_s=30.0,
+                              poll_timeout_s=1.0)).start()
+    _wait_until(lambda: lead.hub.follower_count() == 1,
+                what="follower attached")
+    return lead, fol, lsd, fsd
+
+
+@pytest.mark.faults
+def test_live_divergence_detected_quarantined_healed(tmp_path, monkeypatch):
+    """The tentpole acceptance in-process: CORRUPT one byte of the
+    follower's live state, insert through the next verify point — the
+    follower detects the crc mismatch within one cadence, quarantines
+    durably, refuses reads typed, re-syncs from the leader's snapshot,
+    and rejoins state_crc-equal."""
+    monkeypatch.setenv(scrub.ALLOW_CORRUPT_ENV, "1")
+    lead, fol, lsd, fsd = _spawn_pair(tmp_path, verify_n=4)
+    try:
+        lh, lp = lead.address
+        fh, fp = fol.address
+        with ServeClient(lh, lp) as c:
+            for i in range(4):
+                c.insert([(i, i + 7)])
+        _wait_until(lambda: fol.core.applied_seqno == 4,
+                    what="follower caught up")
+        with ServeClient(fh, fp) as c:
+            bad_crc = c.kv("CORRUPT")["crc"]
+        assert bad_crc != lead.core.state_crc()
+        # the next verify point rides in with these inserts
+        with ServeClient(lh, lp) as c:
+            for i in range(8):
+                c.insert([(i + 50, i + 90)])
+        _wait_until(lambda: fol.replicator.quarantine_heals >= 1,
+                    what="divergence detected and healed")
+        assert fol.core.state_crc() == lead.core.state_crc()
+        assert not fol.core.quarantined
+        assert scrub.read_quarantine(fsd) is None
+        with ServeClient(fh, fp) as c:
+            st = c.kv("STATS")
+        assert st["diverged"] == 0 and st["quarantine_heals"] >= 1
+        assert fol.counters["diverged_reads"] >= 0
+    finally:
+        lead.shutdown()
+        fol.shutdown()
+
+
+@pytest.mark.faults
+def test_quarantined_daemon_refuses_reads_typed(tmp_path):
+    core, sd, _, _ = _make_state(tmp_path, "solo")
+    d = ServeDaemon(core, ServeConfig()).start()
+    try:
+        h, p = d.address
+        with ServeClient(h, p) as c:
+            assert c.part([0, 1]) is not None
+            core.quarantined = True
+            with pytest.raises(ServeError) as ei:
+                c.part([0, 1])
+            assert ei.value.code == "diverged"
+            # non-read verbs still answer: STATS carries the health
+            st = c.kv("STATS")
+            assert st["diverged"] == 1
+        assert d.counters["diverged_reads"] == 1
+    finally:
+        core.quarantined = False
+        d.shutdown()
+
+
+@pytest.mark.faults
+@pytest.mark.parametrize("site", ["quar-resync", "quar-verify",
+                                  "quar-clear"])
+def test_kill_at_every_heal_boundary_resumes(tmp_path, site):
+    """kill -9 at each quarantine/re-sync phase boundary: the durable
+    marker survives, the restarted replica is still quarantined (reads
+    refused), and the re-run heal converges to the leader's crc."""
+    lcore, lsd, _, _ = _make_state(tmp_path, "lead")
+    lead = ServeDaemon(lcore, ServeConfig()).start()
+    try:
+        lh, lp = lead.address
+        with ServeClient(lh, lp) as c:
+            for i in range(4):
+                c.insert([(i, i + 7)])
+        fsd = str(tmp_path / "fol")
+        bootstrap_state_dir(fsd, lh, lp)
+        fol = ServeCore.open(fsd)
+        scrub.enter_quarantine(fsd, "test-divergence", seqno=4)
+        fol.quarantined = True
+        rep = Replicator(fol, "F", lambda: (lh, lp))  # never start()ed
+        serve_faults.install_plan(parse_serve_fault_plan(
+            f"kill@{site}:0", kill_mode="raise"))
+        with pytest.raises(ServeKilled):
+            rep._heal_quarantine((lh, lp))
+        serve_faults.clear_plan()
+        fol.close()  # the "process" died; durable state only
+
+        # restart: the marker decides — still quarantined at every site
+        # before quar-clear, whose kill fires AFTER the marker unlinked
+        revived = ServeCore.open(fsd)
+        marker = scrub.read_quarantine(fsd)
+        if site == "quar-clear":
+            assert marker is None
+        else:
+            assert marker is not None
+            assert marker["phase"] in scrub.PHASES
+            revived.quarantined = True  # the daemon's startup sweep
+            rep2 = Replicator(revived, "F", lambda: (lh, lp))
+            rep2._heal_quarantine((lh, lp))
+            assert rep2.quarantine_heals == 1
+        assert scrub.read_quarantine(fsd) is None
+        assert revived.state_crc() == lcore.state_crc(), site
+        _, failures = fsck_paths([fsd], mode="strict")
+        assert not failures, (site, failures)
+        revived.close()
+    finally:
+        lead.shutdown()
+
+
+@pytest.mark.faults
+@pytest.mark.parametrize("site", ["scrub-quar", "scrub-repair"])
+def test_kill_at_scrub_boundaries_reenters_cleanly(tmp_path, site):
+    """kill -9 mid-scrub: the artifact is either still quarantined (the
+    rename IS durable containment) or already repaired; the next scrub
+    pass finishes the job either way."""
+    d = str(tmp_path)
+    _leg_artifacts(d)
+    _flip(os.path.join(d, "leg.seq"))
+    serve_faults.install_plan(parse_serve_fault_plan(
+        f"kill@{site}:0", kill_mode="raise"))
+    with pytest.raises(ServeKilled):
+        scrub.run_scrub(d)
+    serve_faults.clear_plan()
+    # the real artifact is never half-there: either quarantined away
+    # or fully repaired + verified
+    p = os.path.join(d, "leg.seq")
+    if os.path.exists(p):
+        fsck_file(p, "strict")
+    else:
+        assert os.path.exists(p + scrub.QUAR_SUFFIX)
+    counts = scrub.run_scrub(d, fire_faults=False)
+    assert counts["unrepaired"] == 0
+    assert os.path.exists(p)
+    _, failures = fsck_paths([d], mode="strict")
+    assert not failures, failures
+
+
+@pytest.mark.faults
+def test_daemon_startup_sweeps_quarantine_marker(tmp_path):
+    """A daemon restarted over a marked state dir comes up already
+    quarantined — kill -9 between marker and heal never serves
+    divergent data."""
+    core, sd, _, _ = _make_state(tmp_path, "solo")
+    core.close()
+    scrub.enter_quarantine(sd, "pre-restart")
+    d = ServeDaemon(ServeCore.open(sd), ServeConfig()).start()
+    try:
+        assert d.core.quarantined
+        h, p = d.address
+        with ServeClient(h, p) as c:
+            with pytest.raises(ServeError) as ei:
+                c.part([0])
+            assert ei.value.code == "diverged"
+    finally:
+        d.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# router: quarantined members leave the read spread
+# ---------------------------------------------------------------------------
+
+
+def test_read_targets_push_diverged_to_back(tmp_path):
+    c = _Cluster("c0", ["127.0.0.1:1", "127.0.0.1:2", "127.0.0.1:3"],
+                 poll_timeout_s=0.1)
+    bad = ("127.0.0.1", 2)
+    c.mark_diverged(bad)
+    for _ in range(6):
+        targets = c.read_targets()
+        assert targets[-1] == bad and bad not in targets[:-1]
+    # the mark expires after its TTL: back in the rotation
+    with c._lock:
+        c._diverged[bad] = time.monotonic() - 1
+    assert any(c.read_targets()[0] == bad for _ in range(6))
+
+
+@pytest.mark.faults
+def test_router_skips_quarantined_member(tmp_path):
+    """Reads through the router keep answering while one member is
+    quarantined: the first ``ERR diverged`` marks it out of the spread
+    and every spread read lands on healthy members."""
+    lead, fol, lsd, fsd = _spawn_pair(tmp_path)
+    router = Router({"c0": [lsd, fsd]}, retries=4,
+                    poll_timeout_s=0.5).start()
+    try:
+        rh, rp = router.address
+        fol.core.quarantined = True
+        want = [lead.core.part(v) for v in (0, 1, 2)]
+        with ServeClient(rh, rp, timeout_s=30.0) as c:
+            for _ in range(12):
+                assert c.part([0, 1, 2]) == want
+        assert router.counters["diverged_skips"] >= 1
+        # after the mark, reads stopped landing on the quarantined
+        # member: its refusal count stays far below the request count
+        assert fol.counters["diverged_reads"] <= 2
+    finally:
+        fol.core.quarantined = False
+        router.shutdown()
+        lead.shutdown()
+        fol.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# the wire surface: CRC / SCRUB / CORRUPT verbs
+# ---------------------------------------------------------------------------
+
+
+def test_crc_scrub_corrupt_verbs(tmp_path, monkeypatch):
+    core, sd, _, _ = _make_state(tmp_path, "solo")
+    d = ServeDaemon(core, ServeConfig()).start()
+    try:
+        h, p = d.address
+        with ServeClient(h, p) as c:
+            st = c.kv("CRC")
+            assert st["crc"] == core.state_crc()
+            assert st["seqno"] == core.applied_seqno
+            # CORRUPT is refused until the operator opts in
+            monkeypatch.delenv(scrub.ALLOW_CORRUPT_ENV, raising=False)
+            with pytest.raises(ServeError) as ei:
+                c.kv("CORRUPT")
+            assert ei.value.code == "unavailable"
+            monkeypatch.setenv(scrub.ALLOW_CORRUPT_ENV, "1")
+            # ... and needs inserted edges to flip
+            with pytest.raises(ServeError):
+                c.kv("CORRUPT")
+            c.insert([(1, 5)])
+            before = core.state_crc()
+            out = c.kv("CORRUPT")
+            assert out["crc"] != before
+            # a forced inline scrub answers with counts and chains
+            counts = c.kv("SCRUB")
+            assert counts["checked"] >= 1 and counts["failed"] == 0
+        assert "chain-ok" in scrub.verify_scrub_chain(sd)
+        with ServeClient(h, p) as c:
+            st = c.kv("STATS")
+        assert st["scrub_runs"] == 1
+    finally:
+        d.shutdown()
